@@ -1,0 +1,34 @@
+"""npairloss_tpu — TPU-native multi-class N-pair metric-learning framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design of the capabilities of the
+reference Caffe CUDA+MPI layer ``NPairMultiClassLossLayer`` (quziyan/NPairLoss)
+and its implied host framework.  This top-level module exports the compute
+core: the mined N-pair loss with cross-chip global negative pooling,
+in-training retrieval metrics, and L2 normalization.  Subpackages:
+``parallel`` (device-mesh plumbing), ``config`` (prototxt front-end),
+``data`` (identity-balanced pipeline), ``models`` (embedding zoo),
+``train`` (solver loop).
+"""
+
+from npairloss_tpu.ops.npair_loss import (
+    MiningMethod,
+    MiningRegion,
+    NPairLossConfig,
+    npair_loss,
+    npair_loss_with_aux,
+)
+from npairloss_tpu.ops.metrics import retrieval_metrics
+from npairloss_tpu.ops.normalize import l2_normalize
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MiningMethod",
+    "MiningRegion",
+    "NPairLossConfig",
+    "npair_loss",
+    "npair_loss_with_aux",
+    "retrieval_metrics",
+    "l2_normalize",
+    "__version__",
+]

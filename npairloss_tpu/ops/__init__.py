@@ -1,0 +1,9 @@
+from npairloss_tpu.ops.npair_loss import (
+    MiningMethod,
+    MiningRegion,
+    NPairLossConfig,
+    npair_loss,
+    npair_loss_with_aux,
+)
+from npairloss_tpu.ops.metrics import feature_asum, recall_at_k, retrieval_metrics
+from npairloss_tpu.ops.normalize import l2_normalize

@@ -1,0 +1,84 @@
+"""In-training retrieval metrics, on-device.
+
+The reference computes Recall@k with a per-query host-side std::sort over the
+exp'd similarity row (GetRetrivePerformance, npair_multi_class_loss.cu:173-206)
+and a feature-magnitude monitor (cu:400-401).  Here both are fixed-shape
+``lax.top_k``/reductions inside the jitted graph — no host sync.
+
+Reference semantics preserved exactly:
+  * the self column (gathered index rank*N + q) is excluded (cu:182, cu:196);
+  * the threshold is the sorted-descending value at index
+    ``min(top_k, list_size - 1)`` over the N*G - 1 non-self sims (cu:190);
+  * a query counts as retrieved iff some non-self item has sim STRICTLY
+    greater than the threshold AND the same label (cu:197) — ties at the
+    threshold do not count;
+  * the metric operates on the exp'd matrix (rank-preserving per row, cu:132).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_FILL = float(-np.finfo(np.float32).max)
+
+# k-list the reference wires up (cu:390-394); with the canonical 5-top layout
+# only {1, 5, 10} are consumed (k=15 defined but unused, SURVEY.md C16).
+TOP_K_LIST = (1, 5, 10, 15)
+
+
+def recall_at_k(
+    sim_exp: jax.Array,
+    local_labels: jax.Array,
+    total_labels: jax.Array,
+    rank: jax.Array,
+    top_k: int,
+) -> jax.Array:
+    """Fraction of queries with a same-label item above the top-k threshold."""
+    n_local, n_total = sim_exp.shape
+    col = jnp.arange(n_total, dtype=jnp.int32)[None, :]
+    row_global = jnp.arange(n_local, dtype=jnp.int32)[:, None] + rank * n_local
+    not_self = col != row_global
+
+    masked = jnp.where(not_self, sim_exp, jnp.float32(_NEG_FILL))
+    # Non-self list size is n_total - 1; threshold index min(top_k, size - 1).
+    thr_idx = min(top_k, n_total - 2)
+    top_vals, _ = jax.lax.top_k(masked, thr_idx + 1)
+    threshold = top_vals[:, thr_idx]
+
+    same_lbl = local_labels[:, None] == total_labels[None, :]
+    hit = jnp.any((masked > threshold[:, None]) & same_lbl & not_self, axis=1)
+    return hit.sum().astype(jnp.float32) / jnp.float32(n_local)
+
+
+def feature_asum(features: jax.Array) -> jax.Array:
+    """Mean absolute feature sum: asum(features)/N (cu:400-401).
+
+    After L2 normalization this sits near a constant — it is the reference's
+    sanity monitor for the normalize layer (SURVEY.md §5.5).
+    """
+    n = features.shape[0]
+    return jnp.abs(features.astype(jnp.float32)).sum() / jnp.float32(n)
+
+
+def retrieval_metrics(
+    aux: Dict[str, jax.Array],
+    local_labels: jax.Array,
+    features: jax.Array,
+    top_ks: Sequence[int] = (1, 5, 10),
+) -> Dict[str, jax.Array]:
+    """The reference's metric tops: Recall@k per ``top_ks`` + feature_asum.
+
+    ``aux`` is the second output of ``npair_loss_with_aux``.  Names mirror the
+    def.prototxt top naming (retrieve_top1/5/10, feature_asum).
+    """
+    out = {}
+    for k in top_ks:
+        out[f"retrieve_top{k}"] = recall_at_k(
+            aux["sim_exp"], local_labels, aux["total_labels"], aux["rank"], k
+        )
+    out["feature_asum"] = feature_asum(features)
+    return out

@@ -1,0 +1,23 @@
+"""L2 normalization op.
+
+The reference net L2-normalizes the pool5 embedding immediately before the
+loss (usage/def.prototxt:115-120, layer type "L2Normalize" from the implied
+Caffe fork).  On TPU this is a fused rsqrt-scale that XLA folds into the
+surrounding graph.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def l2_normalize(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    """x / ||x||_2 along ``axis``, numerically guarded.
+
+    Computed in float32 then cast back, so bf16 activations keep unit norm.
+    """
+    xf = x.astype(jnp.float32)
+    sq = jnp.sum(xf * xf, axis=axis, keepdims=True)
+    out = xf * jax.lax.rsqrt(jnp.maximum(sq, eps))
+    return out.astype(x.dtype)

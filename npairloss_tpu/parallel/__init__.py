@@ -1,0 +1,6 @@
+from npairloss_tpu.parallel.mesh import (
+    DEFAULT_AXIS,
+    data_parallel_mesh,
+    shard_batch,
+    sharded_npair_loss_fn,
+)

@@ -1,0 +1,64 @@
+"""Device-mesh plumbing for the global negative pool.
+
+The reference's distribution model is one MPI rank per GPU with
+MPI_Allgather'd embeddings (npair_multi_class_loss.cu:17-43) and an
+MPI_Allreduce of database-side gradients (cu:462-489) — collectives on CPU
+buffers, serialized against compute.  Here the same semantics ride the TPU
+interconnect: a 1-D ``jax.sharding.Mesh`` over the data-parallel axis, the
+loss body wrapped in ``shard_map`` so ``jax.lax.all_gather``/``psum`` become
+ICI (or DCN, multi-slice) collectives fused into the step graph by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from npairloss_tpu.ops.npair_loss import NPairLossConfig, npair_loss_with_aux
+
+DEFAULT_AXIS = "dp"
+
+
+def data_parallel_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, axis: str = DEFAULT_AXIS
+) -> Mesh:
+    """A 1-D mesh over all (or the given) devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def shard_batch(mesh: Mesh, batch, axis: str = DEFAULT_AXIS):
+    """Place a host batch with its leading dim sharded over ``axis``."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def sharded_npair_loss_fn(
+    mesh: Mesh,
+    cfg: NPairLossConfig = NPairLossConfig(),
+    axis: str = DEFAULT_AXIS,
+) -> Callable:
+    """Build ``f(features, labels) -> (loss, aux)`` running under shard_map.
+
+    ``features``/``labels`` are globally-sharded arrays (leading dim split
+    over ``axis``); each shard computes the reference's per-rank loss over the
+    all-gathered pool.  Outputs gain a leading per-rank axis of size G —
+    ``loss`` comes back as shape (G,) (each MPI rank of the reference reports
+    its own loss; their mean is the pod-level monitor).
+    """
+
+    def per_shard(features, labels):
+        loss, aux = npair_loss_with_aux(features, labels, cfg, axis_name=axis)
+        stack = lambda x: jnp.asarray(x)[None]
+        return stack(loss), jax.tree_util.tree_map(stack, aux)
+
+    return jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+    )

@@ -1,0 +1,274 @@
+"""NumPy oracle for the N-pair loss — the golden-test authority.
+
+A deliberately literal, loop-level NumPy rendering of the reference layer's
+semantics (npair_multi_class_loss.cu:207-499), simulating G MPI ranks in one
+process: rank r holds batch block r; MPI_Allgather is a concatenation;
+MPI_Allreduce(SUM) is a sum over ranks.  Slow and simple on purpose — the
+JAX implementation is tested against this, not the other way round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from npairloss_tpu.ops.npair_loss import MiningMethod, MiningRegion, NPairLossConfig
+
+FLT_MAX = float(np.finfo(np.float32).max)
+
+
+def _relative_pos(size: int, sn: float) -> int:
+    # cu:285-287 etc.: C truncation toward zero in both branches.
+    if sn >= 0:
+        pos = size - 1 - int(sn)
+    else:
+        pos = int(size - 1 + sn * size)
+    return min(max(pos, 0), max(size - 1, 0))  # reference is UB out of range
+
+
+def _lookup(sorted_list: List[float], sn: float) -> float:
+    if not sorted_list:
+        return FLT_MAX  # matches the JAX fill for an empty list
+    val = sorted_list[_relative_pos(len(sorted_list), sn)]
+    return val if val >= 0 else -FLT_MAX  # cu:288 quirk
+
+
+@dataclasses.dataclass
+class RankResult:
+    loss: float
+    recalls: Dict[int, float]
+    feature_asum: float
+    sims: np.ndarray
+    sim_exp: np.ndarray
+    same: np.ndarray
+    diff: np.ndarray
+    select: np.ndarray
+    pos_thr: np.ndarray
+    neg_thr: np.ndarray
+    max_all: np.ndarray
+    exp_pos: np.ndarray
+    exp_neg: np.ndarray
+    ident_sum: np.ndarray
+    all_sum: np.ndarray
+    grad: np.ndarray | None = None
+
+
+def forward(
+    features: Sequence[np.ndarray],
+    labels: Sequence[np.ndarray],
+    cfg: NPairLossConfig,
+    top_ks: Sequence[int] = (1, 5, 10),
+) -> List[RankResult]:
+    """Run the forward pass for every simulated rank."""
+    g = len(features)
+    total_f = np.concatenate([f.astype(np.float32) for f in features], axis=0)
+    total_l = np.concatenate([l.astype(np.float32) for l in labels], axis=0)
+    out = []
+    for rank in range(g):
+        out.append(
+            _forward_rank(
+                features[rank].astype(np.float32),
+                labels[rank].astype(np.float32),
+                total_f,
+                total_l,
+                rank,
+                cfg,
+                top_ks,
+            )
+        )
+    return out
+
+
+def _forward_rank(f, l, total_f, total_l, rank, cfg, top_ks):
+    n, d = f.shape
+    ng = total_f.shape[0]
+    sims = (f @ total_f.T).astype(np.float32)
+
+    # Masks (GetLabelDiffMtx, cu:44-66): self pair excluded from both.
+    same = np.zeros((n, ng), dtype=bool)
+    diff = np.zeros((n, ng), dtype=bool)
+    for q in range(n):
+        for b in range(ng):
+            if q + rank * n == b:
+                continue
+            if l[q] == total_l[b]:
+                same[q, b] = True
+            else:
+                diff[q, b] = True
+
+    # Mining statistics (cu:222-273).
+    max_all = np.full(n, -FLT_MAX, dtype=np.float32)
+    min_within = np.full(n, FLT_MAX, dtype=np.float32)
+    max_between = np.full(n, -FLT_MAX, dtype=np.float32)
+    ident_global: List[float] = []
+    diff_global: List[float] = []
+    ident_local: List[List[float]] = []
+    diff_local: List[List[float]] = []
+    for q in range(n):
+        iq: List[float] = []
+        dq: List[float] = []
+        for b in range(ng):
+            s = sims[q, b]
+            if same[q, b]:
+                min_within[q] = min(min_within[q], s)
+                max_all[q] = max(max_all[q], s)
+                iq.append(s)
+                ident_global.append(s)
+            elif diff[q, b]:
+                max_between[q] = max(max_between[q], s)
+                max_all[q] = max(max_all[q], s)
+                dq.append(s)
+                diff_global.append(s)
+        ident_local.append(sorted(iq))
+        diff_local.append(sorted(dq))
+    ident_global.sort()
+    diff_global.sort()
+
+    # Threshold selection (cu:275-337).
+    relative = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
+    pos_thr = np.zeros(n, dtype=np.float32)
+    neg_thr = np.zeros(n, dtype=np.float32)
+    if cfg.ap_mining_region == MiningRegion.LOCAL:
+        if cfg.ap_mining_method in relative:
+            for q in range(n):
+                pos_thr[q] = _lookup(ident_local[q], cfg.identsn)
+        else:
+            pos_thr[:] = max_between
+    else:
+        if cfg.ap_mining_method in relative:
+            pos_thr[:] = _lookup(ident_global, cfg.identsn)
+        else:
+            pos_thr[:] = diff_global[-1] if diff_global else -FLT_MAX
+    if cfg.an_mining_region == MiningRegion.LOCAL:
+        if cfg.an_mining_method in relative:
+            for q in range(n):
+                neg_thr[q] = _lookup(diff_local[q], cfg.diffsn)
+        else:
+            neg_thr[:] = min_within
+    else:
+        if cfg.an_mining_method in relative:
+            neg_thr[:] = _lookup(diff_global, cfg.diffsn)
+        else:
+            neg_thr[:] = ident_global[0] if ident_global else FLT_MAX
+
+    # Selection (GetSampledPairMtx, cu:69-122).
+    select = np.zeros((n, ng), dtype=bool)
+    for q in range(n):
+        pt = pos_thr[q] + np.float32(cfg.margin_ident)
+        nt = neg_thr[q] + np.float32(cfg.margin_diff)
+        for b in range(ng):
+            s = sims[q, b]
+            if same[q, b]:
+                m = cfg.ap_mining_method
+                select[q, b] = (
+                    (m == MiningMethod.HARD and s < pt)
+                    or (m == MiningMethod.EASY and s >= pt)
+                    or m == MiningMethod.RAND
+                    or (m == MiningMethod.RELATIVE_HARD and s <= pt)
+                    or (m == MiningMethod.RELATIVE_EASY and s >= pt)
+                )
+            elif diff[q, b]:
+                m = cfg.an_mining_method
+                select[q, b] = (
+                    (m == MiningMethod.HARD and s > nt)
+                    or (m == MiningMethod.EASY and s <= nt)
+                    or m == MiningMethod.RAND
+                    or (m == MiningMethod.RELATIVE_HARD and s >= nt)
+                    or (m == MiningMethod.RELATIVE_EASY and s <= nt)
+                )
+    sel_pos = (same & select).astype(np.float32)
+    sel_neg = (diff & select).astype(np.float32)
+
+    # Stabilized loss (cu:124-171, cu:362-388).
+    sim_exp = np.exp(sims - max_all[:, None]).astype(np.float32)
+    exp_pos = sim_exp * sel_pos
+    exp_neg = sim_exp * sel_neg
+    ident_sum = exp_pos.sum(axis=1)
+    all_sum = ident_sum + exp_neg.sum(axis=1)
+    loss = 0.0
+    for q in range(n):
+        if ident_sum[q] != 0 and all_sum[q] != 0:
+            loss += np.log(ident_sum[q] / all_sum[q])
+    loss = -loss / n
+
+    # Retrieval metric (GetRetrivePerformance, cu:173-206) on the exp'd matrix.
+    recalls = {}
+    for k in top_ks:
+        hits = 0
+        for q in range(n):
+            vals = [sim_exp[q, b] for b in range(ng) if b != rank * n + q]
+            vals.sort(reverse=True)
+            thr = vals[min(k, len(vals) - 1)]
+            for b in range(ng):
+                if b == rank * n + q:
+                    continue
+                if sim_exp[q, b] > thr and l[q] == total_l[b]:
+                    hits += 1
+                    break
+        recalls[k] = hits / n
+
+    asum = float(np.abs(f).sum() / n)
+    return RankResult(
+        loss=float(loss),
+        recalls=recalls,
+        feature_asum=asum,
+        sims=sims,
+        sim_exp=sim_exp,
+        same=same,
+        diff=diff,
+        select=select,
+        pos_thr=pos_thr,
+        neg_thr=neg_thr,
+        max_all=max_all,
+        exp_pos=exp_pos,
+        exp_neg=exp_neg,
+        ident_sum=ident_sum,
+        all_sum=all_sum,
+    )
+
+
+def backward(
+    features: Sequence[np.ndarray],
+    results: Sequence[RankResult],
+    loss_weight: float = 1.0,
+) -> List[np.ndarray]:
+    """Per-rank feature gradients with the reference's exact scaling.
+
+    (Backward_gpu, cu:420-499: dot_normalizer = N; MPI_Allreduce(SUM) of the
+    database-role gradient then 1/G; final 0.5/0.5 role averaging.)
+    """
+    g_ranks = len(features)
+    n = features[0].shape[0]
+    total_f = np.concatenate([f.astype(np.float32) for f in features], axis=0)
+
+    db_grads = []
+    query_grads = []
+    for res in results:
+        p1 = np.where(
+            res.ident_sum[:, None] != 0, res.exp_pos / np.where(res.ident_sum[:, None] != 0, res.ident_sum[:, None], 1.0), 0.0
+        )
+        p2 = np.where(
+            res.all_sum[:, None] != 0, res.exp_pos / np.where(res.all_sum[:, None] != 0, res.all_sum[:, None], 1.0), 0.0
+        )
+        p3 = np.where(
+            res.all_sum[:, None] != 0, res.exp_neg / np.where(res.all_sum[:, None] != 0, res.all_sum[:, None], 1.0), 0.0
+        )
+        w = (-p1 + p2 + p3) * (loss_weight / n)
+        query_grads.append(w @ total_f)
+        db_grads.append(w.T)  # multiplied with local features below
+
+    # Allreduce(SUM) of database-role grads then scale 1/G (cu:462-489).
+    db_total = np.zeros_like(total_f)
+    for rank in range(g_ranks):
+        db_total += db_grads[rank] @ features[rank].astype(np.float32)
+    db_total /= g_ranks
+
+    out = []
+    for rank in range(g_ranks):
+        local = db_total[rank * n : (rank + 1) * n]
+        final = 0.5 * local + 0.5 * query_grads[rank]  # cu:492-497
+        out.append(final.astype(np.float32))
+        results[rank].grad = out[-1]
+    return out

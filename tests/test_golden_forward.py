@@ -1,0 +1,173 @@
+"""Golden forward tests: JAX implementation vs the NumPy oracle.
+
+The oracle (npairloss_tpu.testing.oracle) is a loop-level transliteration of
+the reference semantics (npair_multi_class_loss.cu:207-402); these tests
+sweep the full (region x method) mining grid per SURVEY.md §4.
+"""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import make_identity_batch
+from npairloss_tpu import MiningMethod, MiningRegion, NPairLossConfig
+from npairloss_tpu.ops.npair_loss import npair_loss_with_aux
+from npairloss_tpu.testing import oracle
+
+REGIONS = [MiningRegion.GLOBAL, MiningRegion.LOCAL]
+METHODS = list(MiningMethod)
+AP_CELLS = list(itertools.product(REGIONS, METHODS))
+
+
+def _run_jax(feats, labs, cfg):
+    loss, aux = jax.jit(
+        lambda f, l: npair_loss_with_aux(f, l, cfg), static_argnums=()
+    )(feats, labs)
+    return float(loss), jax.tree_util.tree_map(np.asarray, aux)
+
+
+def _check_cell(rng, cfg, num_ids=4, imgs_per_id=3, dim=8):
+    feats, labs = make_identity_batch(rng, num_ids, imgs_per_id, dim)
+    want = oracle.forward(feats, labs, cfg)[0]
+    got_loss, aux = _run_jax(feats[0], labs[0], cfg)
+    np.testing.assert_allclose(aux["pos_threshold"], want.pos_thr, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(aux["neg_threshold"], want.neg_thr, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(aux["ident_num"], (want.same & want.select).sum(1))
+    np.testing.assert_allclose(aux["diff_num"], (want.diff & want.select).sum(1))
+    np.testing.assert_allclose(got_loss, want.loss, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(aux["sim_exp"], want.sim_exp, rtol=1e-5)
+
+
+@pytest.mark.parametrize("ap_region,ap_method", AP_CELLS)
+def test_ap_grid(rng, ap_region, ap_method):
+    """Every AP (region, method) cell against the oracle (cu:277-306)."""
+    cfg = NPairLossConfig(
+        margin_ident=0.02,
+        identsn=-0.4,
+        ap_mining_region=ap_region,
+        ap_mining_method=ap_method,
+        an_mining_region=MiningRegion.LOCAL,
+        an_mining_method=MiningMethod.RAND,
+    )
+    _check_cell(rng, cfg)
+
+
+@pytest.mark.parametrize("an_region,an_method", AP_CELLS)
+def test_an_grid(rng, an_region, an_method):
+    """Every AN (region, method) cell against the oracle (cu:307-337)."""
+    cfg = NPairLossConfig(
+        margin_diff=-0.05,
+        diffsn=-0.3,
+        an_mining_region=an_region,
+        an_mining_method=an_method,
+        ap_mining_region=MiningRegion.LOCAL,
+        ap_mining_method=MiningMethod.RAND,
+    )
+    _check_cell(rng, cfg)
+
+
+@pytest.mark.parametrize("identsn,diffsn", [(0.0, 0.0), (1.0, 2.0), (-0.0, -0.3),
+                                            (-0.5, -0.9), (3.0, 1.0)])
+def test_relative_sn_values(rng, identsn, diffsn):
+    """sn >= 0 rank-from-top vs sn < 0 top-fraction semantics (cu:285-287)."""
+    cfg = NPairLossConfig(
+        identsn=identsn,
+        diffsn=diffsn,
+        ap_mining_region=MiningRegion.LOCAL,
+        ap_mining_method=MiningMethod.RELATIVE_HARD,
+        an_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.RELATIVE_EASY,
+    )
+    _check_cell(rng, cfg, num_ids=5, imgs_per_id=3)
+
+
+def test_reference_def_prototxt_config(rng):
+    """The exact shipped mining config (usage/def.prototxt:137-146)."""
+    cfg = NPairLossConfig(
+        margin_ident=0.0,
+        margin_diff=-0.05,
+        identsn=-0.0,
+        diffsn=-0.3,
+        ap_mining_region=MiningRegion.GLOBAL,
+        ap_mining_method=MiningMethod.RELATIVE_HARD,
+        an_mining_region=MiningRegion.LOCAL,
+        an_mining_method=MiningMethod.HARD,
+    )
+    _check_cell(rng, cfg, num_ids=8, imgs_per_id=2, dim=16)
+
+
+def test_negative_threshold_clamps_to_flt_max(rng):
+    """Relative thresholds < 0 become -FLT_MAX (cu:288,303,319,334).
+
+    Antipodal within-class features make every within-class similarity -1,
+    so the AP relative lookup lands on a negative value and the clamp fires.
+    """
+    num_ids, dim = 4, 8
+    f = np.zeros((num_ids * 2, dim), dtype=np.float32)
+    lab = np.repeat(np.arange(num_ids), 2).astype(np.int32)
+    for i in range(num_ids):
+        f[2 * i, i] = 1.0
+        f[2 * i + 1, i] = -1.0
+    feats, labs = [f], [lab]
+    cfg = NPairLossConfig(
+        identsn=-0.5,
+        diffsn=-0.5,
+        ap_mining_region=MiningRegion.LOCAL,
+        ap_mining_method=MiningMethod.RELATIVE_EASY,
+        an_mining_region=MiningRegion.LOCAL,
+        an_mining_method=MiningMethod.RELATIVE_HARD,
+    )
+    want = oracle.forward(feats, labs, cfg)[0]
+    assert (want.pos_thr < -1e30).all(), "clamp should have fired"
+    got_loss, aux = _run_jax(feats[0], labs[0], cfg)
+    np.testing.assert_allclose(aux["pos_threshold"], want.pos_thr, rtol=1e-6)
+    np.testing.assert_allclose(aux["neg_threshold"], want.neg_thr, rtol=1e-6)
+    np.testing.assert_allclose(got_loss, want.loss, rtol=1e-5, atol=1e-7)
+
+
+def test_rand_selects_all(rng):
+    """RAND has no randomness — it selects every pair (cu:88-89, 109-110)."""
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    cfg = NPairLossConfig(
+        ap_mining_method=MiningMethod.RAND, an_mining_method=MiningMethod.RAND
+    )
+    want = oracle.forward(feats, labs, cfg)[0]
+    assert (want.select == (want.same | want.diff)).all()
+    _, aux = _run_jax(feats[0], labs[0], cfg)
+    np.testing.assert_allclose(aux["ident_num"], want.same.sum(1))
+    np.testing.assert_allclose(aux["diff_num"], want.diff.sum(1))
+
+
+def test_zero_count_queries_contribute_zero(rng):
+    """A query whose selection is empty adds exactly 0 loss (cu:162-169).
+
+    HARD positive mining with a hugely negative margin deselects every
+    positive; the loss must equal 0 (all queries invalid), not NaN.
+    """
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    cfg = NPairLossConfig(
+        margin_ident=-100.0,
+        ap_mining_method=MiningMethod.HARD,
+        an_mining_method=MiningMethod.RAND,
+    )
+    want = oracle.forward(feats, labs, cfg)[0]
+    got_loss, aux = _run_jax(feats[0], labs[0], cfg)
+    assert want.loss == 0.0
+    assert got_loss == 0.0
+    assert np.isfinite(got_loss)
+
+
+def test_self_pair_excluded(rng):
+    """The diagonal (self) pair is in neither mask (cu:54)."""
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    want = oracle.forward(feats, labs, NPairLossConfig())[0]
+    n = feats[0].shape[0]
+    for q in range(n):
+        assert not want.same[q, q] and not want.diff[q, q]
+    _, aux = _run_jax(feats[0], labs[0], NPairLossConfig())
+    # ident_num for query q excludes itself: == (#same-label items) - 1.
+    lab = labs[0]
+    expect = np.array([(lab == lab[q]).sum() - 1 for q in range(n)])
+    np.testing.assert_allclose(aux["ident_num"], expect)

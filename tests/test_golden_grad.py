@@ -1,0 +1,97 @@
+"""Golden gradient tests: custom_vjp vs the oracle's analytic backward.
+
+The reference backward (npair_multi_class_loss.cu:420-499) is NOT the plain
+autodiff gradient: it averages each sample's query-role and database-role
+gradients 0.5/0.5 and rescales the allreduced database side by 1/G.  These
+tests pin that exactly, plus the "true" autodiff mode's relationship to it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import make_identity_batch
+from npairloss_tpu import MiningMethod, MiningRegion, NPairLossConfig
+from npairloss_tpu.ops.npair_loss import npair_loss
+from npairloss_tpu.testing import oracle
+
+CFGS = [
+    NPairLossConfig(),  # proto defaults: LOCAL/RAND both sides
+    NPairLossConfig(  # shipped config, def.prototxt:137-146
+        margin_diff=-0.05,
+        identsn=-0.0,
+        diffsn=-0.3,
+        ap_mining_region=MiningRegion.GLOBAL,
+        ap_mining_method=MiningMethod.RELATIVE_HARD,
+        an_mining_region=MiningRegion.LOCAL,
+        an_mining_method=MiningMethod.HARD,
+    ),
+    NPairLossConfig(
+        margin_ident=0.1,
+        ap_mining_method=MiningMethod.EASY,
+        an_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.RELATIVE_EASY,
+        diffsn=2.0,
+    ),
+]
+
+
+@pytest.mark.parametrize("cfg_idx", range(len(CFGS)))
+def test_single_shard_grad_matches_oracle(rng, cfg_idx):
+    cfg = CFGS[cfg_idx]
+    feats, labs = make_identity_batch(rng, 5, 2, 12)
+    res = oracle.forward(feats, labs, cfg)
+    want = oracle.backward(feats, res, loss_weight=1.0)[0]
+    got = jax.jit(jax.grad(lambda f, l: npair_loss(f, l, cfg)))(feats[0], labs[0])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-7)
+
+
+def test_loss_weight_scaling(rng):
+    """Upstream cotangent (Caffe loss_weight, cu:435) scales linearly."""
+    cfg = CFGS[1]
+    feats, labs = make_identity_batch(rng, 5, 2, 12)
+    res = oracle.forward(feats, labs, cfg)
+    want = oracle.backward(feats, res, loss_weight=2.5)[0]
+    got = jax.grad(lambda f, l: 2.5 * npair_loss(f, l, cfg))(feats[0], labs[0])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-7)
+
+
+def test_true_grad_mode_is_exact_autodiff(rng):
+    """grad_mode="true" must equal finite differences of the loss."""
+    cfg = NPairLossConfig(grad_mode="true")
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    f64 = feats[0].astype(np.float64)
+
+    def loss_fn(f):
+        return npair_loss(jnp.asarray(f), jnp.asarray(labs[0]), cfg)
+
+    g = np.asarray(jax.grad(loss_fn)(feats[0]))
+    eps = 1e-3
+    for idx in [(0, 0), (1, 3), (3, 5)]:
+        fp = f64.copy()
+        fp[idx] += eps
+        fm = f64.copy()
+        fm[idx] -= eps
+        fd = (float(loss_fn(fp.astype(np.float32))) - float(loss_fn(fm.astype(np.float32)))) / (2 * eps)
+        np.testing.assert_allclose(g[idx], fd, rtol=5e-2, atol=1e-4)
+
+
+def test_reference_grad_is_half_true_grad_single_shard(rng):
+    """With G=1 the reference gradient is exactly 0.5x the true gradient
+    (0.5 * query-role + 0.5 * db-role vs their sum) — SURVEY.md §3.2."""
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    ref = jax.grad(lambda f, l: npair_loss(f, l, NPairLossConfig()))(
+        feats[0], labs[0]
+    )
+    true = jax.grad(
+        lambda f, l: npair_loss(f, l, NPairLossConfig(grad_mode="true"))
+    )(feats[0], labs[0])
+    np.testing.assert_allclose(np.asarray(ref) * 2.0, np.asarray(true), rtol=1e-5, atol=1e-7)
+
+
+def test_int_labels_grad_ok(rng):
+    """Integer labels must not break the custom_vjp (float0 tangent)."""
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    g = jax.grad(lambda f: npair_loss(f, jnp.asarray(labs[0], jnp.int32)))(feats[0])
+    assert np.isfinite(np.asarray(g)).all()

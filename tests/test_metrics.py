@@ -1,0 +1,67 @@
+"""Recall@k / feature_asum parity vs the oracle (cu:173-206, cu:390-401)."""
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import make_identity_batch
+from npairloss_tpu import NPairLossConfig
+from npairloss_tpu.ops.metrics import feature_asum, recall_at_k, retrieval_metrics
+from npairloss_tpu.ops.npair_loss import npair_loss_with_aux
+from npairloss_tpu.testing import oracle
+
+
+@pytest.mark.parametrize("k", [1, 5, 10])
+def test_recall_matches_oracle(rng, k):
+    feats, labs = make_identity_batch(rng, 8, 2, 16)
+    cfg = NPairLossConfig()
+    want = oracle.forward(feats, labs, cfg, top_ks=(k,))[0]
+    _, aux = jax.jit(lambda f, l: npair_loss_with_aux(f, l, cfg))(feats[0], labs[0])
+    got = recall_at_k(aux["sim_exp"], labs[0], aux["total_labels"], aux["rank"], k)
+    np.testing.assert_allclose(float(got), want.recalls[k], atol=1e-7)
+
+
+def test_recall_perfect_on_separable(rng):
+    """Tight clusters per identity -> Recall@1 == 1."""
+    num_ids, dim = 6, 16
+    centers = np.eye(num_ids, dim, dtype=np.float32)
+    f = np.repeat(centers, 2, axis=0) + 0.01 * rng.standard_normal((num_ids * 2, dim)).astype(np.float32)
+    f /= np.linalg.norm(f, axis=1, keepdims=True)
+    lab = np.repeat(np.arange(num_ids), 2).astype(np.int32)
+    _, aux = jax.jit(lambda a, b: npair_loss_with_aux(a, b))(f, lab)
+    got = recall_at_k(aux["sim_exp"], lab, aux["total_labels"], aux["rank"], 1)
+    assert float(got) == 1.0
+
+
+def test_threshold_tie_not_counted(rng):
+    """cu:197 uses a strict '>' — an item exactly at the threshold is a miss.
+
+    Craft: 3 items, query 0; with k=1 and list size 2, threshold index
+    min(1, 1) = 1 -> the SMALLER of the two non-self sims.  If the same-label
+    item ties the threshold (equal sims), it must not count.
+    """
+    f = np.array(
+        [[1.0, 0.0], [0.5, 0.5], [0.5, 0.5]], dtype=np.float32
+    )  # sims from q0 to items 1,2 are equal -> threshold == both values
+    lab = np.array([0, 0, 1], dtype=np.int32)
+    _, aux = jax.jit(lambda a, b: npair_loss_with_aux(a, b))(f, lab)
+    got = recall_at_k(aux["sim_exp"], lab, aux["total_labels"], aux["rank"], 1)
+    want = oracle.forward([f], [lab], NPairLossConfig(), top_ks=(1,))[0].recalls[1]
+    assert float(got) == want
+    # query 0's same-label item ties the threshold -> not retrieved
+    assert want < 1.0
+
+
+def test_feature_asum(rng):
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    want = oracle.forward(feats, labs, NPairLossConfig())[0].feature_asum
+    got = feature_asum(feats[0])
+    np.testing.assert_allclose(float(got), want, rtol=1e-6)
+
+
+def test_retrieval_metrics_names(rng):
+    """Top names mirror def.prototxt:127-131."""
+    feats, labs = make_identity_batch(rng, 4, 2, 8)
+    _, aux = npair_loss_with_aux(feats[0], labs[0])
+    m = retrieval_metrics(aux, labs[0], feats[0])
+    assert set(m) == {"retrieve_top1", "retrieve_top5", "retrieve_top10", "feature_asum"}
