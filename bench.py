@@ -1,0 +1,80 @@
+"""Benchmark: embeddings/sec/chip for the flagship training step.
+
+Measures the reference's headline workload (BASELINE.md): GoogLeNet
+embedding trunk + L2 normalize + mined N-pair loss (shipped def.prototxt
+mining config) + analytic backward + Caffe-SGD update + in-graph
+Recall@{1,5,10} metrics, batch 120 (60 ids x 2 imgs, def.prototxt:21-27),
+as ONE jitted graph on the current accelerator.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
+compares against a documented estimate of the Caffe+MPI original on its
+contemporary GPU: ~400 embeddings/sec/GPU (GoogLeNet fwd+bwd at ~75 ms per
+batch-32 on a Maxwell Titan X scaled to batch 120, plus the loss layer's
+per-step host mining loop and CPU-buffer MPI round trips). North-star
+target is >= 4x (BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_EMBEDDINGS_PER_SEC = 400.0
+BATCH = 120
+IMAGE = 224
+STEPS = 20
+WARMUP = 3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from npairloss_tpu import REFERENCE_CONFIG
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    solver = Solver(
+        get_model("googlenet", dtype=jnp.bfloat16),
+        REFERENCE_CONFIG,
+        SolverConfig(
+            base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
+            momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
+        ),
+        input_shape=(IMAGE, IMAGE, 3),
+    )
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((BATCH, IMAGE, IMAGE, 3)).astype(np.float32)
+    labels = np.repeat(np.arange(BATCH // 2), 2).astype(np.int32)
+
+    x = jax.device_put(jnp.asarray(images))
+    lab = jax.device_put(jnp.asarray(labels))
+
+    for _ in range(WARMUP):
+        m = solver.step(x, lab)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        m = solver.step(x, lab)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    emb_per_sec = BATCH * STEPS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "googlenet_npair_train_embeddings_per_sec_per_chip",
+                "value": round(emb_per_sec, 2),
+                "unit": "embeddings/sec/chip",
+                "vs_baseline": round(emb_per_sec / BASELINE_EMBEDDINGS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ in-training retrieval metrics, and L2 normalization.  Subpackages:
 """
 
 from npairloss_tpu.ops.npair_loss import (
+    REFERENCE_CONFIG,
     MiningMethod,
     MiningRegion,
     NPairLossConfig,
@@ -23,6 +24,7 @@ from npairloss_tpu.ops.normalize import l2_normalize
 __version__ = "0.1.0"
 
 __all__ = [
+    "REFERENCE_CONFIG",
     "MiningMethod",
     "MiningRegion",
     "NPairLossConfig",

@@ -1,0 +1,36 @@
+"""Embedding model zoo.
+
+The reference trains a GoogLeNet trunk truncated at pool5 with an
+L2-normalized embedding (usage/def.prototxt); BASELINE.json adds ResNet-50
+and ViT-B/16 configs.  ``get_model(name)`` is the registry the config
+front-end and trainer resolve through.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from npairloss_tpu.models.googlenet import GoogLeNetEmbedding
+from npairloss_tpu.models.mlp import MLPEmbedding
+from npairloss_tpu.models.resnet import ResNetEmbedding
+from npairloss_tpu.models.vit import ViTEmbedding
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {
+    "googlenet": GoogLeNetEmbedding,
+    "googlenet_embedding": GoogLeNetEmbedding,
+    "resnet50": lambda **kw: ResNetEmbedding(stage_sizes=(3, 4, 6, 3), **kw),
+    "resnet18": lambda **kw: ResNetEmbedding(stage_sizes=(2, 2, 2, 2), width=64, **kw),
+    "vit_b16": ViTEmbedding,
+    "mlp": MLPEmbedding,
+}
+
+
+def get_model(name: str, **kwargs):
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)
+
+
+def available_models():
+    return sorted(_REGISTRY)

@@ -1,0 +1,91 @@
+"""GoogLeNet (Inception v1) embedding backbone in Flax.
+
+The reference net (usage/def.prototxt:1, "GoogleNet") is the standard
+Inception-v1 trunk truncated at pool5/7x7_s1 — the 1024-d pooled feature is
+the embedding, L2-normalized before the loss (def.prototxt:115-126).  This
+is a fresh Flax NHWC implementation designed for the MXU (bf16 activations,
+conv+relu fused by XLA), not a translation of the prototxt layer list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from npairloss_tpu.models.layers import (
+    ConvBlock,
+    global_avg_pool,
+    local_response_norm,
+    max_pool,
+)
+from npairloss_tpu.ops.normalize import l2_normalize
+
+# Inception block channel plans: (1x1, 3x3red, 3x3, 5x5red, 5x5, pool_proj).
+_INCEPTION_PLAN = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+class Inception(nn.Module):
+    plan: Tuple[int, int, int, int, int, int]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        p1, p3r, p3, p5r, p5, pp = self.plan
+        b1 = ConvBlock(p1, (1, 1), dtype=self.dtype, name="b1x1")(x)
+        b3 = ConvBlock(p3r, (1, 1), dtype=self.dtype, name="b3x3_reduce")(x)
+        b3 = ConvBlock(p3, (3, 3), dtype=self.dtype, name="b3x3")(b3)
+        b5 = ConvBlock(p5r, (1, 1), dtype=self.dtype, name="b5x5_reduce")(x)
+        b5 = ConvBlock(p5, (5, 5), dtype=self.dtype, name="b5x5")(b5)
+        bp = max_pool(x, 3, 1, "SAME")
+        bp = ConvBlock(pp, (1, 1), dtype=self.dtype, name="pool_proj")(bp)
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+class GoogLeNetEmbedding(nn.Module):
+    """Inception-v1 trunk -> pool5 (1024-d) -> optional L2 normalize.
+
+    Input: NHWC images (224x224x3 canonical).  ``normalize=True`` matches
+    the reference's L2Normalize-before-loss topology.
+    """
+
+    dtype: Any = jnp.bfloat16
+    normalize: bool = True
+    use_lrn: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBlock(64, (7, 7), (2, 2), dtype=self.dtype, name="conv1")(x)
+        x = max_pool(x, 3, 2)
+        if self.use_lrn:
+            x = local_response_norm(x)
+        x = ConvBlock(64, (1, 1), dtype=self.dtype, name="conv2_reduce")(x)
+        x = ConvBlock(192, (3, 3), dtype=self.dtype, name="conv2")(x)
+        if self.use_lrn:
+            x = local_response_norm(x)
+        x = max_pool(x, 3, 2)
+        x = Inception(_INCEPTION_PLAN["3a"], self.dtype, name="inception_3a")(x)
+        x = Inception(_INCEPTION_PLAN["3b"], self.dtype, name="inception_3b")(x)
+        x = max_pool(x, 3, 2)
+        for key in ("4a", "4b", "4c", "4d", "4e"):
+            x = Inception(_INCEPTION_PLAN[key], self.dtype, name=f"inception_{key}")(x)
+        x = max_pool(x, 3, 2)
+        x = Inception(_INCEPTION_PLAN["5a"], self.dtype, name="inception_5a")(x)
+        x = Inception(_INCEPTION_PLAN["5b"], self.dtype, name="inception_5b")(x)
+        x = global_avg_pool(x)  # pool5/7x7_s1 -> (N, 1024)
+        x = x.astype(jnp.float32)
+        if self.normalize:
+            x = l2_normalize(x)
+        return x

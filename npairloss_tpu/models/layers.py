@@ -1,0 +1,66 @@
+"""Shared model building blocks (NHWC, bf16-friendly)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def local_response_norm(
+    x: jax.Array,
+    size: int = 5,
+    alpha: float = 1e-4,
+    beta: float = 0.75,
+    k: float = 1.0,
+) -> jax.Array:
+    """Across-channel LRN (the classic GoogLeNet/AlexNet normalization).
+
+    x: NHWC.  Matches Caffe LRN semantics: denominator
+    (k + alpha/size * sum_{window} x^2)^beta over a channel window.
+    """
+    xf = x.astype(jnp.float32)
+    sq = xf * xf
+    win = jax.lax.reduce_window(
+        sq,
+        0.0,
+        jax.lax.add,
+        window_dimensions=(1, 1, 1, size),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (0, 0), (0, 0), (size // 2, size - 1 - size // 2)),
+    )
+    out = xf / jnp.power(k + (alpha / size) * win, beta)
+    return out.astype(x.dtype)
+
+
+class ConvBlock(nn.Module):
+    """Conv + bias + ReLU, Caffe-style 'xavier' init (def.prototxt:98-110)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding=self.padding,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            bias_init=nn.initializers.constant(0.2),
+        )(x)
+        return nn.relu(x)
+
+
+def max_pool(x, window=3, stride=2, padding="SAME"):
+    return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))

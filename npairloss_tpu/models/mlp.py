@@ -1,0 +1,28 @@
+"""Small MLP embedding net — the integration-test / smoke model."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from npairloss_tpu.ops.normalize import l2_normalize
+
+
+class MLPEmbedding(nn.Module):
+    hidden: Sequence[int] = (128,)
+    embedding_dim: int = 64
+    dtype: Any = jnp.float32
+    normalize: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, dtype=self.dtype, name=f"dense{i}")(x))
+        x = nn.Dense(self.embedding_dim, dtype=self.dtype, name="head")(x)
+        x = x.astype(jnp.float32)
+        if self.normalize:
+            x = l2_normalize(x)
+        return x

@@ -1,0 +1,79 @@
+"""ResNet embedding backbones in Flax (BASELINE.json: ResNet-50 on SOP).
+
+Fresh NHWC implementation: bottleneck-v1 with BatchNorm, bf16 activations,
+fp32 norm statistics — the standard TPU recipe.  Embedding = global average
+pool of the final stage, optionally L2-normalized like the reference head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from npairloss_tpu.ops.normalize import l2_normalize
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+        conv = lambda f, k, s, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding="SAME", use_bias=False,
+            dtype=self.dtype, kernel_init=nn.initializers.he_normal(), name=name,
+        )
+        residual = x
+        y = nn.relu(norm("bn1")(conv(self.features, 1, 1, "conv1")(x)))
+        y = nn.relu(norm("bn2")(conv(self.features, 3, self.strides, "conv2")(y)))
+        y = norm("bn3")(conv(self.features * 4, 1, 1, "conv3")(y))
+        if residual.shape[-1] != y.shape[-1] or self.strides != 1:
+            residual = norm("bn_proj")(
+                conv(self.features * 4, 1, self.strides, "conv_proj")(residual)
+            )
+        return nn.relu(y + residual)
+
+
+class ResNetEmbedding(nn.Module):
+    """ResNet-v1 embedding net; ``stage_sizes=(3,4,6,3)`` is ResNet-50."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    normalize: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), strides=(2, 2), padding="SAME", use_bias=False,
+            dtype=self.dtype, kernel_init=nn.initializers.he_normal(), name="conv_stem",
+        )(x)
+        x = nn.relu(
+            nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, dtype=self.dtype,
+                param_dtype=jnp.float32, name="bn_stem",
+            )(x)
+        )
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(
+                    self.width * (2**stage), strides, self.dtype,
+                    name=f"stage{stage+1}_block{block+1}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        if self.normalize:
+            x = l2_normalize(x)
+        return x

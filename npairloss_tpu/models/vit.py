@@ -1,0 +1,90 @@
+"""ViT embedding backbone (BASELINE.json stretch config: ViT-B/16, 32k-batch
+N-pair contrastive — the CLIP-style negative pool over ICI).
+
+Fresh Flax implementation: patchify-as-conv (MXU-friendly), pre-LN
+transformer blocks, bf16 activations / fp32 layernorm, CLS-token embedding,
+optionally L2-normalized.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from npairloss_tpu.ops.normalize import l2_normalize
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        return nn.Dense(d, dtype=self.dtype)(x)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        y = ln("ln1")(x).astype(self.dtype)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype, name="attn"
+        )(y, y)
+        x = x + y
+        y = ln("ln2")(x).astype(self.dtype)
+        return x + MlpBlock(self.mlp_dim, self.dtype, name="mlp")(y)
+
+
+class ViTEmbedding(nn.Module):
+    """ViT trunk -> CLS embedding.  Defaults are ViT-B/16."""
+
+    patch: int = 16
+    hidden: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    normalize: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        n = x.shape[0]
+        x = nn.Conv(
+            self.hidden,
+            (self.patch, self.patch),
+            strides=(self.patch, self.patch),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patchify",
+        )(x.astype(self.dtype))
+        x = x.reshape(n, -1, self.hidden)
+        cls = self.param(
+            "cls", nn.initializers.zeros, (1, 1, self.hidden), jnp.float32
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (n, 1, self.hidden)).astype(self.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], self.hidden),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                self.num_heads, self.mlp_dim, self.dtype, name=f"block{i}"
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        emb = x[:, 0].astype(jnp.float32)
+        if self.normalize:
+            emb = l2_normalize(emb)
+        return emb

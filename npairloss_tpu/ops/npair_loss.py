@@ -98,6 +98,22 @@ class NPairLossConfig:
             )
 
 
+# The exact mining configuration the reference ships (usage/def.prototxt:
+# 137-146): all positives at-or-below the block-wide top similarity (i.e.
+# every positive), negatives harder than the per-query hardest positive
+# minus 0.05.
+REFERENCE_CONFIG = NPairLossConfig(
+    margin_ident=0.0,
+    margin_diff=-0.05,
+    identsn=-0.0,
+    diffsn=-0.3,
+    ap_mining_region=MiningRegion.GLOBAL,
+    ap_mining_method=MiningMethod.RELATIVE_HARD,
+    an_mining_region=MiningRegion.LOCAL,
+    an_mining_method=MiningMethod.HARD,
+)
+
+
 # ---------------------------------------------------------------------------
 # Mask construction (reference: GetLabelDiffMtx kernel, cu:44-66)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,2 @@
+from npairloss_tpu.train.optim import caffe_sgd, lr_schedule
+from npairloss_tpu.train.solver import Solver, SolverConfig

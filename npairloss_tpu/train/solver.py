@@ -1,0 +1,349 @@
+"""The solver loop — the TPU-native counterpart of the Caffe Solver contract.
+
+Reproduces the behavior implied by usage/solver.prototxt (SURVEY.md C21):
+step-decayed momentum SGD, ``display``/``average_loss`` sliding-window
+monitoring, a TEST phase every ``test_interval`` iterations over
+``test_iter`` batches (the reference has no separate eval path — the same
+loss+metrics forward runs on eval batches, SURVEY.md §3.4), and
+``snapshot``/``snapshot_prefix`` checkpoints (Orbax, async-capable, instead
+of Caffe's .caffemodel writes).
+
+The whole training step — model forward, loss with all_gather negative
+pooling, backward, optimizer update, in-graph metrics — is ONE jitted
+function; multi-chip runs shard the batch over a 1-D ``dp`` mesh with
+parameters replicated, collectives compiled into the step by XLA.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from npairloss_tpu.ops.metrics import retrieval_metrics
+from npairloss_tpu.ops.npair_loss import NPairLossConfig, npair_loss_with_aux
+from npairloss_tpu.train.optim import caffe_sgd, lr_schedule
+
+log = logging.getLogger("npairloss_tpu.solver")
+
+
+@dataclasses.dataclass
+class SolverConfig:
+    """Mirror of the SolverParameter subset the reference uses
+    (usage/solver.prototxt:1-17); defaults are the shipped values."""
+
+    base_lr: float = 0.001
+    lr_policy: str = "step"
+    gamma: float = 0.5
+    stepsize: int = 10000
+    power: float = 1.0
+    stepvalues: Sequence[int] = ()
+    momentum: float = 0.9
+    weight_decay: float = 0.00002
+    max_iter: int = 2000000
+    display: int = 100
+    average_loss: int = 100
+    test_iter: int = 2000
+    test_interval: int = 2000
+    test_initialization: bool = True
+    snapshot: int = 5000
+    snapshot_prefix: str = "./snap/model_"
+    random_seed: int = 0
+
+
+class Solver:
+    """Train an embedding model with the N-pair loss.
+
+    Args:
+      model: a Flax module mapping (images, train=...) -> [N, D] embeddings.
+      loss_cfg: mining/margin configuration.
+      cfg: solver hyperparameters.
+      train_iter/test_iter_fn: iterators yielding (inputs, labels) numpy
+        batches (identity-balanced per the MultibatchData contract).
+      mesh: optional 1-D device mesh; when given, batches are sharded over
+        its axis and the loss pools negatives across all shards.
+      top_ks: Recall@k list emitted every step (def.prototxt tops).
+    """
+
+    def __init__(
+        self,
+        model,
+        loss_cfg: NPairLossConfig = NPairLossConfig(),
+        cfg: SolverConfig = SolverConfig(),
+        mesh: Optional[Mesh] = None,
+        axis: str = "dp",
+        top_ks: Sequence[int] = (1, 5, 10),
+        input_shape: Sequence[int] = (224, 224, 3),
+    ):
+        self.model = model
+        self.loss_cfg = loss_cfg
+        self.mesh = mesh
+        self.axis = axis
+        self.top_ks = tuple(top_ks)
+        self.input_shape = tuple(input_shape)
+        self.state: Optional[Dict[str, Any]] = None
+        self._step_fn = None
+        self._eval_fn = None
+        self._checkpointer = None
+        self.cfg = cfg  # property: derives schedule/optimizer/window
+
+    # -- config (schedule/optimizer/window are derived; keep them in sync) --
+
+    @property
+    def cfg(self) -> SolverConfig:
+        return self._cfg
+
+    @cfg.setter
+    def cfg(self, cfg: SolverConfig):
+        self._cfg = cfg
+        self.rate_fn = lr_schedule(
+            cfg.lr_policy, cfg.base_lr, cfg.gamma, cfg.stepsize, cfg.power,
+            cfg.max_iter, cfg.stepvalues,
+        )
+        self.tx = caffe_sgd(self.rate_fn, cfg.momentum, cfg.weight_decay)
+        self._loss_window: collections.deque = collections.deque(
+            maxlen=max(cfg.average_loss, 1)
+        )
+        self._step_fn = None  # recompile with the new schedule
+        self._eval_fn = None
+
+    # -- state ------------------------------------------------------------
+
+    def init(self, example_input: Optional[np.ndarray] = None):
+        if example_input is None:
+            example_input = np.zeros((2, *self.input_shape), np.float32)
+        variables = self.model.init(
+            jax.random.PRNGKey(self.cfg.random_seed),
+            jnp.asarray(example_input),
+            train=False,
+        )
+        params = variables["params"]
+        self.state = {
+            "params": params,
+            "batch_stats": variables.get("batch_stats", {}),
+            "opt": self.tx.init(params),
+        }
+        if self.mesh is not None:
+            replicated = NamedSharding(self.mesh, P())
+            self.state = jax.device_put(self.state, replicated)
+        return self.state
+
+    # -- compiled step ----------------------------------------------------
+
+    def _loss_and_metrics(self, emb, labels):
+        axis = self.axis if self.mesh is not None else None
+        loss, aux = npair_loss_with_aux(emb, labels, self.loss_cfg, axis_name=axis)
+        metrics = retrieval_metrics(
+            jax.lax.stop_gradient(aux), labels, jax.lax.stop_gradient(emb),
+            self.top_ks,
+        )
+        return loss, metrics
+
+    def _sharded_loss(self, emb, labels):
+        """Per-shard loss under shard_map; scalars come back stacked (G,)."""
+
+        def per_shard(e, l):
+            loss, metrics = self._loss_and_metrics(e, l)
+            out = {"loss": loss, **metrics}
+            return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], out)
+
+        stacked = jax.shard_map(
+            per_shard,
+            mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis)),
+            out_specs=P(self.axis),
+        )(emb, labels)
+        loss = stacked["loss"].mean()
+        metrics = {k: v.mean() for k, v in stacked.items() if k != "loss"}
+        return loss, metrics
+
+    def _make_step(self):
+        def train_step(state, inputs, labels):
+            def loss_fn(params):
+                variables = {"params": params}
+                if state["batch_stats"]:
+                    variables["batch_stats"] = state["batch_stats"]
+                    emb, updates = self.model.apply(
+                        variables, inputs, train=True, mutable=["batch_stats"]
+                    )
+                else:
+                    emb = self.model.apply(variables, inputs, train=True)
+                    updates = {}
+                if self.mesh is not None:
+                    loss, metrics = self._sharded_loss(labels=labels, emb=emb)
+                else:
+                    loss, metrics = self._loss_and_metrics(emb, labels)
+                return loss, (metrics, updates)
+
+            (loss, (metrics, updates)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state["params"])
+            # The lr reported and the lr applied both read the optimizer's
+            # own step counter — a single source of truth.
+            metrics["lr"] = self.rate_fn(state["opt"].step)
+            upd, opt = self.tx.update(grads, state["opt"], state["params"])
+            params = jax.tree_util.tree_map(
+                lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+                state["params"],
+                upd,
+            )
+            new_state = {
+                "params": params,
+                "batch_stats": updates.get("batch_stats", state["batch_stats"]),
+                "opt": opt,
+            }
+            metrics["loss"] = loss
+            return new_state, metrics
+
+        def eval_step(state, inputs, labels):
+            variables = {"params": state["params"]}
+            if state["batch_stats"]:
+                variables["batch_stats"] = state["batch_stats"]
+            emb = self.model.apply(variables, inputs, train=False)
+            if self.mesh is not None:
+                loss, metrics = self._sharded_loss(emb, labels)
+            else:
+                loss, metrics = self._loss_and_metrics(emb, labels)
+            metrics["loss"] = loss
+            return metrics
+
+        donate = (0,)
+        if self.mesh is not None:
+            data_sharding = NamedSharding(self.mesh, P(self.axis))
+            replicated = NamedSharding(self.mesh, P())
+            self._step_fn = jax.jit(
+                train_step,
+                donate_argnums=donate,
+                in_shardings=(None, data_sharding, data_sharding),
+            )
+            self._eval_fn = jax.jit(
+                eval_step, in_shardings=(None, data_sharding, data_sharding)
+            )
+        else:
+            self._step_fn = jax.jit(train_step, donate_argnums=donate)
+            self._eval_fn = jax.jit(eval_step)
+
+    # -- public API -------------------------------------------------------
+
+    def step(self, inputs: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+        """One training iteration; returns the step's metric dict."""
+        if self.state is None:
+            self.init(inputs)
+        if self._step_fn is None:
+            self._make_step()
+        self.state, metrics = self._step_fn(
+            self.state, jnp.asarray(inputs), jnp.asarray(labels)
+        )
+        return metrics
+
+    def evaluate(
+        self, batches: Iterator[Tuple[np.ndarray, np.ndarray]], num_iters: int
+    ) -> Dict[str, float]:
+        """TEST phase: average loss+metrics over ``num_iters`` batches."""
+        acc: Dict[str, float] = collections.defaultdict(float)
+        n = 0
+        for _ in range(num_iters):
+            inputs, labels = next(batches)
+            if self.state is None:
+                self.init(inputs)
+            if self._eval_fn is None:
+                self._make_step()
+            m = self._eval_fn(self.state, jnp.asarray(inputs), jnp.asarray(labels))
+            for k, v in m.items():
+                acc[k] += float(v)
+            n += 1
+        return {k: v / max(n, 1) for k, v in acc.items()}
+
+    def train(
+        self,
+        train_batches: Iterator[Tuple[np.ndarray, np.ndarray]],
+        num_iters: Optional[int] = None,
+        test_batches: Optional[Iterator[Tuple[np.ndarray, np.ndarray]]] = None,
+        log_fn: Callable[[str], None] = log.info,
+    ) -> Dict[str, float]:
+        """The Caffe Solver::Solve loop: train/display/test/snapshot cadence."""
+        cfg = self.cfg
+        num_iters = num_iters if num_iters is not None else cfg.max_iter
+        if (
+            cfg.test_initialization
+            and test_batches is not None
+            and cfg.test_iter > 0
+        ):
+            m = self.evaluate(test_batches, cfg.test_iter)
+            log_fn(f"iter 0 TEST {_fmt(m)}")
+        last = {}
+        for it in range(num_iters):
+            inputs, labels = next(train_batches)
+            # Keep metrics as device scalars so the loop never blocks on a
+            # host sync; floats are materialized only at display/test/return
+            # boundaries (JAX async dispatch keeps the TPU pipeline full).
+            metrics = self.step(inputs, labels)
+            self._loss_window.append(metrics["loss"])
+            last = metrics
+            step_num = int(it) + 1
+            if cfg.display and step_num % cfg.display == 0:
+                host = {k: float(v) for k, v in last.items()}
+                avg = float(sum(jnp.stack(list(self._loss_window)))) / len(
+                    self._loss_window
+                )
+                log_fn(
+                    f"iter {step_num} lr={host.get('lr', 0):.6g} "
+                    f"loss={avg:.6g} (avg over {len(self._loss_window)}) "
+                    + _fmt({k: v for k, v in host.items() if k not in ('loss', 'lr')})
+                )
+            if (
+                test_batches is not None
+                and cfg.test_interval
+                and step_num % cfg.test_interval == 0
+            ):
+                m = self.evaluate(test_batches, cfg.test_iter)
+                log_fn(f"iter {step_num} TEST {_fmt(m)}")
+            if cfg.snapshot and step_num % cfg.snapshot == 0:
+                self.save_snapshot(step_num)
+        if self._checkpointer is not None:
+            # Async Orbax saves must land before the process can exit, or the
+            # final snapshot is left as an .orbax-checkpoint-tmp dir.
+            self._checkpointer.wait_until_finished()
+        return {k: float(v) for k, v in last.items()}
+
+    # -- checkpointing (Orbax; Caffe snapshot contract) --------------------
+
+    def _ckpt(self):
+        if self._checkpointer is None:
+            import orbax.checkpoint as ocp
+
+            self._checkpointer = ocp.StandardCheckpointer()
+        return self._checkpointer
+
+    def snapshot_path(self, step: int) -> str:
+        import os
+
+        prefix = self.cfg.snapshot_prefix
+        parent = os.path.dirname(os.path.abspath(prefix))
+        os.makedirs(parent, exist_ok=True)
+        return os.path.abspath(f"{prefix}iter_{step}.ckpt")
+
+    def save_snapshot(self, step: int) -> str:
+        path = self.snapshot_path(step)
+        self._ckpt().save(path, self.state, force=True)
+        log.info("snapshot -> %s", path)
+        return path
+
+    def restore_snapshot(self, path: str):
+        if self.state is None:
+            self.init()
+        self._ckpt().wait_until_finished()
+        self.state = self._ckpt().restore(path, self.state)
+        return self.state
+
+
+def _fmt(metrics: Dict[str, float]) -> str:
+    return " ".join(f"{k}={float(v):.4g}" for k, v in sorted(metrics.items()))

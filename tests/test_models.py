@@ -1,0 +1,56 @@
+"""Model zoo shape/norm tests (small spatial sizes for CPU speed)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from npairloss_tpu.models import available_models, get_model
+
+
+def _init_and_run(model, x, train=False):
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    if "batch_stats" in variables:
+        out, _ = model.apply(
+            variables, x, train=train, mutable=["batch_stats"] if train else []
+        )
+    else:
+        out = model.apply(variables, x, train=train)
+    return out
+
+
+def test_registry_lists_reference_models():
+    names = available_models()
+    for required in ("googlenet", "resnet50", "vit_b16", "mlp"):
+        assert required in names
+
+
+def test_googlenet_embedding_shape_and_norm():
+    m = get_model("googlenet", dtype=jnp.float32)
+    x = jnp.ones((2, 64, 64, 3), jnp.float32)
+    out = _init_and_run(m, x)
+    assert out.shape == (2, 1024)  # pool5/7x7_s1 width, def.prototxt:116
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+
+def test_resnet50_embedding_shape():
+    m = get_model("resnet50", dtype=jnp.float32)
+    x = jnp.ones((2, 64, 64, 3), jnp.float32)
+    out = _init_and_run(m, x, train=True)
+    assert out.shape == (2, 2048)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+
+def test_vit_embedding_shape():
+    m = get_model("vit_b16", depth=2, hidden=64, num_heads=4, mlp_dim=128, dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    out = _init_and_run(m, x)
+    assert out.shape == (2, 64)
+
+
+def test_mlp_embedding_unnormalized_option():
+    m = get_model("mlp", normalize=False, embedding_dim=8)
+    x = jnp.ones((4, 16), jnp.float32)
+    out = _init_and_run(m, x)
+    assert out.shape == (4, 8)
+    assert not np.allclose(np.linalg.norm(out, axis=1), 1.0)

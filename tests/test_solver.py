@@ -1,0 +1,137 @@
+"""Solver-loop tests: Caffe SGD semantics, lr policies, end-to-end training
+(the SURVEY.md §4 integration tier), snapshots, and the sharded step."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from npairloss_tpu import MiningMethod, MiningRegion, NPairLossConfig
+from npairloss_tpu.data import synthetic_identity_batches
+from npairloss_tpu.models import get_model
+from npairloss_tpu.parallel import data_parallel_mesh
+from npairloss_tpu.train import Solver, SolverConfig, caffe_sgd, lr_schedule
+
+
+def test_lr_policies():
+    # step: base * gamma^floor(it/stepsize)  (solver.prototxt:8-10 semantics)
+    f = lr_schedule("step", 0.001, gamma=0.5, stepsize=10)
+    assert float(f(0)) == pytest.approx(0.001)
+    assert float(f(9)) == pytest.approx(0.001)
+    assert float(f(10)) == pytest.approx(0.0005)
+    assert float(f(25)) == pytest.approx(0.00025)
+    f = lr_schedule("fixed", 0.1)
+    assert float(f(12345)) == pytest.approx(0.1)
+    f = lr_schedule("poly", 1.0, power=2.0, max_iter=100)
+    assert float(f(50)) == pytest.approx(0.25)
+    f = lr_schedule("multistep", 1.0, gamma=0.1, stepvalues=(5, 8))
+    assert float(f(4)) == pytest.approx(1.0)
+    assert float(f(5)) == pytest.approx(0.1)
+    assert float(f(8)) == pytest.approx(0.01)
+    f = lr_schedule("inv", 1.0, gamma=0.5, power=1.0)
+    assert float(f(2)) == pytest.approx(0.5)
+
+
+def test_caffe_sgd_lr_inside_momentum():
+    """v = mu*v + lr*(g + wd*w); w -= v — lr folded BEFORE momentum, so a
+    lr drop mid-run decays the buffer differently from optax.sgd."""
+    lr0, lr1, mu, wd = 0.1, 0.05, 0.9, 0.01
+    rates = [lr0, lr1]
+    tx = caffe_sgd(lambda s: jnp.float32(rates[int(s)] if int(s) < 2 else lr1), mu, wd)
+    w = jnp.asarray([1.0])
+    g = jnp.asarray([2.0])
+    state = tx.init(w)
+    upd, state = tx.update(g, state, w)
+    v1 = lr0 * (2.0 + wd * 1.0)
+    np.testing.assert_allclose(np.asarray(upd), [-v1], rtol=1e-6)
+    w = w + upd[0]
+    upd, state = tx.update(g, state, w)
+    v2 = mu * v1 + lr1 * (2.0 + wd * float(w[0]))
+    np.testing.assert_allclose(np.asarray(upd), [-v2], rtol=1e-6)
+
+
+def _make_solver(mesh=None, ids_per_batch=16):
+    cfg = SolverConfig(
+        base_lr=0.5, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
+        display=0, test_interval=0, snapshot=0, average_loss=10,
+    )
+    loss_cfg = NPairLossConfig(
+        margin_diff=-0.05,
+        an_mining_method=MiningMethod.HARD,
+        ap_mining_method=MiningMethod.RAND,
+    )
+    model = get_model("mlp", hidden=(64,), embedding_dim=32)
+    return Solver(
+        model, loss_cfg, cfg, mesh=mesh, input_shape=(16,),
+    ), synthetic_identity_batches(ids_per_batch, ids_per_batch, 2, (16,), noise=0.6)
+
+
+def test_training_learns_single_device():
+    solver, batches = _make_solver()
+    first = None
+    for i in range(150):
+        x, lab = next(batches)
+        m = solver.step(x, lab)
+        if first is None:
+            first = float(m["retrieve_top1"])
+    final = float(m["retrieve_top1"])
+    assert final > 0.9, f"recall@1 {first} -> {final}"
+    assert float(m["loss"]) < 0.5
+
+
+def test_train_loop_with_eval_and_window(caplog):
+    solver, batches = _make_solver()
+    test_cfg = SolverConfig(
+        base_lr=0.5, lr_policy="fixed", display=5, average_loss=5,
+        test_interval=10, test_iter=2, test_initialization=True, snapshot=0,
+    )
+    solver.cfg = test_cfg
+    logs = []
+    last = solver.train(batches, num_iters=20, test_batches=batches, log_fn=logs.append)
+    assert any("TEST" in line for line in logs)
+    assert any("iter 5 " in line for line in logs)
+    assert "retrieve_top1" in last
+
+
+def test_snapshot_roundtrip(tmp_path):
+    solver, batches = _make_solver()
+    solver.cfg.snapshot_prefix = str(tmp_path / "snap_")
+    x, lab = next(batches)
+    solver.step(x, lab)
+    path = solver.save_snapshot(1)
+    before = jax.tree_util.tree_map(np.asarray, solver.state["params"])
+    for _ in range(5):
+        x, lab = next(batches)
+        solver.step(x, lab)
+    solver.restore_snapshot(path)
+    after = jax.tree_util.tree_map(np.asarray, solver.state["params"])
+    jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+    # training continues from the restored state
+    x, lab = next(batches)
+    m = solver.step(x, lab)
+    assert np.isfinite(m["loss"])
+
+
+def test_training_learns_sharded_mesh():
+    """Full solver step over the virtual 8-device mesh: sharded batch,
+    all_gather negative pool, replicated params."""
+    mesh = data_parallel_mesh(jax.devices()[:8])
+    solver, batches = _make_solver(mesh=mesh)
+    for i in range(100):
+        x, lab = next(batches)
+        m = solver.step(x, lab)
+    assert float(m["retrieve_top1"]) > 0.85
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_batchnorm_model_trains():
+    """Mutable batch_stats path (resnet18 on tiny inputs)."""
+    cfg = SolverConfig(base_lr=0.01, lr_policy="fixed", display=0, snapshot=0)
+    model = get_model("resnet18", dtype=jnp.float32)
+    solver = Solver(model, NPairLossConfig(), cfg, input_shape=(16, 16, 3))
+    batches = synthetic_identity_batches(4, 4, 2, (16, 16, 3), noise=0.3)
+    for _ in range(2):
+        x, lab = next(batches)
+        m = solver.step(x, lab)
+    assert np.isfinite(float(m["loss"]))
+    assert solver.state["batch_stats"], "batch_stats should be tracked"
