@@ -1,0 +1,3 @@
+from npairloss_tpu.cli import main
+
+raise SystemExit(main())

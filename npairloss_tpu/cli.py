@@ -1,0 +1,202 @@
+"""Command-line driver — the ``caffe train --solver=...`` counterpart.
+
+The reference is launched as ``caffe train --solver=usage/solver.prototxt``
+(SURVEY.md §3.1) under mpirun.  Here the same entrypoint is
+
+    python -m npairloss_tpu train --solver usage/solver.prototxt
+
+which parses the solver + net prototxts through the config front-end,
+builds the embedding model and identity-balanced data iterators, and runs
+the Solver loop on whatever accelerator JAX sees — multi-chip via
+``--mesh`` (all devices by default) with the negative pool all-gathered
+across the mesh in-graph.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Optional
+
+log = logging.getLogger("npairloss_tpu.cli")
+
+
+def _build_data(net_cfg, phase: str, input_shape, seed: int = 0):
+    """Batches for a phase: real MultibatchData pipeline when the source
+    list file exists, synthetic identity-balanced clusters otherwise."""
+    d = net_cfg.data.get(phase)
+    if d is None:
+        return None, None
+    if d.source and os.path.exists(d.source):
+        try:
+            from npairloss_tpu.data import multibatch_loader
+
+            return multibatch_loader(d, net_cfg.transformer, seed=seed), d
+        except ImportError:
+            log.warning(
+                "real-data loader unavailable; falling back to synthetic"
+            )
+    from npairloss_tpu.data import synthetic_identity_batches
+
+    ids = d.identity_num_per_batch or max(2, (d.batch_size or 8) // 2)
+    imgs = d.img_num_per_identity or 2
+    return (
+        synthetic_identity_batches(
+            max(ids * 4, ids), ids, imgs, input_shape, seed=seed
+        ),
+        d,
+    )
+
+
+def cmd_train(args) -> int:
+    import jax
+
+    from npairloss_tpu.config import load_net, load_solver
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.parallel import data_parallel_mesh
+    from npairloss_tpu.train import Solver
+
+    solver_cfg, net_path = load_solver(args.solver)
+    if args.net:
+        net_path = args.net
+    elif net_path and not os.path.isabs(net_path):
+        # Caffe resolves the net path relative to the CWD; fall back to
+        # solver-relative when that misses (the shipped solver points at
+        # a machine-specific ./conf_same_veri/ path).
+        if not os.path.exists(net_path):
+            cand = os.path.join(os.path.dirname(args.solver), net_path)
+            net_path = cand if os.path.exists(cand) else net_path
+    if not net_path or not os.path.exists(net_path):
+        log.error("net prototxt not found (tried %r); pass --net", net_path)
+        return 2
+    net_cfg = load_net(net_path)
+
+    if args.max_iter is not None:
+        import dataclasses
+
+        solver_cfg = dataclasses.replace(solver_cfg, max_iter=args.max_iter)
+    if args.snapshot_prefix:
+        import dataclasses
+
+        solver_cfg = dataclasses.replace(
+            solver_cfg, snapshot_prefix=args.snapshot_prefix
+        )
+
+    crop = 0
+    train_data = net_cfg.data.get("TRAIN")
+    if train_data is not None:
+        crop = train_data.transform.crop_size
+    side = crop or 224
+    input_shape = (side, side, 3)
+
+    loss_cfg = net_cfg.loss.loss if net_cfg.loss else None
+    if loss_cfg is None:
+        from npairloss_tpu.ops.npair_loss import NPairLossConfig
+
+        loss_cfg = NPairLossConfig()
+
+    mesh = None
+    n_dev = len(jax.devices())
+    want = args.mesh if args.mesh is not None else (n_dev if n_dev > 1 else 1)
+    if want > 1:
+        mesh = data_parallel_mesh(jax.devices()[:want])
+
+    model_name = args.model or _model_for_net(net_cfg)
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = get_model(model_name, dtype=dtype)
+
+    solver = Solver(
+        model, loss_cfg, solver_cfg, mesh=mesh, input_shape=input_shape
+    )
+    if args.resume:
+        solver.restore_snapshot(args.resume)
+
+    train_iter, _ = _build_data(net_cfg, "TRAIN", input_shape, seed=0)
+    test_iter, _ = _build_data(net_cfg, "TEST", input_shape, seed=1)
+    if train_iter is None:
+        log.error("net %s has no TRAIN MultibatchData layer", net_path)
+        return 2
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    final = solver.train(
+        train_iter,
+        num_iters=args.max_iter,
+        test_batches=test_iter,
+        log_fn=lambda s: print(s, flush=True),
+    )
+    print(json.dumps({k: float(v) for k, v in final.items()}))
+    return 0
+
+
+def _model_for_net(net_cfg) -> str:
+    name = (net_cfg.name or "").lower().replace(" ", "")
+    if "resnet" in name:
+        return "resnet50"
+    if "vit" in name:
+        return "vit_b16"
+    if "mlp" in name:
+        return "mlp"
+    return "googlenet"  # the reference's flagship trunk (def.prototxt:1)
+
+
+def cmd_parse(args) -> int:
+    from npairloss_tpu.config import dumps, parse_file
+
+    msg = parse_file(args.file)
+    if args.json:
+        print(json.dumps(msg.to_dict(), indent=2, default=str))
+    else:
+        print(dumps(msg))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import importlib.util
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(repo_root, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.main()
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="npairloss_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train from a solver prototxt")
+    t.add_argument("--solver", required=True)
+    t.add_argument("--net", help="override the solver's net path")
+    t.add_argument("--model", help="model registry name (default: from net)")
+    t.add_argument("--max_iter", type=int, help="override solver max_iter")
+    t.add_argument("--mesh", type=int, help="devices in the dp mesh")
+    t.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
+    t.add_argument("--resume", help="snapshot path to restore")
+    t.add_argument("--snapshot_prefix", help="override snapshot prefix")
+    t.set_defaults(fn=cmd_train)
+
+    pp = sub.add_parser("parse", help="parse + dump a prototxt file")
+    pp.add_argument("file")
+    pp.add_argument("--json", action="store_true")
+    pp.set_defaults(fn=cmd_parse)
+
+    b = sub.add_parser("bench", help="run the benchmark")
+    b.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,41 @@
+"""Prototxt-compatible configuration front-end (SURVEY.md §5.6, §7.4)."""
+
+from npairloss_tpu.config.prototxt import (
+    Message,
+    PrototxtParseError,
+    dumps,
+    parse,
+    parse_file,
+)
+from npairloss_tpu.config.schema import (
+    DataLayerConfig,
+    LossLayerConfig,
+    NetConfig,
+    TransformParam,
+    TransformerConfig,
+    load_net,
+    load_solver,
+    net_from_message,
+    net_from_text,
+    npair_param_to_config,
+    solver_from_message,
+)
+
+__all__ = [
+    "Message",
+    "PrototxtParseError",
+    "dumps",
+    "parse",
+    "parse_file",
+    "DataLayerConfig",
+    "LossLayerConfig",
+    "NetConfig",
+    "TransformParam",
+    "TransformerConfig",
+    "load_net",
+    "load_solver",
+    "net_from_message",
+    "net_from_text",
+    "npair_param_to_config",
+    "solver_from_message",
+]

@@ -19,6 +19,7 @@ class MLPEmbedding(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
+        x = x.reshape(x.shape[0], -1)
         for i, h in enumerate(self.hidden):
             x = nn.relu(nn.Dense(h, dtype=self.dtype, name=f"dense{i}")(x))
         x = nn.Dense(self.embedding_dim, dtype=self.dtype, name="head")(x)

@@ -77,7 +77,7 @@ class Solver:
         self,
         model,
         loss_cfg: NPairLossConfig = NPairLossConfig(),
-        cfg: SolverConfig = SolverConfig(),
+        cfg: Optional[SolverConfig] = None,
         mesh: Optional[Mesh] = None,
         axis: str = "dp",
         top_ks: Sequence[int] = (1, 5, 10),
@@ -93,7 +93,9 @@ class Solver:
         self._step_fn = None
         self._eval_fn = None
         self._checkpointer = None
-        self.cfg = cfg  # property: derives schedule/optimizer/window
+        # A fresh config per solver: SolverConfig is mutable, so a shared
+        # default instance would leak cfg edits across solvers.
+        self.cfg = cfg if cfg is not None else SolverConfig()
 
     # -- config (schedule/optimizer/window are derived; keep them in sync) --
 
@@ -236,7 +238,9 @@ class Solver:
     def step(self, inputs: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
         """One training iteration; returns the step's metric dict."""
         if self.state is None:
-            self.init(inputs)
+            # Shape-only init: two examples suffice (and avoid an eager,
+            # unsharded full-batch forward on one device).
+            self.init(np.asarray(inputs)[:2])
         if self._step_fn is None:
             self._make_step()
         self.state, metrics = self._step_fn(
@@ -253,7 +257,7 @@ class Solver:
         for _ in range(num_iters):
             inputs, labels = next(batches)
             if self.state is None:
-                self.init(inputs)
+                self.init(np.asarray(inputs)[:2])
             if self._eval_fn is None:
                 self._make_step()
             m = self._eval_fn(self.state, jnp.asarray(inputs), jnp.asarray(labels))
@@ -291,9 +295,7 @@ class Solver:
             step_num = int(it) + 1
             if cfg.display and step_num % cfg.display == 0:
                 host = {k: float(v) for k, v in last.items()}
-                avg = float(sum(jnp.stack(list(self._loss_window)))) / len(
-                    self._loss_window
-                )
+                avg = float(jnp.stack(list(self._loss_window)).mean())
                 log_fn(
                     f"iter {step_num} lr={host.get('lr', 0):.6g} "
                     f"loss={avg:.6g} (avg over {len(self._loss_window)}) "

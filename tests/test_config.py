@@ -1,0 +1,243 @@
+"""Config front-end tests: text-format parser + typed schema + reference
+usage files parsed verbatim (SURVEY.md §7.4, north-star prototxt compat)."""
+
+import os
+
+import pytest
+
+from npairloss_tpu.config import (
+    PrototxtParseError,
+    dumps,
+    load_net,
+    load_solver,
+    net_from_text,
+    npair_param_to_config,
+    parse,
+)
+from npairloss_tpu.ops.npair_loss import MiningMethod, MiningRegion
+
+REF_USAGE = "/root/reference/usage"
+
+
+# ---------------------------------------------------------------------------
+# Parser primitives
+# ---------------------------------------------------------------------------
+
+
+def test_scalars_and_types():
+    msg = parse(
+        """
+        an_int: 42
+        a_float: 0.5
+        neg: -0.3
+        sci: 1e-8
+        flag_t: true
+        flag_f: false
+        s: "hello world"
+        enum_val: RELATIVE_HARD
+        """
+    )
+    assert msg["an_int"] == 42 and isinstance(msg["an_int"], int)
+    assert msg["a_float"] == 0.5
+    assert msg["neg"] == -0.3
+    assert msg["sci"] == 1e-8
+    assert msg["flag_t"] is True and msg["flag_f"] is False
+    assert msg["s"] == "hello world"
+    assert msg["enum_val"] == "RELATIVE_HARD"
+
+
+def test_nested_and_repeated():
+    msg = parse(
+        """
+        layer { name: "a" top: "x" top: "y" }
+        layer { name: "b" inner { k: 1 } }
+        loss_weight: 1
+        loss_weight: 2
+        """
+    )
+    layers = msg.getlist("layer")
+    assert len(layers) == 2
+    assert layers[0].getlist("top") == ["x", "y"]
+    assert layers[1]["inner"]["k"] == 1
+    assert msg.getlist("loss_weight") == [1, 2]
+    # singular access takes the last occurrence (proto2 semantics)
+    assert msg["loss_weight"] == 2
+
+
+def test_comments_including_nonascii():
+    msg = parse(
+        """
+        a: 1 # trailing comment
+        # full-line comment
+        b: 2 # 对于绝对选择来说该项无效
+        s: "has # not a comment"
+        """
+    )
+    assert msg["a"] == 1 and msg["b"] == 2
+    assert msg["s"] == "has # not a comment"
+
+
+def test_colon_before_brace_and_no_space():
+    msg = parse('inc:{ phase: TEST }\nval:3')
+    assert msg["inc"]["phase"] == "TEST"
+    assert msg["val"] == 3
+
+
+def test_template_ellipsis_tolerated():
+    # def.prototxt is a truncated template with literal "." lines
+    msg = parse("a: 1\n.\n.\n.\nb: 2")
+    assert msg["a"] == 1 and msg["b"] == 2
+
+
+def test_parse_errors():
+    with pytest.raises(PrototxtParseError):
+        parse("a: 1 }")
+    with pytest.raises(PrototxtParseError):
+        parse("layer {")
+    with pytest.raises(PrototxtParseError):
+        parse("a:")
+
+
+def test_roundtrip():
+    text = 'name: "n"\nlayer {\n    t: GLOBAL\n    v: 3\n}'
+    msg = parse(text)
+    again = parse(dumps(msg))
+    assert again.to_dict() == msg.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# NPairLossParameter mapping (caffe.proto:3-23)
+# ---------------------------------------------------------------------------
+
+
+def test_npair_param_defaults_match_proto():
+    cfg = npair_param_to_config(None)
+    assert cfg.margin_ident == 0.0
+    assert cfg.margin_diff == 0.0
+    assert cfg.identsn == -1.0
+    assert cfg.diffsn == -1.0
+    assert cfg.ap_mining_region == MiningRegion.LOCAL
+    assert cfg.ap_mining_method == MiningMethod.RAND
+    assert cfg.an_mining_region == MiningRegion.LOCAL
+    assert cfg.an_mining_method == MiningMethod.RAND
+
+
+def test_npair_param_numeric_enums():
+    msg = parse("ap_mining_region: 0\nap_mining_method: 3")
+    cfg = npair_param_to_config(msg)
+    assert cfg.ap_mining_region == MiningRegion.GLOBAL
+    assert cfg.ap_mining_method == MiningMethod.RELATIVE_HARD
+
+
+# ---------------------------------------------------------------------------
+# Reference usage files, verbatim
+# ---------------------------------------------------------------------------
+
+needs_ref = pytest.mark.skipif(
+    not os.path.isdir(REF_USAGE), reason="reference usage/ not mounted"
+)
+
+
+@needs_ref
+def test_reference_solver_prototxt():
+    cfg, net = load_solver(os.path.join(REF_USAGE, "solver.prototxt"))
+    assert net == "./conf_same_veri/def.prototxt"
+    assert cfg.base_lr == 0.001
+    assert cfg.lr_policy == "step"
+    assert cfg.stepsize == 10000
+    assert cfg.gamma == 0.5
+    assert cfg.max_iter == 2000000
+    assert cfg.momentum == 0.9
+    assert cfg.weight_decay == 2e-5
+    assert cfg.snapshot == 5000
+    assert cfg.snapshot_prefix == "./snap/googlenet_"
+    assert cfg.test_iter == 2000
+    assert cfg.test_interval == 2000
+    assert cfg.test_initialization is True
+    assert cfg.display == 100
+    assert cfg.average_loss == 100
+
+
+@needs_ref
+def test_reference_def_prototxt():
+    net = load_net(os.path.join(REF_USAGE, "def.prototxt"))
+    assert net.name == "GoogleNet"
+    assert net.l2_normalize
+
+    train = net.data["TRAIN"]
+    assert train.batch_size == 120
+    assert train.identity_num_per_batch == 60
+    assert train.img_num_per_identity == 2
+    assert train.rand_identity and train.shuffle
+    assert train.new_height == train.new_width == 224
+    assert train.transform.crop_size == 224
+    assert train.transform.mirror is True
+    assert train.transform.mean_value == (104.0, 117.0, 123.0)
+
+    test = net.data["TEST"]
+    assert test.batch_size == 30
+    assert test.identity_num_per_batch == 15
+
+    tr = net.transformer
+    assert tr is not None
+    assert tr.rotate_angle_scope == pytest.approx(0.349)
+    assert tr.translation_w_scope == 70
+    assert tr.scale_w_scope == pytest.approx(1.2)
+    assert tr.h_flip is True
+    assert tr.elastic_transform is False
+
+    loss = net.loss
+    assert loss is not None
+    assert len(loss.tops) == 5
+    assert loss.loss_weights == (1.0,) * 5
+    lc = loss.loss
+    assert lc.margin_ident == 0.0
+    assert lc.margin_diff == pytest.approx(-0.05)
+    assert lc.identsn == pytest.approx(-0.0)
+    assert lc.diffsn == pytest.approx(-0.3)
+    assert lc.ap_mining_region == MiningRegion.GLOBAL
+    assert lc.ap_mining_method == MiningMethod.RELATIVE_HARD
+    assert lc.an_mining_region == MiningRegion.LOCAL
+    assert lc.an_mining_method == MiningMethod.HARD
+
+
+@needs_ref
+def test_reference_def_matches_shipped_reference_config():
+    """The parsed def.prototxt mining config must equal REFERENCE_CONFIG."""
+    import dataclasses
+
+    from npairloss_tpu.ops.npair_loss import REFERENCE_CONFIG
+
+    net = load_net(os.path.join(REF_USAGE, "def.prototxt"))
+    parsed = dataclasses.replace(net.loss.loss, grad_mode="reference")
+    assert parsed == REFERENCE_CONFIG
+
+
+# ---------------------------------------------------------------------------
+# Solver round-trip on our own fixture
+# ---------------------------------------------------------------------------
+
+
+def test_solver_from_text(tmp_path):
+    p = tmp_path / "solver.prototxt"
+    p.write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nlr_policy: "multistep"\n'
+        "stepvalue: 10\nstepvalue: 20\nmomentum: 0.5\nmax_iter: 100\n"
+        'solver_mode: GPU\n'
+    )
+    cfg, net = load_solver(str(p))
+    assert net == "net.prototxt"
+    assert cfg.base_lr == 0.01
+    assert cfg.lr_policy == "multistep"
+    assert cfg.stepvalues == (10, 20)
+    assert cfg.momentum == 0.5
+    assert cfg.max_iter == 100
+
+
+def test_net_without_loss_params_uses_defaults():
+    net = net_from_text(
+        'name: "tiny"\nlayer { name: "l" type: "NPairMultiClassLoss" '
+        'bottom: "f" bottom: "y" top: "loss" }'
+    )
+    assert net.loss is not None
+    assert net.loss.loss == npair_param_to_config(None)
