@@ -1,1 +1,24 @@
+"""Data pipeline: identity-balanced sampling, on-device augmentation,
+list-file datasets, synthetic clusters (SURVEY.md §3.5, §7.5)."""
+
+from npairloss_tpu.data.dataset import ArrayDataset, ListFileDataset
+from npairloss_tpu.data.loader import MultibatchLoader, multibatch_loader
+from npairloss_tpu.data.sampler import IdentityBalancedSampler
 from npairloss_tpu.data.synthetic import synthetic_identity_batches
+from npairloss_tpu.data.transforms import (
+    apply_transform_param,
+    augment,
+    data_transformer,
+)
+
+__all__ = [
+    "ArrayDataset",
+    "ListFileDataset",
+    "MultibatchLoader",
+    "multibatch_loader",
+    "IdentityBalancedSampler",
+    "synthetic_identity_batches",
+    "apply_transform_param",
+    "augment",
+    "data_transformer",
+]

@@ -1,0 +1,145 @@
+"""The MultibatchData pipeline: sample -> decode (host threads) -> augment
+(device, jitted) -> prefetch queue.
+
+The reference's data layer runs decode + augmentation on a CPU prefetch
+thread per rank (SURVEY.md §3.5).  Here the host only decodes and
+resizes; every augmentation op (warp, crop, mirror, mean) runs on the
+accelerator as one jitted graph (``data.transforms``), and a background
+thread keeps a bounded queue of ready batches so the training step never
+waits on input.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from npairloss_tpu.config.schema import DataLayerConfig, TransformerConfig
+from npairloss_tpu.data.dataset import ArrayDataset, ListFileDataset
+from npairloss_tpu.data.sampler import IdentityBalancedSampler
+from npairloss_tpu.data.transforms import augment
+
+
+def _identity_counts(cfg: DataLayerConfig) -> Tuple[int, int]:
+    ids = cfg.identity_num_per_batch
+    imgs = cfg.img_num_per_identity
+    if not ids or not imgs:
+        # Fall back to pairs (the minimum the mining contract allows).
+        imgs = imgs or 2
+        ids = ids or max(1, (cfg.batch_size or 2) // imgs)
+    return ids, imgs
+
+
+class MultibatchLoader:
+    """Iterator of (images[float32 NHWC], labels[int32]) batches."""
+
+    def __init__(
+        self,
+        dataset,
+        cfg: DataLayerConfig,
+        transformer: Optional[TransformerConfig] = None,
+        train: bool = True,
+        seed: int = 0,
+        prefetch: int = 2,
+        device_augment: bool = True,
+    ):
+        self.dataset = dataset
+        self.cfg = cfg
+        self.transformer = transformer
+        self.train = train
+        self.device_augment = device_augment
+        ids, imgs = _identity_counts(cfg)
+        self.sampler = IdentityBalancedSampler(
+            dataset.labels,
+            ids,
+            imgs,
+            rand_identity=cfg.rand_identity,
+            shuffle=cfg.shuffle,
+            seed=seed,
+        )
+        self._key = jax.random.PRNGKey(seed)
+        self._queue: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    # -- host side: sample + decode ---------------------------------------
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                idx = next(self.sampler)
+                images = self.dataset.load_batch(idx).astype(np.float32)
+                labels = self.dataset.labels[idx].astype(np.int32)
+                self._put((images, labels))
+        except BaseException as exc:  # surface in __next__, don't die silently
+            self._put(exc)
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=1.0)
+                return
+            except queue.Full:
+                continue
+
+    # -- device side: augmentation -----------------------------------------
+
+    def _augment(self, images: np.ndarray):
+        self._key, sub = jax.random.split(self._key)
+        return augment(
+            images,
+            sub,
+            tp=self.cfg.transform,
+            transformer=self.transformer,
+            train=self.train,
+        )
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration("loader is closed")
+        item = self._queue.get()
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise RuntimeError("data prefetch worker failed") from item
+        images, labels = item
+        if self.device_augment and (
+            self.cfg.transform != type(self.cfg.transform)()
+            or self.transformer is not None
+        ):
+            images = self._augment(images)
+        return images, labels
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+
+def multibatch_loader(
+    cfg: DataLayerConfig,
+    transformer: Optional[TransformerConfig] = None,
+    train: Optional[bool] = None,
+    seed: int = 0,
+    prefetch: int = 2,
+) -> MultibatchLoader:
+    """Build the full pipeline from a parsed MultibatchData layer config."""
+    dataset = ListFileDataset(
+        cfg.root_folder, cfg.source, cfg.new_height, cfg.new_width
+    )
+    if train is None:
+        train = cfg.phase == "TRAIN"
+    return MultibatchLoader(
+        dataset, cfg, transformer, train=train, seed=seed, prefetch=prefetch
+    )

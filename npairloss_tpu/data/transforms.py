@@ -1,0 +1,263 @@
+"""On-device, jit-compiled data augmentation.
+
+The reference splits augmentation between Caffe's ``transform_param``
+(mean subtraction, random crop, mirror — usage/def.prototxt:10-16) and a
+``DataTransformer`` layer doing geometric warps (rotation, translation,
+scale, horizontal flip, optional elastic deformation —
+def.prototxt:69-83), all on CPU per image inside the data prefetch
+thread.
+
+TPU-first redesign: the whole augmentation stack is ONE jitted, batched
+function on device —
+
+  * rotation/scale/translation compose into a single inverse affine
+    matrix per image; one bilinear gather warps the image (no per-op
+    passes over HBM);
+  * the elastic deformation is a Gaussian-smoothed random displacement
+    field added to the same sampling grid, so it fuses into the same
+    gather;
+  * crop/mirror/mean-subtract are elementwise/slice ops XLA fuses into
+    the surrounding graph.
+
+Everything is shape-static and batched (vmap), so XLA tiles it onto the
+VPU; host work is reduced to decode + resize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from npairloss_tpu.config.schema import TransformParam, TransformerConfig
+
+
+# ---------------------------------------------------------------------------
+# Bilinear warp primitives
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_sample(img: jax.Array, ys: jax.Array, xs: jax.Array) -> jax.Array:
+    """Sample img[H,W,C] at float coords (ys, xs) [H,W], border-clamped."""
+    h, w = img.shape[0], img.shape[1]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    y0 = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+
+    def at(yy, xx):
+        return img[yy, xx]
+
+    top = at(y0, x0) * (1 - wx)[..., None] + at(y0, x1) * wx[..., None]
+    bot = at(y1, x0) * (1 - wx)[..., None] + at(y1, x1) * wx[..., None]
+    return top * (1 - wy)[..., None] + bot * wy[..., None]
+
+
+def _gaussian_kernel1d(radius: float, width: int) -> np.ndarray:
+    sigma = max(float(radius), 1e-3)
+    xs = np.arange(-width, width + 1, dtype=np.float32)
+    k = np.exp(-0.5 * (xs / sigma) ** 2)
+    return (k / k.sum()).astype(np.float32)
+
+
+def _smooth_field(field: jax.Array, kernel: jax.Array) -> jax.Array:
+    """Separable Gaussian blur of a [H,W] field."""
+    pad = kernel.shape[0] // 2
+    f = jnp.pad(field, ((pad, pad), (0, 0)), mode="edge")
+    f = jax.vmap(lambda col: jnp.convolve(col, kernel, mode="valid"),
+                 in_axes=1, out_axes=1)(f)
+    f = jnp.pad(f, ((0, 0), (pad, pad)), mode="edge")
+    f = jax.vmap(lambda row: jnp.convolve(row, kernel, mode="valid"))(f)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# DataTransformer: rotation + translation + scale + flip + elastic
+# ---------------------------------------------------------------------------
+
+
+def _warp_one(
+    img: jax.Array,
+    angle: jax.Array,
+    tx: jax.Array,
+    ty: jax.Array,
+    sx: jax.Array,
+    sy: jax.Array,
+    flip: jax.Array,
+    disp: Optional[Tuple[jax.Array, jax.Array]],
+) -> jax.Array:
+    """Apply the inverse affine (about the image center) + displacement."""
+    h, w = img.shape[0], img.shape[1]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    yy, xx = jnp.meshgrid(
+        jnp.arange(h, dtype=jnp.float32),
+        jnp.arange(w, dtype=jnp.float32),
+        indexing="ij",
+    )
+    # Output pixel -> input pixel: undo translation, then rotation+scale
+    # about the center, then optional horizontal flip.
+    yr = yy - cy - ty
+    xr = xx - cx - tx
+    cos, sin = jnp.cos(angle), jnp.sin(angle)
+    xs = (cos * xr + sin * yr) / sx
+    ys = (-sin * xr + cos * yr) / sy
+    xs = jnp.where(flip, -xs, xs)
+    ys = ys + cy
+    xs = xs + cx
+    if disp is not None:
+        ys = ys + disp[0]
+        xs = xs + disp[1]
+    return _bilinear_sample(img, ys, xs)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def data_transformer(
+    images: jax.Array, key: jax.Array, cfg: TransformerConfig
+) -> jax.Array:
+    """Batched geometric augmentation per the DataTransformer layer.
+
+    Parameter semantics (def.prototxt:69-83): per image, draw
+      angle ~ U(-rotate_angle_scope, +rotate_angle_scope)       [radians]
+      t_w   ~ U(-translation_w_scope, +translation_w_scope)     [pixels]
+      t_h   ~ U(-translation_h_scope, +translation_h_scope)
+      s_w   ~ U(min(1, 1/scale_w_scope), max(1, scale_w_scope))
+      s_h   ~ U(min(1, 1/scale_h_scope), max(1, scale_h_scope))
+      flip  ~ Bernoulli(0.5) when h_flip
+    plus, when elastic_transform, a displacement field of N(0, amplitude²)
+    noise smoothed by a Gaussian of sigma ``radius``.
+    """
+    n, h, w = images.shape[0], images.shape[1], images.shape[2]
+    images = images.astype(jnp.float32)
+    ks = jax.random.split(key, 7)
+
+    scope = float(cfg.rotate_angle_scope)
+    angles = jax.random.uniform(ks[0], (n,), minval=-scope, maxval=scope)
+    txs = jax.random.uniform(
+        ks[1], (n,),
+        minval=-float(cfg.translation_w_scope),
+        maxval=float(cfg.translation_w_scope),
+    )
+    tys = jax.random.uniform(
+        ks[2], (n,),
+        minval=-float(cfg.translation_h_scope),
+        maxval=float(cfg.translation_h_scope),
+    )
+
+    def scale_range(s):
+        s = float(s) if s else 1.0
+        if s <= 0:
+            return 1.0, 1.0
+        # Symmetric zoom range U(min(s,1/s), max(s,1/s)); scope 0.8 and
+        # scope 1.25 both mean the same +-25% zoom.
+        return min(s, 1.0 / s), max(s, 1.0 / s)
+
+    lo_w, hi_w = scale_range(cfg.scale_w_scope)
+    lo_h, hi_h = scale_range(cfg.scale_h_scope)
+    sxs = jax.random.uniform(ks[3], (n,), minval=lo_w, maxval=hi_w)
+    sys_ = jax.random.uniform(ks[4], (n,), minval=lo_h, maxval=hi_h)
+    flips = (
+        jax.random.bernoulli(ks[5], 0.5, (n,))
+        if cfg.h_flip
+        else jnp.zeros((n,), bool)
+    )
+
+    if cfg.elastic_transform:
+        kernel = jnp.asarray(
+            _gaussian_kernel1d(cfg.radius, max(int(3 * cfg.radius), 1))
+        )
+        noise = (
+            jax.random.normal(ks[6], (n, 2, h, w), dtype=jnp.float32)
+            * jnp.float32(cfg.amplitude)
+        )
+        smooth = jax.vmap(jax.vmap(lambda f: _smooth_field(f, kernel)))(noise)
+        disp = (smooth[:, 0], smooth[:, 1])
+        return jax.vmap(_warp_one)(
+            images, angles, txs, tys, sxs, sys_, flips, disp
+        )
+    return jax.vmap(
+        lambda i, a, tx, ty, sx, sy, f: _warp_one(i, a, tx, ty, sx, sy, f, None)
+    )(images, angles, txs, tys, sxs, sys_, flips)
+
+
+# ---------------------------------------------------------------------------
+# transform_param: mean subtraction + random crop + mirror
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("tp", "train"))
+def apply_transform_param(
+    images: jax.Array, key: jax.Array, tp: TransformParam, train: bool = True
+) -> jax.Array:
+    """Caffe transform_param semantics, batched on device.
+
+    Mean values appear in the prototxt in Caffe's BGR channel order
+    (def.prototxt:13-15: 104, 117, 123); images here are RGB, so the mean
+    triple is reversed before subtraction.  TRAIN crops at a random
+    offset and mirrors with p=0.5 per image; TEST center-crops without
+    mirroring (standard Caffe DataTransformer behavior).
+    """
+    images = images.astype(jnp.float32)
+    n, h, w, c = images.shape
+
+    if tp.mean_value:
+        mean = list(tp.mean_value)
+        if len(mean) == 1:
+            mean = mean * c
+        if len(mean) != c:
+            raise ValueError(
+                f"mean_value has {len(tp.mean_value)} entries; expected 1 or "
+                f"{c} (channel count)"
+            )
+        mean = mean[::-1]
+        images = images - jnp.asarray(mean, jnp.float32)[None, None, None, :]
+
+    if tp.scale != 1.0:
+        images = images * jnp.float32(tp.scale)
+
+    crop = int(tp.crop_size)
+    if crop and crop > min(h, w):
+        raise ValueError(f"crop_size {crop} exceeds image size {h}x{w}")
+    if crop and (crop < h or crop < w):
+        kh, kw, km = jax.random.split(key, 3)
+        if train:
+            oy = jax.random.randint(kh, (n,), 0, h - crop + 1)
+            ox = jax.random.randint(kw, (n,), 0, w - crop + 1)
+        else:
+            oy = jnp.full((n,), (h - crop) // 2, jnp.int32)
+            ox = jnp.full((n,), (w - crop) // 2, jnp.int32)
+        images = jax.vmap(
+            lambda im, y, x: jax.lax.dynamic_slice(
+                im, (y, x, 0), (crop, crop, c)
+            )
+        )(images, oy, ox)
+    else:
+        km = key
+
+    if tp.mirror and train:
+        do = jax.random.bernoulli(km, 0.5, (n,))
+        images = jnp.where(do[:, None, None, None], images[:, :, ::-1, :], images)
+    return images
+
+
+def augment(
+    images: jax.Array,
+    key: jax.Array,
+    tp: Optional[TransformParam] = None,
+    transformer: Optional[TransformerConfig] = None,
+    train: bool = True,
+) -> jax.Array:
+    """Full augmentation pipeline: DataTransformer warp (TRAIN only, as in
+    the reference's include{phase:TRAIN}) then transform_param."""
+    k1, k2 = jax.random.split(key)
+    if transformer is not None and train:
+        images = data_transformer(images, k1, transformer)
+    if tp is not None:
+        images = apply_transform_param(images, k2, tp, train)
+    return images

@@ -1,0 +1,330 @@
+"""Data pipeline tests: identity-balanced sampler contract, on-device
+augmentation semantics, list-file dataset, end-to-end loader."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from npairloss_tpu.config.schema import (
+    DataLayerConfig,
+    TransformParam,
+    TransformerConfig,
+)
+from npairloss_tpu.data import (
+    ArrayDataset,
+    IdentityBalancedSampler,
+    ListFileDataset,
+    MultibatchLoader,
+    apply_transform_param,
+    data_transformer,
+    multibatch_loader,
+)
+
+
+# ---------------------------------------------------------------------------
+# Sampler
+# ---------------------------------------------------------------------------
+
+
+def _labels(n_ids=10, per_id=6):
+    return np.repeat(np.arange(n_ids), per_id)
+
+
+def test_sampler_batch_contract():
+    """Every batch is ids_per_batch x imgs_per_id, identity-grouped."""
+    s = IdentityBalancedSampler(_labels(), 4, 2, seed=0)
+    labels = _labels()
+    for _ in range(20):
+        idx = next(s)
+        assert idx.shape == (8,)
+        lab = labels[idx]
+        # Grouped in runs of imgs_per_id with matching labels.
+        pairs = lab.reshape(4, 2)
+        assert (pairs[:, 0] == pairs[:, 1]).all()
+        # Identities within a batch are distinct.
+        assert len(set(pairs[:, 0])) == 4
+
+
+def test_sampler_without_replacement_within_identity():
+    """An identity's images cycle before repeating."""
+    labels = _labels(n_ids=2, per_id=4)
+    s = IdentityBalancedSampler(
+        labels, 2, 2, rand_identity=False, shuffle=False, seed=0
+    )
+    seen = {0: [], 1: []}
+    for _ in range(2):  # 2 batches x 2 imgs = one full pool per identity
+        idx = next(s)
+        for i in idx:
+            seen[labels[i]].append(i)
+    for lbl, imgs in seen.items():
+        assert len(set(imgs)) == 4, f"identity {lbl} repeated early: {imgs}"
+
+
+def test_sampler_replacement_for_small_identity():
+    labels = np.array([0, 1, 1, 2, 2])  # identity 0 has 1 image < 2
+    s = IdentityBalancedSampler(labels, 3, 2, seed=0)
+    idx = next(s)
+    assert len(idx) == 6
+    assert sorted(set(labels[idx])) == [0, 1, 2]
+
+
+def test_sampler_deterministic_given_seed():
+    a = IdentityBalancedSampler(_labels(), 4, 2, seed=7)
+    b = IdentityBalancedSampler(_labels(), 4, 2, seed=7)
+    for _ in range(5):
+        np.testing.assert_array_equal(next(a), next(b))
+
+
+def test_sampler_sequential_identities():
+    labels = _labels(n_ids=6, per_id=2)
+    s = IdentityBalancedSampler(
+        labels, 2, 2, rand_identity=False, shuffle=False, seed=0
+    )
+    batches = [labels[next(s)].reshape(2, 2)[:, 0] for _ in range(3)]
+    assert np.concatenate(batches).tolist() == [0, 1, 2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# transform_param
+# ---------------------------------------------------------------------------
+
+
+def test_mean_subtraction_reversed_for_rgb():
+    tp = TransformParam(mean_value=(104.0, 117.0, 123.0))
+    img = np.zeros((1, 4, 4, 3), np.float32)
+    out = np.asarray(apply_transform_param(img, jax.random.PRNGKey(0), tp))
+    # BGR-order means reversed onto RGB channels.
+    assert out[0, 0, 0, 0] == -123.0
+    assert out[0, 0, 0, 1] == -117.0
+    assert out[0, 0, 0, 2] == -104.0
+
+
+def test_crop_train_and_test():
+    tp = TransformParam(crop_size=4)
+    img = np.arange(2 * 8 * 8 * 3, dtype=np.float32).reshape(2, 8, 8, 3)
+    out_tr = apply_transform_param(img, jax.random.PRNGKey(0), tp, train=True)
+    out_te = apply_transform_param(img, jax.random.PRNGKey(0), tp, train=False)
+    assert out_tr.shape == (2, 4, 4, 3)
+    # TEST center crop is deterministic.
+    np.testing.assert_array_equal(np.asarray(out_te), img[:, 2:6, 2:6, :])
+
+
+def test_mirror_only_in_train():
+    tp = TransformParam(mirror=True)
+    img = np.arange(1 * 2 * 4 * 3, dtype=np.float32).reshape(1, 2, 4, 3)
+    out_te = apply_transform_param(img, jax.random.PRNGKey(0), tp, train=False)
+    np.testing.assert_array_equal(np.asarray(out_te), img)
+    # With many samples, some must mirror in train.
+    big = np.tile(img, (64, 1, 1, 1))
+    out_tr = np.asarray(
+        apply_transform_param(big, jax.random.PRNGKey(1), tp, train=True)
+    )
+    flipped = (out_tr == big[:, :, ::-1, :]).all(axis=(1, 2, 3))
+    kept = (out_tr == big).all(axis=(1, 2, 3))
+    assert flipped.any() and kept.any()
+    assert (flipped | kept).all()
+
+
+# ---------------------------------------------------------------------------
+# DataTransformer warp
+# ---------------------------------------------------------------------------
+
+
+def test_zero_scopes_are_identity():
+    cfg = TransformerConfig()  # all scopes zero / scales 1
+    img = np.random.default_rng(0).uniform(0, 255, (2, 8, 8, 3)).astype(np.float32)
+    out = np.asarray(data_transformer(img, jax.random.PRNGKey(0), cfg))
+    np.testing.assert_allclose(out, img, atol=1e-4)
+
+
+def test_translation_shifts_content():
+    cfg = TransformerConfig(translation_w_scope=3.0)
+    img = np.zeros((8, 16, 16, 1), np.float32)
+    img[:, :, 8, 0] = 1.0  # vertical line at x=8
+    out = np.asarray(data_transformer(img, jax.random.PRNGKey(2), cfg))
+    cols = out[..., 0].sum(axis=1).argmax(axis=1)
+    assert (np.abs(cols - 8) <= 3).all()
+    assert len(set(cols.tolist())) > 1  # actually random per image
+
+
+def test_rotation_preserves_center():
+    cfg = TransformerConfig(rotate_angle_scope=0.349)
+    img = np.zeros((4, 9, 9, 1), np.float32)
+    img[:, 4, 4, 0] = 1.0
+    out = np.asarray(data_transformer(img, jax.random.PRNGKey(3), cfg))
+    # Center pixel is the rotation fixed point.
+    assert (out[:, 4, 4, 0] > 0.5).all()
+
+
+def test_elastic_runs_and_stays_bounded():
+    cfg = TransformerConfig(
+        elastic_transform=True, amplitude=2.0, radius=1.5
+    )
+    img = np.random.default_rng(0).uniform(0, 1, (2, 12, 12, 3)).astype(np.float32)
+    out = np.asarray(data_transformer(img, jax.random.PRNGKey(4), cfg))
+    assert out.shape == img.shape
+    assert np.isfinite(out).all()
+    assert out.min() >= img.min() - 1e-5 and out.max() <= img.max() + 1e-5
+
+
+def test_reference_config_warp_shapes():
+    """The exact def.prototxt:69-83 transformer config runs end-to-end."""
+    cfg = TransformerConfig(
+        rotate_angle_scope=0.349,
+        translation_w_scope=70,
+        translation_h_scope=70,
+        scale_w_scope=1.2,
+        scale_h_scope=1.2,
+        h_flip=True,
+        elastic_transform=False,
+    )
+    img = np.random.default_rng(1).uniform(0, 255, (4, 64, 64, 3)).astype(np.float32)
+    out = np.asarray(data_transformer(img, jax.random.PRNGKey(5), cfg))
+    assert out.shape == img.shape and np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# ListFileDataset + loader end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _write_image_tree(tmp_path, n_ids=4, per_id=3, size=(10, 12)):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(n_ids):
+        for j in range(per_id):
+            arr = rng.integers(0, 255, (*size, 3), dtype=np.uint8)
+            rel = f"id{i}/img{j}.png"
+            os.makedirs(tmp_path / f"id{i}", exist_ok=True)
+            Image.fromarray(arr).save(tmp_path / rel)
+            lines.append(f"{rel} {i}")
+    src = tmp_path / "list.txt"
+    src.write_text("\n".join(lines) + "\n")
+    return str(src)
+
+
+def test_listfile_dataset(tmp_path):
+    src = _write_image_tree(tmp_path)
+    ds = ListFileDataset(str(tmp_path), src, new_height=8, new_width=8)
+    assert len(ds) == 12
+    img = ds.load(0)
+    assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+    assert ds.labels.tolist() == [0] * 3 + [1] * 3 + [2] * 3 + [3] * 3
+
+
+def test_multibatch_loader_end_to_end(tmp_path):
+    src = _write_image_tree(tmp_path)
+    cfg = DataLayerConfig(
+        phase="TRAIN",
+        root_folder=str(tmp_path),
+        source=src,
+        batch_size=4,
+        shuffle=True,
+        new_height=16,
+        new_width=16,
+        identity_num_per_batch=2,
+        img_num_per_identity=2,
+        rand_identity=True,
+        transform=TransformParam(
+            mirror=True, crop_size=12, mean_value=(104.0, 117.0, 123.0)
+        ),
+    )
+    tr = TransformerConfig(rotate_angle_scope=0.2, h_flip=True)
+    loader = multibatch_loader(cfg, tr, seed=0)
+    try:
+        for _ in range(3):
+            images, labels = next(loader)
+            images = np.asarray(images)
+            assert images.shape == (4, 12, 12, 3)
+            assert images.dtype == np.float32
+            assert labels.shape == (4,)
+            lab = labels.reshape(2, 2)
+            assert (lab[:, 0] == lab[:, 1]).all()
+    finally:
+        loader.close()
+
+
+def test_loader_with_array_dataset_no_augment():
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0, 1, (20, 6, 6, 3)).astype(np.float32)
+    labels = np.repeat(np.arange(5), 4)
+    cfg = DataLayerConfig(
+        identity_num_per_batch=3, img_num_per_identity=2, shuffle=True,
+        rand_identity=True,
+    )
+    loader = MultibatchLoader(ArrayDataset(images, labels), cfg, seed=1)
+    try:
+        x, y = next(loader)
+        assert np.asarray(x).shape == (6, 6, 6, 3)
+        assert y.shape == (6,)
+    finally:
+        loader.close()
+
+
+# ---------------------------------------------------------------------------
+# Review-driven regressions
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_no_duplicate_image_within_batch_group():
+    """Pool refill mid-batch must not hand the same image to one group."""
+    labels = np.repeat(np.arange(8), 3)  # 3 images/id, imgs_per_id=2
+    s = IdentityBalancedSampler(labels, 4, 2, seed=0)
+    for _ in range(200):
+        idx = next(s).reshape(4, 2)
+        assert (idx[:, 0] != idx[:, 1]).all()
+
+
+def test_loader_worker_error_surfaces(tmp_path):
+    src = tmp_path / "bad.txt"
+    src.write_text("missing.png 0\nalso_missing.png 1\n")
+    ds = ListFileDataset(str(tmp_path), str(src), 8, 8)
+    cfg = DataLayerConfig(identity_num_per_batch=2, img_num_per_identity=1)
+    loader = MultibatchLoader(ds, cfg, seed=0)
+    try:
+        with pytest.raises(RuntimeError, match="prefetch worker failed"):
+            next(loader)
+    finally:
+        loader.close()
+
+
+def test_scale_scope_below_one_still_scales():
+    cfg = TransformerConfig(scale_w_scope=0.5)
+    img = np.zeros((16, 17, 17, 1), np.float32)
+    img[:, :, 8, 0] = 1.0
+    out = np.asarray(data_transformer(img, jax.random.PRNGKey(6), cfg))
+    widths = (out[..., 0].sum(axis=1) > 0.05).sum(axis=1)
+    assert len(set(widths.tolist())) > 1, "scale augmentation was a no-op"
+
+
+def test_crop_larger_than_image_raises():
+    tp = TransformParam(crop_size=64)
+    img = np.zeros((1, 32, 32, 3), np.float32)
+    with pytest.raises(ValueError, match="crop_size"):
+        apply_transform_param(img, jax.random.PRNGKey(0), tp)
+
+
+def test_bad_mean_value_length_raises():
+    tp = TransformParam(mean_value=(104.0, 117.0))
+    img = np.zeros((1, 4, 4, 3), np.float32)
+    with pytest.raises(ValueError, match="mean_value"):
+        apply_transform_param(img, jax.random.PRNGKey(0), tp)
+
+
+def test_listfile_tabs_and_multispace(tmp_path):
+    from PIL import Image
+
+    arr = np.zeros((4, 4, 3), np.uint8)
+    Image.fromarray(arr).save(tmp_path / "a.png")
+    Image.fromarray(arr).save(tmp_path / "b.png")
+    src = tmp_path / "list.txt"
+    src.write_text("a.png\t0\nb.png  1\n")
+    ds = ListFileDataset(str(tmp_path), str(src))
+    assert ds.paths == ["a.png", "b.png"]
+    assert ds.labels.tolist() == [0, 1]
+    assert ds.load(1).shape == (4, 4, 3)
