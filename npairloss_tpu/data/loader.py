@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -45,13 +46,11 @@ class MultibatchLoader:
         train: bool = True,
         seed: int = 0,
         prefetch: int = 2,
-        device_augment: bool = True,
     ):
         self.dataset = dataset
         self.cfg = cfg
         self.transformer = transformer
         self.train = train
-        self.device_augment = device_augment
         ids, imgs = _identity_counts(cfg)
         self.sampler = IdentityBalancedSampler(
             dataset.labels,
@@ -64,28 +63,24 @@ class MultibatchLoader:
         self._key = jax.random.PRNGKey(seed)
         self._queue: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        # The worker holds only a weakref to the loader, so an abandoned
+        # loader (no close()) is still garbage-collectable; __del__ then
+        # stops the thread.
+        self._thread = threading.Thread(
+            target=_prefetch_worker,
+            args=(weakref.ref(self), self._queue, self._stop),
+            daemon=True,
+        )
         self._thread.start()
 
-    # -- host side: sample + decode ---------------------------------------
+    # -- host side: sample + decode (see _prefetch_worker) -----------------
 
-    def _worker(self):
-        try:
-            while not self._stop.is_set():
-                idx = next(self.sampler)
-                images = self.dataset.load_batch(idx).astype(np.float32)
-                labels = self.dataset.labels[idx].astype(np.int32)
-                self._put((images, labels))
-        except BaseException as exc:  # surface in __next__, don't die silently
-            self._put(exc)
+    def _produce_one(self):
+        idx = next(self.sampler)
+        images = self.dataset.load_batch(idx).astype(np.float32)
+        labels = self.dataset.labels[idx].astype(np.int32)
+        return images, labels
 
-    def _put(self, item):
-        while not self._stop.is_set():
-            try:
-                self._queue.put(item, timeout=1.0)
-                return
-            except queue.Full:
-                continue
 
     # -- device side: augmentation -----------------------------------------
 
@@ -110,7 +105,7 @@ class MultibatchLoader:
             self._stop.set()
             raise RuntimeError("data prefetch worker failed") from item
         images, labels = item
-        if self.device_augment and (
+        if (
             self.cfg.transform != type(self.cfg.transform)()
             or self.transformer is not None
         ):
@@ -125,6 +120,48 @@ class MultibatchLoader:
         except queue.Empty:
             pass
         self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        # The worker only weakrefs the loader, so this runs even without
+        # close(); stop the thread rather than leak it.
+        try:
+            self._stop.set()
+        except AttributeError:
+            pass
+
+
+def _prefetch_worker(loader_ref, q: queue.Queue, stop: threading.Event):
+    """Module-level worker holding only a weakref to the loader (plus its
+    queue/stop-event, which don't reference back), so an abandoned loader
+    is garbage-collectable even while the worker blocks on a full queue."""
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=1.0)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    while not stop.is_set():
+        loader = loader_ref()
+        if loader is None:
+            return
+        try:
+            item = loader._produce_one()
+            fatal = False
+        except BaseException as exc:  # surface in __next__, not silently
+            item, fatal = exc, True
+        del loader  # no strong ref while blocking on the queue
+        if not put(item) or fatal:
+            return
 
 
 def multibatch_loader(

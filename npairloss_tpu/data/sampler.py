@@ -81,14 +81,19 @@ class IdentityBalancedSampler:
             return self.rng.choice(
                 self.identities, size=self.ids_per_batch, replace=False
             )
-        chosen = []
+        chosen: List[int] = []
         while len(chosen) < self.ids_per_batch:
             if self._id_pos >= len(self._id_order):
                 self._id_pos = 0
                 if self.shuffle:
                     self.rng.shuffle(self._id_order)
-            chosen.append(int(self._id_order[self._id_pos]))
+            cand = int(self._id_order[self._id_pos])
             self._id_pos += 1
+            # A mid-batch wrap + reshuffle may resurface an identity this
+            # batch already holds; skip it to keep batch identities
+            # distinct (the contract the mining statistics assume).
+            if cand not in chosen:
+                chosen.append(cand)
         return np.array(chosen)
 
     def __iter__(self) -> Iterator[np.ndarray]:

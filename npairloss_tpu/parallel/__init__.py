@@ -1,6 +1,21 @@
+"""Distribution: device-mesh plumbing + ring-blockwise negative pooling."""
+
 from npairloss_tpu.parallel.mesh import (
     DEFAULT_AXIS,
     data_parallel_mesh,
     shard_batch,
     sharded_npair_loss_fn,
 )
+from npairloss_tpu.parallel.ring import (
+    ring_npair_loss_and_metrics,
+    ring_supported,
+)
+
+__all__ = [
+    "DEFAULT_AXIS",
+    "data_parallel_mesh",
+    "shard_batch",
+    "sharded_npair_loss_fn",
+    "ring_npair_loss_and_metrics",
+    "ring_supported",
+]

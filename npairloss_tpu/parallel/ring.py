@@ -1,0 +1,443 @@
+"""Ring-blockwise N-pair loss: pod-scale negative pools without the matrix.
+
+The reference materializes the full N x (N*G) pair-similarity matrix after
+an MPI_Allgather of every rank's embeddings (reference:
+npair_multi_class_loss.cu:17-43, cu:218).  That is O(N^2 G) memory per
+rank — fine at G=8, fatal for the 32k-batch stretch config
+(BASELINE.json) where the gathered pool no longer fits HBM.
+
+This module is the contrastive-learning transplant of ring attention
+(SURVEY.md §5.7): instead of gathering the pool, each shard's feature
+block circulates around the mesh axis via ``jax.lax.ppermute`` while
+every shard streams its N x N_block similarity tile on the MXU,
+reducing online.  Memory is O(N x N_block); the interconnect carries
+each block exactly G-1 hops per pass, and XLA overlaps the ppermute
+with the tile matmul.
+
+Three ring passes per step:
+
+  1. **stats**: per-query min-within / max-between / max-all running
+     reductions (the mining statistics of cu:229-265) — plus running
+     top-(k+1) similarity/label lists for Recall@k.
+  2. **loss**: selection mask from the absolute thresholds, stabilized
+     exp, running I_q/D_q sums (cu:343-388 semantics).
+  3. **backward**: the weight tile w = (-p1+p2+p3)*g/N is recomputed
+     per block; the query-role grad accumulates locally while the
+     database-role grad rides the ring WITH its feature block, arriving
+     at the block's owner as the full cross-shard sum — exactly what the
+     reference's MPI_Allreduce produces (cu:462-489) — then merged
+     0.5/0.5 with the query-role grad (cu:492-497).
+
+Mining-method support: the absolute methods (HARD / EASY / RAND) are
+exact, since their thresholds are min/max reductions that stream.  The
+RELATIVE_* methods need rank statistics over the full pair population;
+use the dense (gather) path for those — fine through v5e-8 pods, and the
+documented growth path beyond is a distributed-selection pass
+(SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from npairloss_tpu.ops.npair_loss import (
+    FLT_MAX,
+    MiningMethod,
+    MiningRegion,
+    NPairLossConfig,
+    selection_mask,
+)
+
+_ABSOLUTE = (MiningMethod.HARD, MiningMethod.EASY, MiningMethod.RAND)
+
+
+def ring_supported(cfg: NPairLossConfig) -> bool:
+    """True when the mining config streams (no rank statistics needed)."""
+    return (
+        cfg.ap_mining_method in _ABSOLUTE and cfg.an_mining_method in _ABSOLUTE
+    )
+
+
+def _check_cfg(cfg: NPairLossConfig) -> None:
+    if not ring_supported(cfg):
+        raise NotImplementedError(
+            "ring mode streams min/max thresholds only; RELATIVE_* mining "
+            "needs the dense gather path (npair_loss_with_aux)"
+        )
+
+
+def _tile(
+    feats: jax.Array, block_f: jax.Array
+) -> jax.Array:
+    """One N x N_block similarity tile on the MXU, fp32 accumulate."""
+    return jnp.dot(
+        feats,
+        block_f.T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _block_masks(
+    labels: jax.Array,
+    block_labels: jax.Array,
+    my_rank: jax.Array,
+    block_rank: jax.Array,
+    n_local: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """same/diff masks for one tile; self-pair excluded when the tile is
+    this shard's own block (cu:54 semantics on the tiled grid)."""
+    same_lbl = labels[:, None] == block_labels[None, :]
+    eye = jnp.eye(n_local, dtype=bool)
+    self_pair = jnp.where(my_rank == block_rank, eye, jnp.zeros_like(eye))
+    same = same_lbl & ~self_pair
+    diff = (~same_lbl) & ~self_pair
+    return same, diff
+
+
+def _pvary(tree, axis_name: str):
+    """Mark fresh (replicated) carry values as device-varying so the scan
+    carry type stays stable under shard_map's manual-axes tracking."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree
+    )
+
+
+def _ring_scan(axis_name: str, body, carry, rotating):
+    """Run ``body(carry, rotating, step) -> (carry, rotating)`` G times,
+    ppermuting ``rotating`` one hop forward between steps.  Shard r
+    therefore sees block (r - step) mod G at step ``step``; after G hops
+    every rotating value is back at its owner."""
+    g = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % g) for i in range(g)]
+    carry = _pvary(carry, axis_name)
+
+    def step_fn(state, step):
+        carry, rotating = state
+        carry, rotating = body(carry, rotating, step)
+        rotating = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), rotating
+        )
+        return (carry, rotating), None
+
+    (carry, rotating), _ = jax.lax.scan(
+        step_fn, (carry, rotating), jnp.arange(g)
+    )
+    return carry, rotating
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: mining statistics + retrieval top-k
+# ---------------------------------------------------------------------------
+
+
+def _stats_pass(
+    feats, labels, my_rank, axis_name: str, top_k_max: int
+):
+    n_local = feats.shape[0]
+    neg = jnp.float32(-FLT_MAX)
+    pos = jnp.float32(FLT_MAX)
+
+    carry = {
+        "min_within": jnp.full((n_local,), pos),
+        "max_between": jnp.full((n_local,), neg),
+        "max_all": jnp.full((n_local,), neg),
+        # Running top-(k+1) non-self sims and a same-label flag for each,
+        # for the Recall@k threshold semantics (cu:190-197).
+        "top_sims": jnp.full((n_local, top_k_max + 1), neg),
+        "top_same": jnp.zeros((n_local, top_k_max + 1), bool),
+    }
+    rotating = {
+        "f": feats,
+        "l": labels,
+        "rank": my_rank,
+    }
+
+    def body(c, rot, step):
+        sims = _tile(feats, rot["f"])
+        same, diff = _block_masks(labels, rot["l"], my_rank, rot["rank"], n_local)
+        c = dict(c)
+        c["min_within"] = jnp.minimum(
+            c["min_within"], jnp.where(same, sims, pos).min(axis=1)
+        )
+        c["max_between"] = jnp.maximum(
+            c["max_between"], jnp.where(diff, sims, neg).max(axis=1)
+        )
+        c["max_all"] = jnp.maximum(
+            c["max_all"], jnp.where(same | diff, sims, neg).max(axis=1)
+        )
+        nonself = same | diff
+        cat_sims = jnp.concatenate(
+            [c["top_sims"], jnp.where(nonself, sims, neg)], axis=1
+        )
+        cat_same = jnp.concatenate([c["top_same"], same], axis=1)
+        top_sims, idx = jax.lax.top_k(cat_sims, c["top_sims"].shape[1])
+        c["top_sims"] = top_sims
+        c["top_same"] = jnp.take_along_axis(cat_same, idx, axis=1)
+        return c, rot
+
+    carry, _ = _ring_scan(axis_name, body, carry, rotating)
+    return carry
+
+
+def _thresholds(stats, cfg: NPairLossConfig, axis_name: str):
+    """Absolute thresholds from streamed stats (cu:279, 296, 310, 327).
+
+    GLOBAL region means this RANK's whole N x (N*G) block (each rank
+    computes its own block-wide extremum in the reference, with no
+    cross-rank reduction) — so it reduces over queries, not shards.
+    """
+    if cfg.ap_mining_region == MiningRegion.LOCAL:
+        pos_thr = stats["max_between"]
+    else:
+        pos_thr = jnp.broadcast_to(
+            stats["max_between"].max(), stats["max_between"].shape
+        )
+    if cfg.an_mining_region == MiningRegion.LOCAL:
+        neg_thr = stats["min_within"]
+    else:
+        neg_thr = jnp.broadcast_to(
+            stats["min_within"].min(), stats["min_within"].shape
+        )
+    return pos_thr, neg_thr
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: selection + stabilized exp sums (+ counts)
+# ---------------------------------------------------------------------------
+
+
+def _loss_pass(
+    feats, labels, my_rank, pos_thr, neg_thr, max_all, cfg, axis_name: str
+):
+    n_local = feats.shape[0]
+    carry = {
+        "ident_sum": jnp.zeros((n_local,), jnp.float32),
+        "diff_sum": jnp.zeros((n_local,), jnp.float32),
+        "ident_num": jnp.zeros((n_local,), jnp.float32),
+        "diff_num": jnp.zeros((n_local,), jnp.float32),
+    }
+    rotating = {"f": feats, "l": labels, "rank": my_rank}
+
+    def body(c, rot, step):
+        sims = _tile(feats, rot["f"])
+        same, diff = _block_masks(labels, rot["l"], my_rank, rot["rank"], n_local)
+        sel = selection_mask(sims, same, diff, pos_thr, neg_thr, cfg)
+        sel_pos = same & sel
+        sel_neg = diff & sel
+        sim_exp = jnp.exp(sims - max_all[:, None])
+        c = dict(c)
+        c["ident_sum"] = c["ident_sum"] + jnp.where(sel_pos, sim_exp, 0.0).sum(1)
+        c["diff_sum"] = c["diff_sum"] + jnp.where(sel_neg, sim_exp, 0.0).sum(1)
+        c["ident_num"] = c["ident_num"] + sel_pos.sum(1).astype(jnp.float32)
+        c["diff_num"] = c["diff_num"] + sel_neg.sum(1).astype(jnp.float32)
+        return c, rot
+
+    carry, _ = _ring_scan(axis_name, body, carry, rotating)
+    return carry
+
+
+# ---------------------------------------------------------------------------
+# Pass 3 (backward): ring allreduce of database-role grads
+# ---------------------------------------------------------------------------
+
+
+def _backward_pass(
+    feats,
+    labels,
+    my_rank,
+    pos_thr,
+    neg_thr,
+    max_all,
+    ident_sum,
+    all_sum,
+    cfg,
+    axis_name: str,
+    g_loss,
+    grad_mode: str,
+):
+    n_local, dim = feats.shape
+    num_shards = jax.lax.axis_size(axis_name)
+
+    def weight_tile(sims, same, diff):
+        sel = selection_mask(sims, same, diff, pos_thr, neg_thr, cfg)
+        sim_exp = jnp.exp(sims - max_all[:, None])
+        exp_pos = jnp.where(same & sel, sim_exp, 0.0)
+        exp_neg = jnp.where(diff & sel, sim_exp, 0.0)
+
+        def safe(num, den):
+            ok = den != 0
+            return jnp.where(
+                ok[:, None], num / jnp.where(ok, den, 1.0)[:, None], 0.0
+            )
+
+        p1 = safe(exp_pos, ident_sum)
+        p2 = safe(exp_pos, all_sum)
+        p3 = safe(exp_neg, all_sum)
+        return (-p1 + p2 + p3) * (g_loss / jnp.float32(n_local))
+
+    carry = {"grad_query": jnp.zeros((n_local, dim), jnp.float32)}
+    rotating = {
+        "f": feats,
+        "l": labels,
+        "rank": my_rank,
+        # The database-role grad for the block travels WITH the block;
+        # after G hops it returns to the owner holding the full sum —
+        # the ring equivalent of MPI_Allreduce(SUM) (cu:467-488).
+        "grad_db": jnp.zeros((n_local, dim), jnp.float32),
+    }
+
+    rotating["grad_db"] = jax.lax.pcast(
+        rotating["grad_db"], (axis_name,), to="varying"
+    )
+
+    def body(c, rot, step):
+        sims = _tile(feats, rot["f"])
+        same, diff = _block_masks(labels, rot["l"], my_rank, rot["rank"], n_local)
+        w = weight_tile(sims, same, diff)
+        c = dict(c)
+        c["grad_query"] = c["grad_query"] + jnp.dot(
+            w, rot["f"],
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        rot = dict(rot)
+        rot["grad_db"] = rot["grad_db"] + jnp.dot(
+            w.T, feats,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return c, rot
+
+    carry, rotating = _ring_scan(axis_name, body, carry, rotating)
+    # After G hops every block is back home: rotating["grad_db"] is this
+    # shard's database-role grad summed over all shards.
+    grad_db = rotating["grad_db"]
+    grad_query = carry["grad_query"]
+    if grad_mode == "reference":
+        # 1/G allreduce scale (cu:474) + 0.5/0.5 role merge (cu:492-497).
+        return 0.5 * grad_db / jnp.float32(num_shards) + 0.5 * grad_query
+    return grad_query + grad_db
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _ring_core(features, labels, cfg, axis_name, top_ks):
+    out, _ = _ring_fwd_impl(features, labels, cfg, axis_name, top_ks)
+    return out
+
+
+def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks):
+    features = features.astype(jnp.float32)
+    n_local = features.shape[0]
+    my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
+
+    top_k_max = max(top_ks) if top_ks else 1
+    stats = _stats_pass(features, labels, my_rank, axis_name, top_k_max)
+    pos_thr, neg_thr = _thresholds(stats, cfg, axis_name)
+    sums = _loss_pass(
+        features, labels, my_rank, pos_thr, neg_thr, stats["max_all"],
+        cfg, axis_name,
+    )
+    ident_sum = sums["ident_sum"]
+    all_sum = ident_sum + sums["diff_sum"]
+    valid = (ident_sum != 0) & (all_sum != 0)
+    log_q = jnp.where(
+        valid, jnp.log(jnp.where(valid, ident_sum / all_sum, 1.0)), 0.0
+    )
+    loss = -log_q.sum() / jnp.float32(n_local)
+
+    # Recall@k from the streamed top-(k+1) lists.  Threshold = the
+    # descending-sorted value at index min(k, size-1) over the exp'd row
+    # (cu:190); exp is monotone, so raw-sim comparison is equivalent.
+    n_total_minus1 = n_local * jax.lax.axis_size(axis_name) - 1
+    metrics: Dict[str, jax.Array] = {}
+    for k in top_ks:
+        thr_idx = jnp.minimum(k, n_total_minus1 - 1)
+        thr = jnp.take_along_axis(
+            stats["top_sims"], jnp.full((n_local, 1), thr_idx), axis=1
+        )[:, 0]
+        hit = jnp.any(
+            (stats["top_sims"] > thr[:, None]) & stats["top_same"], axis=1
+        )
+        metrics[f"retrieve_top{k}"] = (
+            hit.sum().astype(jnp.float32) / jnp.float32(n_local)
+        )
+    metrics["feature_asum"] = (
+        jnp.abs(features).sum() / jnp.float32(n_local)
+    )
+    metrics["ident_num"] = sums["ident_num"].sum()
+    metrics["diff_num"] = sums["diff_num"].sum()
+
+    residuals = {
+        "features": features,
+        "labels": labels,
+        "pos_thr": pos_thr,
+        "neg_thr": neg_thr,
+        "max_all": stats["max_all"],
+        "ident_sum": ident_sum,
+        "all_sum": all_sum,
+    }
+    return (loss, metrics), residuals
+
+
+def _ring_fwd(features, labels, cfg, axis_name, top_ks):
+    return _ring_fwd_impl(features, labels, cfg, axis_name, top_ks)
+
+
+def _ring_bwd(cfg, axis_name, top_ks, res, cotangents):
+    g_loss, _ = cotangents  # metrics are monitors, non-differentiable
+    my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
+    d_features = _backward_pass(
+        res["features"],
+        res["labels"],
+        my_rank,
+        res["pos_thr"],
+        res["neg_thr"],
+        res["max_all"],
+        res["ident_sum"],
+        res["all_sum"],
+        cfg,
+        axis_name,
+        g_loss,
+        cfg.grad_mode,
+    )
+    labels = res["labels"]
+    if jnp.issubdtype(labels.dtype, jnp.floating):
+        d_labels = jnp.zeros(labels.shape, labels.dtype)
+    else:
+        d_labels = np.zeros(labels.shape, jax.dtypes.float0)
+    return d_features, d_labels
+
+
+_ring_core.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_npair_loss_and_metrics(
+    features: jax.Array,
+    labels: jax.Array,
+    cfg: NPairLossConfig = NPairLossConfig(),
+    axis_name: str = "dp",
+    top_ks: Sequence[int] = (1, 5, 10),
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Blockwise-ring N-pair loss + retrieval metrics for one shard.
+
+    Call under ``shard_map`` over ``axis_name``.  Semantically identical
+    to ``npair_loss_with_aux`` + ``retrieval_metrics`` for absolute
+    mining methods, but never materializes the N x (N*G) matrix:
+    memory is O(N x N_block), blocks stream over the ring.
+
+    Gradient semantics follow ``cfg.grad_mode`` exactly like the dense
+    path ("reference": 0.5/0.5 role merge with the 1/G allreduce scale).
+    """
+    _check_cfg(cfg)
+    return _ring_core(features, labels, cfg, axis_name, tuple(top_ks))

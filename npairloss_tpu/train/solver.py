@@ -82,11 +82,26 @@ class Solver:
         axis: str = "dp",
         top_ks: Sequence[int] = (1, 5, 10),
         input_shape: Sequence[int] = (224, 224, 3),
+        use_ring: bool = False,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
         self.mesh = mesh
         self.axis = axis
+        # Ring-blockwise negative pooling (parallel.ring): streams the
+        # pair matrix instead of gathering it — for pools too large to
+        # materialize.  Requires absolute mining methods.
+        self.use_ring = use_ring
+        if use_ring:
+            from npairloss_tpu.parallel.ring import ring_supported
+
+            if mesh is None:
+                raise ValueError("use_ring requires a mesh")
+            if not ring_supported(loss_cfg):
+                raise ValueError(
+                    "ring mode supports absolute mining methods only "
+                    "(HARD/EASY/RAND); use the dense path for RELATIVE_*"
+                )
         self.top_ks = tuple(top_ks)
         self.input_shape = tuple(input_shape)
         self.state: Optional[Dict[str, Any]] = None
@@ -153,7 +168,20 @@ class Solver:
         """Per-shard loss under shard_map; scalars come back stacked (G,)."""
 
         def per_shard(e, l):
-            loss, metrics = self._loss_and_metrics(e, l)
+            if self.use_ring:
+                from npairloss_tpu.parallel.ring import (
+                    ring_npair_loss_and_metrics,
+                )
+
+                loss, metrics = ring_npair_loss_and_metrics(
+                    e, l, self.loss_cfg, self.axis, self.top_ks
+                )
+                metrics = {
+                    k: v for k, v in metrics.items()
+                    if k not in ("ident_num", "diff_num")
+                }
+            else:
+                loss, metrics = self._loss_and_metrics(e, l)
             out = {"loss": loss, **metrics}
             return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], out)
 

@@ -328,3 +328,35 @@ def test_listfile_tabs_and_multispace(tmp_path):
     assert ds.paths == ["a.png", "b.png"]
     assert ds.labels.tolist() == [0, 1]
     assert ds.load(1).shape == (4, 4, 3)
+
+
+def test_sampler_sequential_wrap_keeps_identities_distinct():
+    """A mid-batch wrap + reshuffle must not repeat an identity in-batch."""
+    labels = np.repeat(np.arange(6), 2)
+    s = IdentityBalancedSampler(
+        labels, 4, 2, rand_identity=False, shuffle=True, seed=0
+    )
+    for _ in range(100):
+        idx = next(s).reshape(4, 2)
+        ids = labels[idx[:, 0]]
+        assert len(set(ids.tolist())) == 4
+        assert (idx[:, 0] != idx[:, 1]).all()
+
+
+def test_loader_garbage_collected_without_close():
+    """Abandoned loaders must not pin the prefetch thread forever."""
+    import gc
+    import weakref as wr
+
+    images = np.zeros((8, 4, 4, 3), np.float32)
+    labels = np.repeat(np.arange(4), 2)
+    cfg = DataLayerConfig(identity_num_per_batch=2, img_num_per_identity=2)
+    loader = MultibatchLoader(ArrayDataset(images, labels), cfg, seed=0)
+    next(loader)
+    ref = wr.ref(loader)
+    thread = loader._thread
+    del loader
+    gc.collect()
+    assert ref() is None, "loader leaked (worker holds a strong ref)"
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()

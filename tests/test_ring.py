@@ -1,0 +1,235 @@
+"""Ring-blockwise loss parity: ring path ≡ dense gather path, per shard,
+for loss, metrics, and gradients in both grad modes (SURVEY.md §5.7)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from npairloss_tpu.ops.metrics import retrieval_metrics
+from npairloss_tpu.ops.npair_loss import (
+    MiningMethod,
+    MiningRegion,
+    NPairLossConfig,
+    npair_loss_with_aux,
+)
+from npairloss_tpu.parallel import data_parallel_mesh, ring_supported
+from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
+
+from conftest import make_identity_batch
+
+AXIS = "dp"
+
+
+def _mesh():
+    return data_parallel_mesh()
+
+
+def _make_inputs(rng, num_shards, num_ids=4, imgs=2, dim=16):
+    feats, labs = make_identity_batch(rng, num_ids, imgs, dim, num_shards)
+    return np.concatenate(feats), np.concatenate(labs)
+
+
+def _dense_fns(mesh, cfg, top_ks=(1, 5, 10)):
+    def per_shard(f, l):
+        loss, aux = npair_loss_with_aux(f, l, cfg, axis_name=AXIS)
+        m = retrieval_metrics(
+            jax.lax.stop_gradient(aux), l, jax.lax.stop_gradient(f), top_ks
+        )
+        return loss, m
+
+    def value(f, l):
+        loss, m = per_shard(f, l)
+        stack = lambda x: jnp.asarray(x)[None]
+        return stack(loss), jax.tree_util.tree_map(stack, m)
+
+    def grad(f, l):
+        g = jax.grad(lambda f_: per_shard(f_, l)[0])(f)
+        return g
+
+    value_sh = jax.jit(
+        jax.shard_map(
+            value, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS)),
+        )
+    )
+    grad_sh = jax.jit(
+        jax.shard_map(
+            grad, mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS)
+        )
+    )
+    return value_sh, grad_sh
+
+
+def _ring_fns(mesh, cfg, top_ks=(1, 5, 10)):
+    def per_shard(f, l):
+        loss, m = ring_npair_loss_and_metrics(f, l, cfg, AXIS, top_ks)
+        stack = lambda x: jnp.asarray(x)[None]
+        return stack(loss), jax.tree_util.tree_map(stack, m)
+
+    def grad(f, l):
+        g = jax.grad(
+            lambda f_: ring_npair_loss_and_metrics(f_, l, cfg, AXIS, top_ks)[0]
+        )(f)
+        return g
+
+    value_sh = jax.jit(
+        jax.shard_map(
+            per_shard, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS)),
+        )
+    )
+    grad_sh = jax.jit(
+        jax.shard_map(
+            grad, mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS)
+        )
+    )
+    return value_sh, grad_sh
+
+
+ABS_CONFIGS = [
+    NPairLossConfig(),  # proto defaults: LOCAL/RAND both sides
+    NPairLossConfig(
+        an_mining_method=MiningMethod.HARD, margin_diff=-0.05
+    ),  # def.prototxt AN side
+    NPairLossConfig(
+        ap_mining_method=MiningMethod.HARD,
+        ap_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.EASY,
+        margin_ident=0.1,
+    ),
+    NPairLossConfig(
+        ap_mining_method=MiningMethod.EASY,
+        an_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.HARD,
+    ),
+]
+
+
+@pytest.mark.parametrize("cfg_idx", range(len(ABS_CONFIGS)))
+def test_ring_matches_dense_loss_and_metrics(rng, cfg_idx):
+    cfg = ABS_CONFIGS[cfg_idx]
+    mesh = _mesh()
+    g = len(mesh.devices)
+    f, l = _make_inputs(rng, g)
+    dense_v, _ = _dense_fns(mesh, cfg)
+    ring_v, _ = _ring_fns(mesh, cfg)
+    dl, dm = dense_v(jnp.asarray(f), jnp.asarray(l))
+    rl, rm = ring_v(jnp.asarray(f), jnp.asarray(l))
+    np.testing.assert_allclose(np.asarray(rl), np.asarray(dl), rtol=2e-5, atol=1e-6)
+    for k in ("retrieve_top1", "retrieve_top5", "retrieve_top10", "feature_asum"):
+        np.testing.assert_allclose(
+            np.asarray(rm[k]), np.asarray(dm[k]), rtol=2e-5, atol=1e-6,
+            err_msg=k,
+        )
+
+
+@pytest.mark.parametrize("grad_mode", ["reference", "true"])
+def test_ring_matches_dense_grad(rng, grad_mode):
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        NPairLossConfig(an_mining_method=MiningMethod.HARD, margin_diff=-0.05),
+        grad_mode=grad_mode,
+    )
+    mesh = _mesh()
+    g = len(mesh.devices)
+    f, l = _make_inputs(rng, g)
+    _, dense_g = _dense_fns(mesh, cfg)
+    _, ring_g = _ring_fns(mesh, cfg)
+    dg = np.asarray(dense_g(jnp.asarray(f), jnp.asarray(l)))
+    rg = np.asarray(ring_g(jnp.asarray(f), jnp.asarray(l)))
+    assert np.isfinite(rg).all()
+    np.testing.assert_allclose(rg, dg, rtol=3e-5, atol=1e-6)
+
+
+def test_ring_rejects_relative_mining(rng):
+    cfg = NPairLossConfig(ap_mining_method=MiningMethod.RELATIVE_HARD)
+    assert not ring_supported(cfg)
+    mesh = _mesh()
+    with pytest.raises(NotImplementedError):
+        _ring_fns(mesh, cfg)[0](
+            jnp.zeros((8, 4), jnp.float32), jnp.zeros((8,), jnp.int32)
+        )
+
+
+def test_ring_ident_counts_match_dense(rng):
+    """Selected-pair counts stream correctly (identNum/diffNum parity)."""
+    cfg = NPairLossConfig(
+        an_mining_method=MiningMethod.HARD, margin_diff=-0.05
+    )
+    mesh = _mesh()
+    g = len(mesh.devices)
+    f, l = _make_inputs(rng, g)
+
+    def dense_counts(f_, l_):
+        _, aux = npair_loss_with_aux(f_, l_, cfg, axis_name=AXIS)
+        return aux["ident_num"].sum()[None], aux["diff_num"].sum()[None]
+
+    dc = jax.jit(
+        jax.shard_map(
+            dense_counts, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS)),
+        )
+    )
+    ring_v, _ = _ring_fns(mesh, cfg)
+    di, dd = dc(jnp.asarray(f), jnp.asarray(l))
+    _, rm = ring_v(jnp.asarray(f), jnp.asarray(l))
+    np.testing.assert_allclose(np.asarray(rm["ident_num"]), np.asarray(di))
+    np.testing.assert_allclose(np.asarray(rm["diff_num"]), np.asarray(dd))
+
+
+def test_ring_all_same_label_is_zero_loss(rng):
+    """No negatives anywhere -> D=0 -> log(I/I)=0 (zero-guard parity)."""
+    mesh = _mesh()
+    g = len(mesh.devices)
+    n, d = 4, 8
+    f = rng.standard_normal((g * n, d)).astype(np.float32)
+    f /= np.linalg.norm(f, axis=1, keepdims=True)
+    l = np.zeros((g * n,), np.int32)
+    ring_v, ring_g = _ring_fns(mesh, NPairLossConfig())
+    loss, _ = ring_v(jnp.asarray(f), jnp.asarray(l))
+    grads = np.asarray(ring_g(jnp.asarray(f), jnp.asarray(l)))
+    np.testing.assert_allclose(np.asarray(loss), 0.0, atol=1e-7)
+    assert np.isfinite(grads).all()
+
+
+def test_solver_ring_step_trains(rng):
+    """Full jitted training step with ring pooling over the 8-device mesh."""
+    import jax.numpy as jnp
+
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    mesh = _mesh()
+    g = len(mesh.devices)
+    solver = Solver(
+        get_model("mlp", hidden=(16,), embedding_dim=8),
+        NPairLossConfig(),
+        SolverConfig(base_lr=0.1, lr_policy="fixed", display=0, snapshot=0),
+        mesh=mesh,
+        input_shape=(12,),
+        use_ring=True,
+    )
+    from npairloss_tpu.data import synthetic_identity_batches
+
+    batches = synthetic_identity_batches(4 * g, 2 * g, 2, (12,), noise=0.6)
+    losses = []
+    for _ in range(12):
+        x, lab = next(batches)
+        m = solver.step(x, lab)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert min(losses[-4:]) <= max(losses[:4])
+
+
+def test_solver_ring_rejects_relative():
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver
+
+    cfg = NPairLossConfig(ap_mining_method=MiningMethod.RELATIVE_HARD)
+    with pytest.raises(ValueError, match="ring mode"):
+        Solver(get_model("mlp"), cfg, mesh=_mesh(), use_ring=True)
