@@ -68,6 +68,7 @@ class MiningMethod(enum.IntEnum):
 
 
 _RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
+_ABSOLUTE = (MiningMethod.HARD, MiningMethod.EASY, MiningMethod.RAND)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,23 +237,49 @@ def mining_thresholds(
     return pos_thr, neg_thr, max_all
 
 
+def streaming_supported(cfg: "NPairLossConfig") -> bool:
+    """True when the mining config needs only streamable min/max thresholds
+    (absolute methods); RELATIVE_* needs rank statistics over the full pair
+    population, which only the dense path computes.  Shared contract for
+    the ring (parallel.ring) and Pallas-blockwise (ops.pallas_npair) paths."""
+    return (
+        cfg.ap_mining_method in _ABSOLUTE and cfg.an_mining_method in _ABSOLUTE
+    )
+
+
+def absolute_thresholds(
+    min_within: jax.Array, max_between: jax.Array, cfg: "NPairLossConfig"
+) -> Tuple[jax.Array, jax.Array]:
+    """(pos_thr, neg_thr) from streamed per-query stats, absolute methods
+    only (cu:279, 296, 310, 327).  GLOBAL region means this rank's whole
+    N x (N*G) block — each rank's own extremum, no cross-rank reduction —
+    so it reduces over the query axis of the streamed stats."""
+    if cfg.ap_mining_region == MiningRegion.LOCAL:
+        pos_thr = max_between
+    else:
+        pos_thr = jnp.broadcast_to(max_between.max(), max_between.shape)
+    if cfg.an_mining_region == MiningRegion.LOCAL:
+        neg_thr = min_within
+    else:
+        neg_thr = jnp.broadcast_to(min_within.min(), min_within.shape)
+    return pos_thr, neg_thr
+
+
 # ---------------------------------------------------------------------------
 # Pair selection (reference: GetSampledPairMtx kernel, cu:69-122)
 # ---------------------------------------------------------------------------
 
 
-def selection_mask(
-    sims: jax.Array,
-    same: jax.Array,
-    diff: jax.Array,
-    pos_thr: jax.Array,
-    neg_thr: jax.Array,
-    cfg: NPairLossConfig,
-) -> jax.Array:
-    """0/1 per-pair selection mask; exact comparison operators of cu:80-119."""
-    pt = (pos_thr + jnp.float32(cfg.margin_ident))[:, None]
-    nt = (neg_thr + jnp.float32(cfg.margin_diff))[:, None]
+def selection_predicates(
+    sims: jax.Array, pt: jax.Array, nt: jax.Array, cfg: NPairLossConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """(pos_sel, neg_sel) comparison predicates of cu:80-119 against the
+    margin-adjusted thresholds ``pt``/``nt`` (broadcastable to sims).
 
+    The single home of the quirk-sensitive comparison directions — shared
+    by the dense path, the ring path and the Pallas-blockwise kernels so
+    the three can never desynchronize.
+    """
     m = cfg.ap_mining_method
     if m == MiningMethod.HARD:
         pos_sel = sims < pt
@@ -277,6 +304,21 @@ def selection_mask(
     else:  # RELATIVE_EASY
         neg_sel = sims <= nt
 
+    return pos_sel, neg_sel
+
+
+def selection_mask(
+    sims: jax.Array,
+    same: jax.Array,
+    diff: jax.Array,
+    pos_thr: jax.Array,
+    neg_thr: jax.Array,
+    cfg: NPairLossConfig,
+) -> jax.Array:
+    """0/1 per-pair selection mask; exact comparison operators of cu:80-119."""
+    pt = (pos_thr + jnp.float32(cfg.margin_ident))[:, None]
+    nt = (neg_thr + jnp.float32(cfg.margin_diff))[:, None]
+    pos_sel, neg_sel = selection_predicates(sims, pt, nt, cfg)
     return jnp.where(same, pos_sel, jnp.where(diff, neg_sel, False))
 
 

@@ -1,0 +1,624 @@
+"""Fused blockwise N-pair loss as Pallas TPU kernels.
+
+The dense path (``ops.npair_loss``) materializes the full N x M pair
+matrix (M = pool size) in HBM — the TPU transplant of the reference's
+``_innerProd`` workspace blob (reference: npair_multi_class_loss.cu:218,
+cpp:55-64).  At the 32k-batch stretch config that matrix is gigabytes,
+and HBM bandwidth (not MXU FLOPs) dominates: the matrix is written once
+and re-read by every stage (stats, selection, exp, reductions).
+
+These kernels never materialize it.  Queries and pool both stream
+through VMEM in (BN x BM) tiles over a 2-D grid; each tile is produced
+on the MXU and consumed in-register by the fused mask ->
+threshold-compare -> exp -> row-sum pipeline — the flash-attention trick
+transplanted to contrastive similarity (SURVEY.md §5.7), as explicit
+Pallas kernels for fusion control the XLA autofuser cannot guarantee
+across a gemm:
+
+  * ``_stats_kernel``  — running per-query min-within / max-between /
+    max-all (the mining statistics of cu:229-265; the reference runs
+    this O(N*M) scan on the *host*, one float at a time).
+  * ``_loss_kernel``   — selection mask from absolute thresholds
+    (cu:69-122), stabilized exp (cu:124-156), running I_q/D_q sums and
+    pair counts (cu:355-378).
+  * ``_gq_kernel`` / ``_gdb_kernel`` — recompute the weight tile
+    w = (-p1+p2+p3) * g/N (Get_Query_Diff_Part, cu:405-419) and
+    accumulate the two gemms of cu:448-460: query-role grad w @ pool
+    (pool axis innermost) and database-role grad w^T @ feats (query
+    axis innermost), so each output block stays VMEM-resident across
+    its whole accumulation.
+
+Mining-method support matches the ring path (``parallel.ring``): the
+absolute methods (HARD / EASY / RAND) stream exactly; RELATIVE_* needs
+rank statistics over the full pair population — use the dense path.
+
+On non-TPU backends the kernels run in Pallas interpreter mode, which is
+how the CPU test suite checks bit-parity against the dense path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from npairloss_tpu.ops.npair_loss import (
+    FLT_MAX,
+    NPairLossConfig,
+    absolute_thresholds,
+    selection_predicates,
+    streaming_supported,
+)
+
+# Same streaming contract as the ring path (parallel.ring).
+blockwise_supported = streaming_supported
+
+
+def _check_cfg(cfg: NPairLossConfig) -> None:
+    if not blockwise_supported(cfg):
+        raise NotImplementedError(
+            "blockwise kernels stream min/max thresholds only; RELATIVE_* "
+            "mining needs the dense path (npair_loss_with_aux)"
+        )
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _canon_labels(labels: jax.Array) -> jax.Array:
+    """Kernel-friendly labels WITHOUT collapsing identities: float labels
+    stay float32 (the dense path compares raw labels — int32 truncation
+    would merge e.g. 0.2 and 0.7), ints become int32."""
+    if jnp.issubdtype(labels.dtype, jnp.floating):
+        return labels.astype(jnp.float32)
+    return labels.astype(jnp.int32)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+def _pad_rows(x: jax.Array, block: int) -> jax.Array:
+    n = x.shape[0]
+    np_ = ((n + block - 1) // block) * block
+    if np_ == n:
+        return x
+    pad = [(0, np_ - n)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad)
+
+
+def _row(x):
+    """Per-query/per-pool scalar vectors travel as (1, N): the lane axis
+    carries the index, so TPU (8,128) tiling stores them compactly — a
+    (N, 1) layout would lane-pad every query to 128 floats and blow VMEM
+    at large N."""
+    return x.reshape(1, -1)
+
+
+def _tile_masks(scal_ref, labels_ref, pool_labels_ref, qi, ii, bn: int, bm: int):
+    """(same, diff) bool masks for tile (qi, ii) of the N x M pair grid.
+
+    Self-pair exclusion (cu:54): global pool column ``self_offset + row``
+    is this query's own embedding.  Padded rows (>= n_real) and padded
+    columns (>= m_real) are in neither mask, so every downstream
+    reduction and weight tile ignores them.
+    """
+    m_real = scal_ref[0]
+    self_offset = scal_ref[1]
+    n_real = scal_ref[2]
+    col = jax.lax.broadcasted_iota(jnp.int32, (bn, bm), 1) + ii * bm
+    row = jax.lax.broadcasted_iota(jnp.int32, (bn, bm), 0) + qi * bn
+    valid = (col < m_real) & (row < n_real)
+    not_self = col != (row + self_offset)
+    same_lbl = labels_ref[:].T == pool_labels_ref[:]
+    same = same_lbl & valid & not_self
+    diff = (~same_lbl) & valid & not_self
+    return same, diff
+
+
+def _sim_tile(feats_ref, pool_ref):
+    # HIGHEST keeps full fp32 on the MXU — the default would truncate to
+    # bf16 and break bit-parity with the dense path (cu:218 semantics).
+    return jnp.dot(
+        feats_ref[:],
+        pool_ref[:].T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _selection(sims, same, diff, pt, nt, cfg: NPairLossConfig):
+    """Tile selection via the shared quirk-exact predicates of cu:80-119
+    (ops.npair_loss.selection_predicates); cfg is static, so the
+    branches resolve at trace time."""
+    pos_sel, neg_sel = selection_predicates(sims, pt, nt, cfg)
+    return same & pos_sel, diff & neg_sel
+
+
+# ---------------------------------------------------------------------------
+# Kernels.  Grid convention: the output-resident axis is OUTER, the
+# accumulation axis is INNER, so each output block is initialized once
+# (inner index == 0) and accumulates in VMEM across the inner loop.
+# ---------------------------------------------------------------------------
+
+
+def _stats_kernel(
+    scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+    min_w_ref, max_b_ref, max_a_ref,
+):
+    # grid = (num_q_blocks, num_pool_blocks)
+    qi, ii = pl.program_id(0), pl.program_id(1)
+    bn, bm = feats_ref.shape[0], pool_ref.shape[0]
+    neg = jnp.float32(-FLT_MAX)
+    pos = jnp.float32(FLT_MAX)
+
+    @pl.when(ii == 0)
+    def _():
+        min_w_ref[:] = jnp.full_like(min_w_ref, pos)
+        max_b_ref[:] = jnp.full_like(max_b_ref, neg)
+        max_a_ref[:] = jnp.full_like(max_a_ref, neg)
+
+    sims = _sim_tile(feats_ref, pool_ref)
+    same, diff = _tile_masks(scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm)
+    min_w_ref[:] = jnp.minimum(
+        min_w_ref[:], jnp.where(same, sims, pos).min(axis=1, keepdims=True).T
+    )
+    max_b_ref[:] = jnp.maximum(
+        max_b_ref[:], jnp.where(diff, sims, neg).max(axis=1, keepdims=True).T
+    )
+    max_a_ref[:] = jnp.maximum(
+        max_a_ref[:],
+        jnp.where(same | diff, sims, neg).max(axis=1, keepdims=True).T,
+    )
+
+
+def _make_loss_kernel(cfg: NPairLossConfig):
+    def kernel(
+        scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+        pos_thr_ref, neg_thr_ref, max_all_ref,
+        isum_ref, dsum_ref, inum_ref, dnum_ref,
+    ):
+        qi, ii = pl.program_id(0), pl.program_id(1)
+        bn, bm = feats_ref.shape[0], pool_ref.shape[0]
+
+        @pl.when(ii == 0)
+        def _():
+            isum_ref[:] = jnp.zeros_like(isum_ref)
+            dsum_ref[:] = jnp.zeros_like(dsum_ref)
+            inum_ref[:] = jnp.zeros_like(inum_ref)
+            dnum_ref[:] = jnp.zeros_like(dnum_ref)
+
+        sims = _sim_tile(feats_ref, pool_ref)
+        same, diff = _tile_masks(
+            scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm
+        )
+        pt = pos_thr_ref[:].T + jnp.float32(cfg.margin_ident)
+        nt = neg_thr_ref[:].T + jnp.float32(cfg.margin_diff)
+        sel_pos, sel_neg = _selection(sims, same, diff, pt, nt, cfg)
+        sim_exp = jnp.exp(sims - max_all_ref[:].T)
+        isum_ref[:] += jnp.where(sel_pos, sim_exp, 0.0).sum(1, keepdims=True).T
+        dsum_ref[:] += jnp.where(sel_neg, sim_exp, 0.0).sum(1, keepdims=True).T
+        inum_ref[:] += sel_pos.sum(1, keepdims=True).astype(jnp.float32).T
+        dnum_ref[:] += sel_neg.sum(1, keepdims=True).astype(jnp.float32).T
+
+    return kernel
+
+
+def _weight_tile(cfg, scal_ref, feats_ref, labels_ref, pool_ref,
+                 pool_labels_ref, pos_thr_ref, neg_thr_ref, max_all_ref,
+                 isum_ref, asum_ref, valid_ref, g_ref, qi, ii):
+    """w = (-p1+p2+p3) * valid * g/N for one tile (cu:405-446).
+
+    valid_ref is all-ones in "reference" grad mode — the reference keeps
+    diff-type entries alive for identNum==0 queries (cu:133-146), so p3
+    still contributes — and the zero-loss-query mask in "true" mode,
+    where autodiff of the guarded log (cu:162-169) yields exactly 0.
+    """
+    bn, bm = feats_ref.shape[0], pool_ref.shape[0]
+    sims = _sim_tile(feats_ref, pool_ref)
+    same, diff = _tile_masks(scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm)
+    pt = pos_thr_ref[:].T + jnp.float32(cfg.margin_ident)
+    nt = neg_thr_ref[:].T + jnp.float32(cfg.margin_diff)
+    sel_pos, sel_neg = _selection(sims, same, diff, pt, nt, cfg)
+    # -p1+p2+p3 factors into per-query coefficients (keeps the live
+    # (bn, bm) temporaries to sims/coef/w so big tiles fit VMEM):
+    #   selected positive: a_q = -1/I_q + 1/(I+D)_q
+    #   selected negative: b_q =          1/(I+D)_q
+    # each 0-guarded per cu:412-417.
+    def inv(den):
+        ok = den != 0
+        return jnp.where(ok, 1.0 / jnp.where(ok, den, 1.0), 0.0)
+
+    # dot_normalizer = query count in backward (cu:427); n_real = scal[2].
+    scale = (g_ref[0] / scal_ref[2].astype(jnp.float32)) * valid_ref[:].T
+    a_q = (-inv(isum_ref[:].T) + inv(asum_ref[:].T)) * scale
+    b_q = inv(asum_ref[:].T) * scale
+    coef = jnp.where(sel_pos, a_q, jnp.where(sel_neg, b_q, 0.0))
+    # Masking must be where-based, not multiplicative: a query with no
+    # pairs has max_all = -FLT_MAX, so sim_exp overflows to +inf and
+    # inf * 0 would poison the gemms with NaN (same hazard the dense
+    # path guards, cu:152-154 semantics).
+    return jnp.where(
+        sel_pos | sel_neg, jnp.exp(sims - max_all_ref[:].T) * coef, 0.0
+    )
+
+
+def _make_gq_kernel(cfg: NPairLossConfig):
+    def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+               pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
+               valid_ref, g_ref, gq_ref):
+        # grid = (num_q_blocks, num_pool_blocks): pool axis accumulates.
+        qi, ii = pl.program_id(0), pl.program_id(1)
+
+        @pl.when(ii == 0)
+        def _():
+            gq_ref[:] = jnp.zeros_like(gq_ref)
+
+        w = _weight_tile(
+            cfg, scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+            pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
+            valid_ref, g_ref, qi, ii,
+        )
+        gq_ref[:] += jnp.dot(
+            w, pool_ref[:],
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    return kernel
+
+
+def _make_gdb_kernel(cfg: NPairLossConfig):
+    def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+               pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
+               valid_ref, g_ref, gdb_ref):
+        # grid = (num_pool_blocks, num_q_blocks): query axis accumulates.
+        ii, qi = pl.program_id(0), pl.program_id(1)
+
+        @pl.when(qi == 0)
+        def _():
+            gdb_ref[:] = jnp.zeros_like(gdb_ref)
+
+        w = _weight_tile(
+            cfg, scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+            pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
+            valid_ref, g_ref, qi, ii,
+        )
+        gdb_ref[:] += jnp.dot(
+            w.T, feats_ref[:],
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# Host-side wrappers
+# ---------------------------------------------------------------------------
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _qblock(shape, qpos: int):
+    """Matrix BlockSpec indexed by the grid's query axis at ``qpos``."""
+    if qpos == 0:
+        return pl.BlockSpec(shape, lambda q, i: (q, 0), memory_space=pltpu.VMEM)
+    return pl.BlockSpec(shape, lambda i, q: (q, 0), memory_space=pltpu.VMEM)
+
+
+def _qvec(b: int, qpos: int):
+    """(1, b) row-vector BlockSpec indexed by the grid's query axis."""
+    if qpos == 0:
+        return pl.BlockSpec((1, b), lambda q, i: (0, q), memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, b), lambda i, q: (0, q), memory_space=pltpu.VMEM)
+
+
+def _pblock(shape, ppos: int):
+    """Matrix BlockSpec indexed by the grid's pool axis at ``ppos``."""
+    if ppos == 0:
+        return pl.BlockSpec(shape, lambda i, q: (i, 0), memory_space=pltpu.VMEM)
+    return pl.BlockSpec(shape, lambda q, i: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _pvec(b: int, ppos: int):
+    """(1, b) row-vector BlockSpec indexed by the grid's pool axis."""
+    if ppos == 0:
+        return pl.BlockSpec((1, b), lambda i, q: (0, i), memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, b), lambda q, i: (0, i), memory_space=pltpu.VMEM)
+
+
+def _data_specs(bn: int, bm: int, dim: int, q_axis: int):
+    """Specs for (scalars, feats, labels, pool, pool_labels) with the
+    query axis at grid position ``q_axis`` (pool axis at the other)."""
+    p_axis = 1 - q_axis
+    return [
+        _smem_spec(),
+        _qblock((bn, dim), q_axis),
+        _qvec(bn, q_axis),
+        _pblock((bm, dim), p_axis),
+        _pvec(bm, p_axis),
+    ]
+
+
+def _run_stats(feats_p, labels_p, pool_p, pool_labels_p, scal,
+               bn, bm, interpret):
+    npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
+    npi = pool_p.shape[0] // bm
+    out = pl.pallas_call(
+        _stats_kernel,
+        grid=(npq, npi),
+        in_specs=_data_specs(bn, bm, dim, 0),
+        out_specs=[_qvec(bn, 0)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((1, feats_p.shape[0]), jnp.float32)] * 3,
+        interpret=interpret,
+    )(scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p))
+    return tuple(o[0, :] for o in out)
+
+
+def _run_loss(feats_p, labels_p, pool_p, pool_labels_p, scal,
+              pos_thr_p, neg_thr_p, max_all_p, cfg, bn, bm, interpret):
+    npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
+    npi = pool_p.shape[0] // bm
+    specs = _data_specs(bn, bm, dim, 0) + [_qvec(bn, 0)] * 3
+    out = pl.pallas_call(
+        _make_loss_kernel(cfg),
+        grid=(npq, npi),
+        in_specs=specs,
+        out_specs=[_qvec(bn, 0)] * 4,
+        out_shape=[jax.ShapeDtypeStruct((1, feats_p.shape[0]), jnp.float32)] * 4,
+        interpret=interpret,
+    )(
+        scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
+        _row(pos_thr_p), _row(neg_thr_p), _row(max_all_p),
+    )
+    return tuple(o[0, :] for o in out)
+
+
+def _run_bwd(feats_p, labels_p, pool_p, pool_labels_p, scal,
+             pos_thr_p, neg_thr_p, max_all_p, ident_sum_p, all_sum_p,
+             valid_p, g, cfg, bn, bm, interpret):
+    npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
+    npi = pool_p.shape[0] // bm
+    g_arr = jnp.asarray(g, jnp.float32).reshape(1)
+    args = (
+        scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
+        _row(pos_thr_p), _row(neg_thr_p), _row(max_all_p),
+        _row(ident_sum_p), _row(all_sum_p), _row(valid_p), g_arr,
+    )
+    gq = pl.pallas_call(
+        _make_gq_kernel(cfg),
+        grid=(npq, npi),
+        in_specs=_data_specs(bn, bm, dim, 0)
+        + [_qvec(bn, 0)] * 6 + [_smem_spec()],
+        out_specs=_qblock((bn, dim), 0),
+        out_shape=jax.ShapeDtypeStruct((feats_p.shape[0], dim), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    gdb = pl.pallas_call(
+        _make_gdb_kernel(cfg),
+        grid=(npi, npq),
+        in_specs=_data_specs(bn, bm, dim, 1)
+        + [_qvec(bn, 1)] * 6 + [_smem_spec()],
+        out_specs=_pblock((bm, dim), 0),
+        out_shape=jax.ShapeDtypeStruct((pool_p.shape[0], dim), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return gq, gdb
+
+
+# ---------------------------------------------------------------------------
+# Public API: self-pool loss with custom VJP (dense-path parity, G = 1)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _blockwise_core(features, labels, cfg, bn, bm, interpret):
+    out, _ = _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret)
+    return out
+
+
+def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret):
+    features = features.astype(jnp.float32)
+    labels_i = _canon_labels(labels)
+    n = features.shape[0]
+    feats_p = _pad_rows(features, bn)
+    labels_qp = _pad_rows(labels_i, bn)
+    pool_p = _pad_rows(features, bm)
+    pool_labels_p = _pad_rows(labels_i, bm)
+    scal = jnp.array([n, 0, n], jnp.int32)  # [m_real, self_offset, n_real]
+
+    min_w, max_b, max_all = _run_stats(
+        feats_p, labels_qp, pool_p, pool_labels_p, scal, bn, bm, interpret
+    )
+    min_w, max_b, max_all = min_w[:n], max_b[:n], max_all[:n]
+    pos_thr, neg_thr = absolute_thresholds(min_w, max_b, cfg)
+    out = _run_loss(
+        feats_p, labels_qp, pool_p, pool_labels_p, scal,
+        _pad_rows(pos_thr, bn), _pad_rows(neg_thr, bn), _pad_rows(max_all, bn),
+        cfg, bn, bm, interpret,
+    )
+    isum, dsum, inum, dnum = (o[:n] for o in out)
+    all_sum = isum + dsum
+    valid = (isum != 0) & (all_sum != 0)
+    log_q = jnp.where(valid, jnp.log(jnp.where(valid, isum / all_sum, 1.0)), 0.0)
+    loss = -log_q.sum() / jnp.float32(n)
+
+    aux = {
+        "ident_num": inum,
+        "diff_num": dnum,
+        "pos_threshold": pos_thr,
+        "neg_threshold": neg_thr,
+    }
+    residuals = {
+        "features": features,
+        "labels": labels,
+        "pos_thr": pos_thr,
+        "neg_thr": neg_thr,
+        "max_all": max_all,
+        "ident_sum": isum,
+        "all_sum": all_sum,
+    }
+    return (loss, aux), residuals
+
+
+def _blockwise_fwd(features, labels, cfg, bn, bm, interpret):
+    return _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret)
+
+
+def _blockwise_bwd(cfg, bn, bm, interpret, res, cotangents):
+    g, _ = cotangents  # aux outputs are monitors
+    features = res["features"]
+    labels = res["labels"]
+    labels_i = _canon_labels(labels)
+    n = features.shape[0]
+    if cfg.grad_mode == "reference":
+        valid = jnp.ones((n,), jnp.float32)
+    else:
+        valid = (
+            (res["ident_sum"] != 0) & (res["all_sum"] != 0)
+        ).astype(jnp.float32)
+    scal = jnp.array([n, 0, n], jnp.int32)
+    gq, gdb = _run_bwd(
+        _pad_rows(features, bn), _pad_rows(labels_i, bn),
+        _pad_rows(features, bm), _pad_rows(labels_i, bm), scal,
+        _pad_rows(res["pos_thr"], bn), _pad_rows(res["neg_thr"], bn),
+        _pad_rows(res["max_all"], bn), _pad_rows(res["ident_sum"], bn),
+        _pad_rows(res["all_sum"], bn), _pad_rows(valid, bn),
+        g, cfg, bn, bm, interpret,
+    )
+    gq, gdb = gq[:n], gdb[:n]
+    if cfg.grad_mode == "reference":
+        # G = 1 specialization of cu:462-497: allreduce is the identity,
+        # 1/G = 1, own rows are the whole database grad; 0.5/0.5 merge.
+        d_features = 0.5 * gdb + 0.5 * gq
+    else:
+        d_features = gq + gdb
+    if jnp.issubdtype(labels.dtype, jnp.floating):
+        d_labels = jnp.zeros(labels.shape, labels.dtype)
+    else:
+        d_labels = np.zeros(labels.shape, jax.dtypes.float0)
+    return d_features, d_labels
+
+
+_blockwise_core.defvjp(_blockwise_fwd, _blockwise_bwd)
+
+
+def blockwise_npair_loss_with_aux(
+    features: jax.Array,
+    labels: jax.Array,
+    cfg: NPairLossConfig = NPairLossConfig(),
+    block_size: int = 512,
+    q_block_size: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """N-pair loss over a self-pool too large for the dense N x N matrix.
+
+    Semantically identical (loss and gradient) to
+    ``npair_loss_with_aux(features, labels, cfg)`` for absolute mining
+    methods, but peak memory is O(q_block x D + block x D + q_block x
+    block) VMEM per tile — the pair matrix is produced and consumed
+    tile-by-tile inside Pallas kernels.  ``aux`` carries the
+    streaming-computable monitors (pair counts, thresholds) — the full
+    similarity matrices of the dense aux are exactly what this path
+    exists to avoid.
+    """
+    _check_cfg(cfg)
+    if interpret is None:
+        interpret = _default_interpret()
+    n = features.shape[0]
+    bm = int(min(block_size, max(n, 1)))
+    bn = int(min(q_block_size or block_size, max(n, 1)))
+    if not interpret:
+        # Mosaic requires block dims divisible by the (8, 128) tiling
+        # (unless equal to the full padded dim); the block index appears
+        # as both a sublane dim (matrix tiles) and a lane dim ((1, b)
+        # stat vectors), so round to 128.  _pad_rows absorbs overshoot.
+        bn, bm = _round_up(bn, 128), _round_up(bm, 128)
+    return _blockwise_core(features, labels, cfg, bn, bm, interpret)
+
+
+def blockwise_npair_loss(features, labels, cfg=NPairLossConfig(),
+                         block_size: int = 512,
+                         q_block_size: Optional[int] = None,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Scalar blockwise N-pair loss (see ``blockwise_npair_loss_with_aux``)."""
+    return blockwise_npair_loss_with_aux(
+        features, labels, cfg, block_size, q_block_size, interpret
+    )[0]
+
+
+# ---------------------------------------------------------------------------
+# Streamed retrieval metrics (pure-JAX scan; no N x M matrix)
+# ---------------------------------------------------------------------------
+
+
+def blockwise_retrieval_metrics(
+    features: jax.Array,
+    labels: jax.Array,
+    top_ks: Sequence[int] = (1, 5, 10),
+    block_size: int = 512,
+) -> Dict[str, jax.Array]:
+    """Recall@k + feature_asum with the reference's exact threshold/tie
+    semantics (cu:182-197), streaming the pool in blocks via lax.scan.
+
+    Keeps a running top-(k_max+1) list per query (exp is monotone, so raw
+    similarities give identical ranks to the reference's exp'd rows).
+    """
+    features = features.astype(jnp.float32)
+    labels = _canon_labels(labels)
+    n = features.shape[0]
+    neg = jnp.float32(-FLT_MAX)
+    k_max = max(top_ks)
+    block = int(min(block_size, max(n, 1)))
+    pool = _pad_rows(features, block)
+    pool_labels = _pad_rows(labels, block)
+    nblocks = pool.shape[0] // block
+    pool = pool.reshape(nblocks, block, -1)
+    pool_labels = pool_labels.reshape(nblocks, block)
+
+    def step(carry, blk):
+        top_sims, top_same = carry
+        bf, bl, idx = blk
+        sims = jnp.dot(
+            features, bf.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        col = idx * block + jnp.arange(block, dtype=jnp.int32)[None, :]
+        row = jnp.arange(n, dtype=jnp.int32)[:, None]
+        nonself = (col != row) & (col < n)
+        same = (labels[:, None] == bl[None, :]) & nonself
+        cat_sims = jnp.concatenate(
+            [top_sims, jnp.where(nonself, sims, neg)], axis=1
+        )
+        cat_same = jnp.concatenate([top_same, same], axis=1)
+        top_sims, idx2 = jax.lax.top_k(cat_sims, top_sims.shape[1])
+        top_same = jnp.take_along_axis(cat_same, idx2, axis=1)
+        return (top_sims, top_same), None
+
+    carry = (
+        jnp.full((n, k_max + 1), neg),
+        jnp.zeros((n, k_max + 1), bool),
+    )
+    (top_sims, top_same), _ = jax.lax.scan(
+        step, carry,
+        (pool, pool_labels, jnp.arange(nblocks, dtype=jnp.int32)),
+    )
+
+    out: Dict[str, jax.Array] = {}
+    for k in top_ks:
+        thr_idx = min(k, n - 2)
+        thr = top_sims[:, thr_idx]
+        hit = jnp.any((top_sims > thr[:, None]) & top_same, axis=1)
+        out[f"retrieve_top{k}"] = hit.sum().astype(jnp.float32) / jnp.float32(n)
+    out["feature_asum"] = jnp.abs(features).sum() / jnp.float32(n)
+    return out

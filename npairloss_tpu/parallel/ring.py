@@ -47,20 +47,14 @@ import numpy as np
 
 from npairloss_tpu.ops.npair_loss import (
     FLT_MAX,
-    MiningMethod,
-    MiningRegion,
     NPairLossConfig,
+    absolute_thresholds,
     selection_mask,
+    streaming_supported,
 )
 
-_ABSOLUTE = (MiningMethod.HARD, MiningMethod.EASY, MiningMethod.RAND)
-
-
-def ring_supported(cfg: NPairLossConfig) -> bool:
-    """True when the mining config streams (no rank statistics needed)."""
-    return (
-        cfg.ap_mining_method in _ABSOLUTE and cfg.an_mining_method in _ABSOLUTE
-    )
+# Same streaming contract as the Pallas-blockwise path (ops.pallas_npair).
+ring_supported = streaming_supported
 
 
 def _check_cfg(cfg: NPairLossConfig) -> None:
@@ -185,28 +179,6 @@ def _stats_pass(
     return carry
 
 
-def _thresholds(stats, cfg: NPairLossConfig, axis_name: str):
-    """Absolute thresholds from streamed stats (cu:279, 296, 310, 327).
-
-    GLOBAL region means this RANK's whole N x (N*G) block (each rank
-    computes its own block-wide extremum in the reference, with no
-    cross-rank reduction) — so it reduces over queries, not shards.
-    """
-    if cfg.ap_mining_region == MiningRegion.LOCAL:
-        pos_thr = stats["max_between"]
-    else:
-        pos_thr = jnp.broadcast_to(
-            stats["max_between"].max(), stats["max_between"].shape
-        )
-    if cfg.an_mining_region == MiningRegion.LOCAL:
-        neg_thr = stats["min_within"]
-    else:
-        neg_thr = jnp.broadcast_to(
-            stats["min_within"].min(), stats["min_within"].shape
-        )
-    return pos_thr, neg_thr
-
-
 # ---------------------------------------------------------------------------
 # Pass 2: selection + stabilized exp sums (+ counts)
 # ---------------------------------------------------------------------------
@@ -279,7 +251,14 @@ def _backward_pass(
         p1 = safe(exp_pos, ident_sum)
         p2 = safe(exp_pos, all_sum)
         p3 = safe(exp_neg, all_sum)
-        return (-p1 + p2 + p3) * (g_loss / jnp.float32(n_local))
+        w = (-p1 + p2 + p3) * (g_loss / jnp.float32(n_local))
+        if grad_mode != "reference":
+            # "true" autodiff of the guarded log (cu:162-169 semantics)
+            # gives exactly 0 for zero-loss queries; the reference path
+            # keeps p3 alive for identNum==0 queries (cu:133-146).
+            valid = (ident_sum != 0) & (all_sum != 0)
+            w = jnp.where(valid[:, None], w, 0.0)
+        return w
 
     carry = {"grad_query": jnp.zeros((n_local, dim), jnp.float32)}
     rotating = {
@@ -343,7 +322,9 @@ def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks):
 
     top_k_max = max(top_ks) if top_ks else 1
     stats = _stats_pass(features, labels, my_rank, axis_name, top_k_max)
-    pos_thr, neg_thr = _thresholds(stats, cfg, axis_name)
+    pos_thr, neg_thr = absolute_thresholds(
+        stats["min_within"], stats["max_between"], cfg
+    )
     sums = _loss_pass(
         features, labels, my_rank, pos_thr, neg_thr, stats["max_all"],
         cfg, axis_name,
