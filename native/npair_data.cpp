@@ -1,0 +1,621 @@
+// Native data runtime: the TPU-side equivalent of the reference's C++
+// MultibatchData layer (implied host framework, SURVEY.md §1 L1, §3.5).
+//
+// The reference decodes, resizes and assembles identity-balanced batches
+// on CPU prefetch threads inside Caffe (usage/def.prototxt:2-29:
+// root_folder + source list, batch = identity_num_per_batch x
+// img_num_per_identity, shuffle, new_height/new_width).  This library
+// reproduces that host runtime natively for the JAX framework:
+//
+//   * list-file dataset ("relative/path label" rows),
+//   * identity-balanced sampler (same contract as
+//     npairloss_tpu.data.sampler: distinct identities per batch,
+//     within-identity draw-without-replacement with refill, replacement
+//     only for degenerate identities),
+//   * image decode (PPM/PGM, BMP 24/32-bit, NPY uint8) + bilinear
+//     resize with OpenCV's half-pixel-center convention (what Caffe's
+//     cv::resize INTER_LINEAR used),
+//   * a worker thread pool filling a bounded prefetch ring of uint8
+//     NHWC batch buffers.
+//
+// Exposed as a C ABI consumed via ctypes (npairloss_tpu/data/native.py).
+// Augmentation stays on-device (data/transforms.py) — the host's job is
+// only sample/decode/resize/assemble, which is exactly what this does.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+// ---------------------------------------------------------------------------
+// Decoders -> uint8 RGB, row-major HWC
+// ---------------------------------------------------------------------------
+
+struct Image {
+  int h = 0, w = 0;
+  std::vector<uint8_t> rgb;  // h*w*3
+};
+
+bool read_file(const std::string& path, std::vector<uint8_t>& out) {
+  std::ifstream f(path, std::ios::binary | std::ios::ate);
+  if (!f) {
+    set_error("cannot open file: " + path);
+    return false;
+  }
+  std::streamsize size = f.tellg();
+  f.seekg(0);
+  out.resize(static_cast<size_t>(size));
+  if (!f.read(reinterpret_cast<char*>(out.data()), size)) {
+    set_error("short read: " + path);
+    return false;
+  }
+  return true;
+}
+
+// PPM (P6) / PGM (P5), binary variants with maxval <= 255.
+bool decode_pnm(const std::vector<uint8_t>& buf, Image& img) {
+  std::istringstream hs(std::string(
+      reinterpret_cast<const char*>(buf.data()),
+      std::min<size_t>(buf.size(), 512)));
+  std::string magic;
+  hs >> magic;
+  const bool color = magic == "P6";
+  if (!color && magic != "P5") {
+    set_error("not a binary PNM");
+    return false;
+  }
+  int vals[3], got = 0;
+  while (got < 3) {
+    // Skip whitespace and '#' comments between header tokens.
+    int c = hs.peek();
+    if (c == '#') {
+      std::string line;
+      std::getline(hs, line);
+      continue;
+    }
+    if (std::isspace(c)) {
+      hs.get();
+      continue;
+    }
+    if (!(hs >> vals[got])) {
+      set_error("bad PNM header");
+      return false;
+    }
+    ++got;
+  }
+  if (vals[2] <= 0 || vals[2] > 255) {
+    set_error("PNM maxval > 255 unsupported");
+    return false;
+  }
+  if (vals[0] <= 0 || vals[1] <= 0) {
+    set_error("PNM dimensions must be positive");
+    return false;
+  }
+  img.w = vals[0];
+  img.h = vals[1];
+  // Pixel data starts after exactly one whitespace char past maxval.
+  size_t offset = static_cast<size_t>(hs.tellg()) + 1;
+  const size_t ch = color ? 3 : 1;
+  const size_t need = static_cast<size_t>(img.h) * img.w * ch;
+  if (buf.size() < offset + need) {
+    set_error("truncated PNM pixel data");
+    return false;
+  }
+  img.rgb.resize(static_cast<size_t>(img.h) * img.w * 3);
+  const uint8_t* src = buf.data() + offset;
+  if (color) {
+    std::memcpy(img.rgb.data(), src, need);
+  } else {
+    for (size_t i = 0; i < need; ++i) {
+      img.rgb[3 * i] = img.rgb[3 * i + 1] = img.rgb[3 * i + 2] = src[i];
+    }
+  }
+  return true;
+}
+
+uint32_t le32(const uint8_t* p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16) | (uint32_t(p[3]) << 24);
+}
+
+// Uncompressed 24/32-bit BMP (BGR(A), bottom-up or top-down).
+bool decode_bmp(const std::vector<uint8_t>& buf, Image& img) {
+  if (buf.size() < 54) {
+    set_error("truncated BMP header");
+    return false;
+  }
+  const uint32_t pix_off = le32(&buf[10]);
+  const int32_t w = static_cast<int32_t>(le32(&buf[18]));
+  int32_t h = static_cast<int32_t>(le32(&buf[22]));
+  const uint16_t bpp = buf[28] | (buf[29] << 8);
+  const uint32_t compression = le32(&buf[30]);
+  if (compression != 0 || (bpp != 24 && bpp != 32)) {
+    set_error("only uncompressed 24/32-bit BMP supported");
+    return false;
+  }
+  const bool bottom_up = h > 0;
+  if (h < 0) h = -h;
+  if (w <= 0 || h == 0) {
+    set_error("BMP dimensions must be positive");
+    return false;
+  }
+  const int bytes = bpp / 8;
+  const size_t stride = (static_cast<size_t>(w) * bytes + 3) & ~size_t(3);
+  if (buf.size() < pix_off + stride * h) {
+    set_error("truncated BMP pixel data");
+    return false;
+  }
+  img.w = w;
+  img.h = h;
+  img.rgb.resize(static_cast<size_t>(h) * w * 3);
+  for (int y = 0; y < h; ++y) {
+    const int src_y = bottom_up ? (h - 1 - y) : y;
+    const uint8_t* row = buf.data() + pix_off + stride * src_y;
+    uint8_t* dst = img.rgb.data() + static_cast<size_t>(y) * w * 3;
+    for (int x = 0; x < w; ++x) {
+      dst[3 * x + 0] = row[bytes * x + 2];  // BGR -> RGB
+      dst[3 * x + 1] = row[bytes * x + 1];
+      dst[3 * x + 2] = row[bytes * x + 0];
+    }
+  }
+  return true;
+}
+
+// NPY v1/v2, uint8 ('|u1'), C-order, shape (H, W), (H, W, 1) or (H, W, 3).
+bool decode_npy(const std::vector<uint8_t>& buf, Image& img) {
+  if (buf.size() < 10 || std::memcmp(buf.data(), "\x93NUMPY", 6) != 0) {
+    set_error("not an NPY file");
+    return false;
+  }
+  const int major = buf[6];
+  size_t hlen, data_off;
+  if (major == 1) {
+    hlen = buf[8] | (buf[9] << 8);
+    data_off = 10 + hlen;
+  } else {
+    if (buf.size() < 12) {
+      set_error("truncated NPY header");
+      return false;
+    }
+    hlen = le32(&buf[8]);
+    data_off = 12 + hlen;
+  }
+  if (buf.size() < data_off) {
+    set_error("truncated NPY header");
+    return false;
+  }
+  std::string header(reinterpret_cast<const char*>(
+                         buf.data() + (major == 1 ? 10 : 12)), hlen);
+  if (header.find("|u1") == std::string::npos) {
+    set_error("NPY dtype must be uint8 ('|u1')");
+    return false;
+  }
+  if (header.find("'fortran_order': False") == std::string::npos) {
+    set_error("NPY must be C-order");
+    return false;
+  }
+  const size_t sp = header.find("'shape': (");
+  if (sp == std::string::npos) {
+    set_error("NPY header missing shape");
+    return false;
+  }
+  std::vector<long> dims;
+  {
+    std::istringstream ss(header.substr(sp + 10));
+    long v;
+    while (ss >> v) {
+      dims.push_back(v);
+      while (ss.peek() == ',' || ss.peek() == ' ') ss.get();
+      if (ss.peek() == ')') break;
+    }
+  }
+  int ch;
+  if (dims.size() == 2 || (dims.size() == 3 && dims[2] == 1)) {
+    ch = 1;
+  } else if (dims.size() == 3 && dims[2] == 3) {
+    ch = 3;
+  } else {
+    set_error("NPY shape must be (H,W), (H,W,1) or (H,W,3)");
+    return false;
+  }
+  if (dims[0] <= 0 || dims[1] <= 0) {
+    set_error("NPY dimensions must be positive");
+    return false;
+  }
+  img.h = static_cast<int>(dims[0]);
+  img.w = static_cast<int>(dims[1]);
+  const size_t need = static_cast<size_t>(img.h) * img.w * ch;
+  if (buf.size() < data_off + need) {
+    set_error("truncated NPY data");
+    return false;
+  }
+  const uint8_t* src = buf.data() + data_off;
+  img.rgb.resize(static_cast<size_t>(img.h) * img.w * 3);
+  if (ch == 3) {
+    std::memcpy(img.rgb.data(), src, need);
+  } else {
+    for (size_t i = 0; i < need; ++i) {
+      img.rgb[3 * i] = img.rgb[3 * i + 1] = img.rgb[3 * i + 2] = src[i];
+    }
+  }
+  return true;
+}
+
+bool decode_image(const std::vector<uint8_t>& buf, Image& img) {
+  if (buf.size() >= 2 && buf[0] == 'P' && (buf[1] == '5' || buf[1] == '6'))
+    return decode_pnm(buf, img);
+  if (buf.size() >= 2 && buf[0] == 'B' && buf[1] == 'M')
+    return decode_bmp(buf, img);
+  if (buf.size() >= 6 && std::memcmp(buf.data(), "\x93NUMPY", 6) == 0)
+    return decode_npy(buf, img);
+  set_error("unsupported image format (supported: PPM/PGM, BMP, NPY-u8)");
+  return false;
+}
+
+// Bilinear resize, OpenCV INTER_LINEAR convention (half-pixel centers):
+// src = (dst + 0.5) * scale - 0.5, border-clamped — what Caffe's
+// cv::resize did in the reference's implied data layer.
+void bilinear_resize(const Image& src, int dh, int dw, uint8_t* dst) {
+  if (src.h == dh && src.w == dw) {
+    std::memcpy(dst, src.rgb.data(), static_cast<size_t>(dh) * dw * 3);
+    return;
+  }
+  const float sy = static_cast<float>(src.h) / dh;
+  const float sx = static_cast<float>(src.w) / dw;
+  std::vector<int> x0s(dw), x1s(dw);
+  std::vector<float> wxs(dw);
+  for (int x = 0; x < dw; ++x) {
+    float fx = (x + 0.5f) * sx - 0.5f;
+    if (fx < 0) fx = 0;
+    int x0 = static_cast<int>(fx);
+    if (x0 > src.w - 1) x0 = src.w - 1;
+    x0s[x] = x0;
+    x1s[x] = std::min(x0 + 1, src.w - 1);
+    wxs[x] = fx - x0;
+  }
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    int y0 = static_cast<int>(fy);
+    if (y0 > src.h - 1) y0 = src.h - 1;
+    const int y1 = std::min(y0 + 1, src.h - 1);
+    const float wy = fy - y0;
+    const uint8_t* r0 = src.rgb.data() + static_cast<size_t>(y0) * src.w * 3;
+    const uint8_t* r1 = src.rgb.data() + static_cast<size_t>(y1) * src.w * 3;
+    uint8_t* out = dst + static_cast<size_t>(y) * dw * 3;
+    for (int x = 0; x < dw; ++x) {
+      const int x0 = 3 * x0s[x], x1 = 3 * x1s[x];
+      const float wx = wxs[x];
+      for (int c = 0; c < 3; ++c) {
+        const float top = r0[x0 + c] + (r0[x1 + c] - r0[x0 + c]) * wx;
+        const float bot = r1[x0 + c] + (r1[x1 + c] - r1[x0 + c]) * wx;
+        const float v = top + (bot - top) * wy;
+        out[3 * x + c] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Dataset
+// ---------------------------------------------------------------------------
+
+struct Dataset {
+  std::string root;
+  std::vector<std::string> paths;
+  std::vector<int64_t> labels;
+  int new_h = 0, new_w = 0;
+
+  bool load_into(size_t index, uint8_t* dst, int* out_h, int* out_w) const {
+    std::string full = root;
+    if (!full.empty() && full.back() != '/') full += '/';
+    full += paths[index];
+    std::vector<uint8_t> buf;
+    Image img;
+    if (!read_file(full, buf) || !decode_image(buf, img)) return false;
+    const int dh = new_h > 0 ? new_h : img.h;
+    const int dw = new_w > 0 ? new_w : img.w;
+    *out_h = dh;
+    *out_w = dw;
+    bilinear_resize(img, dh, dw, dst);
+    return true;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Identity-balanced sampler (contract of npairloss_tpu.data.sampler)
+// ---------------------------------------------------------------------------
+
+struct Sampler {
+  std::vector<int64_t> identities;                       // sorted unique
+  std::unordered_map<int64_t, std::vector<int64_t>> by_identity;
+  std::unordered_map<int64_t, std::vector<int64_t>> pools;  // w/o-replacement
+  std::vector<int64_t> id_order;                         // sequential mode
+  size_t id_pos = 0;
+  int ids_per_batch, imgs_per_id;
+  bool rand_identity, shuffle;
+  std::mt19937_64 rng;
+
+  Sampler(const std::vector<int64_t>& labels, int ids, int imgs,
+          bool rand_id, bool shuf, uint64_t seed)
+      : ids_per_batch(ids), imgs_per_id(imgs), rand_identity(rand_id),
+        shuffle(shuf), rng(seed) {
+    for (size_t i = 0; i < labels.size(); ++i)
+      by_identity[labels[i]].push_back(static_cast<int64_t>(i));
+    identities.reserve(by_identity.size());
+    for (auto& kv : by_identity) identities.push_back(kv.first);
+    std::sort(identities.begin(), identities.end());
+    id_order = identities;
+    if (shuffle) std::shuffle(id_order.begin(), id_order.end(), rng);
+  }
+
+  void draw_images(int64_t identity, std::vector<int64_t>& out) {
+    auto& pool = by_identity[identity];
+    if (static_cast<int>(pool.size()) < imgs_per_id) {
+      // Degenerate identity: with replacement (batch contract must hold
+      // for the mining statistics).
+      std::uniform_int_distribution<size_t> d(0, pool.size() - 1);
+      for (int i = 0; i < imgs_per_id; ++i) out.push_back(pool[d(rng)]);
+      return;
+    }
+    std::vector<int64_t> picked;
+    while (static_cast<int>(picked.size()) < imgs_per_id) {
+      auto& cached = pools[identity];
+      if (cached.empty()) {
+        // Refill excluding this batch's picks: a group never holds the
+        // same image twice.
+        for (int64_t i : pool)
+          if (std::find(picked.begin(), picked.end(), i) == picked.end())
+            cached.push_back(i);
+        if (shuffle) std::shuffle(cached.begin(), cached.end(), rng);
+      }
+      picked.push_back(cached.back());
+      cached.pop_back();
+    }
+    out.insert(out.end(), picked.begin(), picked.end());
+  }
+
+  void next_batch(std::vector<int64_t>& out) {
+    std::vector<int64_t> chosen;
+    if (rand_identity) {
+      // Partial Fisher-Yates over a scratch copy: distinct identities.
+      std::vector<int64_t> scratch = identities;
+      for (int i = 0; i < ids_per_batch; ++i) {
+        std::uniform_int_distribution<size_t> d(i, scratch.size() - 1);
+        std::swap(scratch[i], scratch[d(rng)]);
+        chosen.push_back(scratch[i]);
+      }
+    } else {
+      while (static_cast<int>(chosen.size()) < ids_per_batch) {
+        if (id_pos >= id_order.size()) {
+          id_pos = 0;
+          if (shuffle) std::shuffle(id_order.begin(), id_order.end(), rng);
+        }
+        const int64_t cand = id_order[id_pos++];
+        if (std::find(chosen.begin(), chosen.end(), cand) == chosen.end())
+          chosen.push_back(cand);
+      }
+    }
+    out.clear();
+    for (int64_t identity : chosen) draw_images(identity, out);
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Prefetching loader: worker pool + bounded ring of batch buffers
+// ---------------------------------------------------------------------------
+
+struct Batch {
+  std::vector<uint8_t> images;  // batch*h*w*3
+  std::vector<int32_t> labels;  // batch
+};
+
+struct Loader {
+  const Dataset* ds;
+  Sampler sampler;
+  int batch_size, h, w;
+  size_t capacity;
+
+  std::mutex sampler_mu;
+  std::mutex q_mu;
+  std::condition_variable q_not_empty, q_not_full;
+  std::deque<Batch> queue;
+  std::atomic<bool> stop{false};
+  std::string worker_error;  // guarded by q_mu; first error wins
+  std::vector<std::thread> workers;
+
+  Loader(const Dataset* d, int ids, int imgs, bool rand_id, bool shuf,
+         uint64_t seed, int threads, int prefetch)
+      : ds(d), sampler(d->labels, ids, imgs, rand_id, shuf, seed),
+        batch_size(ids * imgs),
+        h(d->new_h), w(d->new_w),
+        capacity(std::max(prefetch, 1)) {
+    for (int t = 0; t < std::max(threads, 1); ++t)
+      workers.emplace_back([this] { work(); });
+  }
+
+  ~Loader() {
+    stop.store(true);
+    q_not_full.notify_all();
+    q_not_empty.notify_all();
+    for (auto& t : workers) t.join();
+  }
+
+  void work() {
+    while (!stop.load()) {
+      std::vector<int64_t> idx;
+      {
+        std::lock_guard<std::mutex> lk(sampler_mu);
+        sampler.next_batch(idx);
+      }
+      Batch b;
+      b.images.resize(static_cast<size_t>(batch_size) * h * w * 3);
+      b.labels.resize(batch_size);
+      bool ok = true;
+      for (int i = 0; i < batch_size; ++i) {
+        int oh, ow;
+        if (!ds->load_into(static_cast<size_t>(idx[i]),
+                           b.images.data() +
+                               static_cast<size_t>(i) * h * w * 3,
+                           &oh, &ow)) {
+          ok = false;
+          break;
+        }
+        if (oh != h || ow != w) {
+          set_error("image dims vary but no new_height/new_width given");
+          ok = false;
+          break;
+        }
+        b.labels[i] = static_cast<int32_t>(ds->labels[idx[i]]);
+      }
+      std::unique_lock<std::mutex> lk(q_mu);
+      if (!ok) {
+        if (worker_error.empty()) worker_error = g_last_error;
+        stop.store(true);
+        q_not_empty.notify_all();
+        return;
+      }
+      q_not_full.wait(lk, [this] {
+        return stop.load() || queue.size() < capacity;
+      });
+      if (stop.load()) return;
+      queue.push_back(std::move(b));
+      q_not_empty.notify_one();
+    }
+  }
+
+  // 0 ok, 1 failed (see nd_last_error)
+  int next(uint8_t* images, int32_t* labels) {
+    std::unique_lock<std::mutex> lk(q_mu);
+    q_not_empty.wait(lk, [this] { return stop.load() || !queue.empty(); });
+    if (queue.empty()) {
+      set_error(worker_error.empty() ? "loader stopped" : worker_error);
+      return 1;
+    }
+    Batch b = std::move(queue.front());
+    queue.pop_front();
+    q_not_full.notify_one();
+    lk.unlock();
+    std::memcpy(images, b.images.data(), b.images.size());
+    std::memcpy(labels, b.labels.data(), b.labels.size() * sizeof(int32_t));
+    return 0;
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+const char* nd_last_error() { return g_last_error.c_str(); }
+
+void* nd_dataset_open(const char* root, const char* source, int new_h,
+                      int new_w, long long* n_items) {
+  auto ds = new Dataset;
+  ds->root = root ? root : "";
+  ds->new_h = new_h;
+  ds->new_w = new_w;
+  std::ifstream f(source);
+  if (!f) {
+    set_error(std::string("cannot open list file: ") + source);
+    delete ds;
+    return nullptr;
+  }
+  std::string line;
+  while (std::getline(f, line)) {
+    // Trim trailing CR/whitespace; skip blanks and '#' comments.
+    while (!line.empty() && std::isspace(
+               static_cast<unsigned char>(line.back())))
+      line.pop_back();
+    if (line.empty() || line[0] == '#') continue;
+    // Label is the last whitespace-separated token (paths may hold spaces).
+    size_t cut = line.find_last_of(" \t");
+    if (cut == std::string::npos) {
+      set_error("malformed list line: " + line);
+      delete ds;
+      return nullptr;
+    }
+    const std::string lbl = line.substr(cut + 1);
+    size_t start = line.find_last_not_of(" \t", cut);
+    try {
+      ds->labels.push_back(
+          static_cast<int64_t>(std::stod(lbl)));
+    } catch (...) {
+      set_error("bad label in list line: " + line);
+      delete ds;
+      return nullptr;
+    }
+    ds->paths.push_back(line.substr(0, start + 1));
+  }
+  if (ds->paths.empty()) {
+    set_error(std::string("empty list file: ") + source);
+    delete ds;
+    return nullptr;
+  }
+  *n_items = static_cast<long long>(ds->paths.size());
+  return ds;
+}
+
+void nd_dataset_labels(void* handle, long long* out) {
+  auto* ds = static_cast<Dataset*>(handle);
+  for (size_t i = 0; i < ds->labels.size(); ++i) out[i] = ds->labels[i];
+}
+
+// Decode + resize one item; images buffer must hold new_h*new_w*3 (or the
+// native dims when new_h/new_w are 0 — call nd_dataset_dims first then).
+int nd_dataset_load(void* handle, long long index, unsigned char* dst,
+                    int* out_h, int* out_w) {
+  auto* ds = static_cast<Dataset*>(handle);
+  if (index < 0 || index >= static_cast<long long>(ds->paths.size())) {
+    set_error("index out of range");
+    return 1;
+  }
+  return ds->load_into(static_cast<size_t>(index), dst, out_h, out_w) ? 0 : 1;
+}
+
+void nd_dataset_close(void* handle) { delete static_cast<Dataset*>(handle); }
+
+void* nd_loader_create(void* dataset, int ids_per_batch, int imgs_per_id,
+                       int rand_identity, int shuffle,
+                       unsigned long long seed, int threads, int prefetch) {
+  auto* ds = static_cast<Dataset*>(dataset);
+  if (ds->new_h <= 0 || ds->new_w <= 0) {
+    set_error("loader requires new_height/new_width (fixed batch shape)");
+    return nullptr;
+  }
+  std::unordered_set<int64_t> uniq(ds->labels.begin(), ds->labels.end());
+  if (static_cast<int>(uniq.size()) < ids_per_batch) {
+    set_error("need >= identity_num_per_batch distinct identities");
+    return nullptr;
+  }
+  return new Loader(ds, ids_per_batch, imgs_per_id, rand_identity != 0,
+                    shuffle != 0, seed, threads, prefetch);
+}
+
+int nd_loader_next(void* handle, unsigned char* images, int* labels) {
+  return static_cast<Loader*>(handle)->next(
+      images, reinterpret_cast<int32_t*>(labels));
+}
+
+void nd_loader_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"

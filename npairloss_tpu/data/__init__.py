@@ -2,7 +2,11 @@
 list-file datasets, synthetic clusters (SURVEY.md §3.5, §7.5)."""
 
 from npairloss_tpu.data.dataset import ArrayDataset, ListFileDataset
-from npairloss_tpu.data.loader import MultibatchLoader, multibatch_loader
+from npairloss_tpu.data.loader import (
+    MultibatchLoader,
+    NativeMultibatchLoader,
+    multibatch_loader,
+)
 from npairloss_tpu.data.sampler import IdentityBalancedSampler
 from npairloss_tpu.data.synthetic import synthetic_identity_batches
 from npairloss_tpu.data.transforms import (
@@ -15,6 +19,7 @@ __all__ = [
     "ArrayDataset",
     "ListFileDataset",
     "MultibatchLoader",
+    "NativeMultibatchLoader",
     "multibatch_loader",
     "IdentityBalancedSampler",
     "synthetic_identity_batches",

@@ -82,17 +82,6 @@ class MultibatchLoader:
         return images, labels
 
 
-    # -- device side: augmentation -----------------------------------------
-
-    def _augment(self, images: np.ndarray):
-        self._key, sub = jax.random.split(self._key)
-        return augment(
-            images,
-            sub,
-            tp=self.cfg.transform,
-            transformer=self.transformer,
-            train=self.train,
-        )
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         return self
@@ -105,12 +94,7 @@ class MultibatchLoader:
             self._stop.set()
             raise RuntimeError("data prefetch worker failed") from item
         images, labels = item
-        if (
-            self.cfg.transform != type(self.cfg.transform)()
-            or self.transformer is not None
-        ):
-            images = self._augment(images)
-        return images, labels
+        return _maybe_augment(self, images), labels
 
     def close(self):
         self._stop.set()
@@ -164,19 +148,129 @@ def _prefetch_worker(loader_ref, q: queue.Queue, stop: threading.Event):
             return
 
 
+class NativeMultibatchLoader:
+    """MultibatchLoader on the C++ runtime (``data.native``): sampling,
+    decode, resize and batch assembly run in native worker threads off
+    the GIL; augmentation stays on-device as one jitted graph."""
+
+    def __init__(
+        self,
+        cfg: DataLayerConfig,
+        transformer: Optional[TransformerConfig] = None,
+        train: bool = True,
+        seed: int = 0,
+        prefetch: int = 2,
+        threads: int = 4,
+    ):
+        from npairloss_tpu.data import native
+
+        self.cfg = cfg
+        self.transformer = transformer
+        self.train = train
+        self._key = jax.random.PRNGKey(seed)
+        self.dataset = native.NativeListFileDataset(
+            cfg.root_folder, cfg.source, cfg.new_height, cfg.new_width
+        )
+        ids, imgs = _identity_counts(cfg)
+        self._prefetcher = native.NativePrefetcher(
+            self.dataset, ids, imgs,
+            rand_identity=cfg.rand_identity, shuffle=cfg.shuffle,
+            seed=seed, threads=threads, prefetch=prefetch,
+        )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        images, labels = next(self._prefetcher)
+        return _maybe_augment(self, images.astype(np.float32)), labels
+
+    def close(self):
+        self._prefetcher.close()
+        self.dataset.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _maybe_augment(loader, images):
+    """On-device augmentation shared by both loaders: applied only when
+    the transform config is non-default or a DataTransformer is set, with
+    the loader's own PRNG key chain."""
+    if (
+        loader.cfg.transform == type(loader.cfg.transform)()
+        and loader.transformer is None
+    ):
+        return images
+    loader._key, sub = jax.random.split(loader._key)
+    return augment(
+        images, sub,
+        tp=loader.cfg.transform, transformer=loader.transformer,
+        train=loader.train,
+    )
+
+
 def multibatch_loader(
     cfg: DataLayerConfig,
     transformer: Optional[TransformerConfig] = None,
     train: Optional[bool] = None,
     seed: int = 0,
     prefetch: int = 2,
-) -> MultibatchLoader:
-    """Build the full pipeline from a parsed MultibatchData layer config."""
+    native: str = "auto",
+):
+    """Build the full pipeline from a parsed MultibatchData layer config.
+
+    ``native``: "auto" uses the C++ runtime when it is buildable AND the
+    config can use it (fixed resize dims — the loader's batch contract);
+    "never" forces the Python pipeline; "require" raises when the native
+    runtime is unavailable.  Decode-format support differs: native reads
+    PPM/PGM/BMP/NPY-u8; the Python path reads anything PIL does — a
+    native worker hitting an unsupported format surfaces the error on
+    the next batch, so "auto" keeps Python for such datasets.
+    """
+    if train is None:
+        train = cfg.phase == "TRAIN"
+    if native not in ("auto", "never", "require"):
+        raise ValueError(f"native must be auto/never/require, got {native!r}")
+    if native != "never" and cfg.new_height and cfg.new_width:
+        from npairloss_tpu.data import native as nd
+
+        supported = (".ppm", ".pgm", ".bmp", ".npy")
+        try:
+            if native == "require" or _list_file_all_suffixed(
+                cfg.source, supported
+            ):
+                if nd.native_available():
+                    return NativeMultibatchLoader(
+                        cfg, transformer, train=train, seed=seed,
+                        prefetch=prefetch,
+                    )
+                if native == "require":
+                    raise RuntimeError("native data runtime unavailable")
+        except OSError:
+            pass  # unreadable list file: let the Python path report it
+    elif native == "require":
+        raise RuntimeError(
+            "native loader requires new_height/new_width (fixed batch shape)"
+        )
     dataset = ListFileDataset(
         cfg.root_folder, cfg.source, cfg.new_height, cfg.new_width
     )
-    if train is None:
-        train = cfg.phase == "TRAIN"
     return MultibatchLoader(
         dataset, cfg, transformer, train=train, seed=seed, prefetch=prefetch
     )
+
+
+def _list_file_all_suffixed(source: str, suffixes) -> bool:
+    with open(source, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            path = line.rsplit(None, 1)[0].lower()
+            if not path.endswith(suffixes):
+                return False
+    return True

@@ -1,0 +1,232 @@
+"""ctypes binding for the native data runtime (native/npair_data.cpp).
+
+The C++ library is the TPU-side equivalent of the reference's C++
+MultibatchData layer (SURVEY.md §1 L1, §3.5): list-file dataset,
+identity-balanced sampler, PPM/BMP/NPY decode + bilinear resize, and a
+worker-pool prefetch ring — all off the GIL.  It is compiled on demand
+with g++ (no pip deps); when the toolchain or the library is
+unavailable, callers fall back to the pure-Python pipeline
+(``data.loader``), which has identical contract semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "npair_data.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libnpair_data.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Atomic build: compile to a temp name, rename over the target, so
+    # concurrent processes never dlopen a half-written .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as exc:
+        os.unlink(tmp)
+        detail = getattr(exc, "stderr", "") or str(exc)
+        raise RuntimeError(f"native build failed: {detail}") from exc
+    os.replace(tmp, _LIB)
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _lib_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_error is not None:
+            raise RuntimeError(_lib_error)
+        try:
+            # Rebuild when the source is newer; a prebuilt .so without the
+            # source on disk is used as-is.
+            stale = not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            )
+            if stale:
+                _build()
+            lib = ctypes.CDLL(_LIB)
+        except (OSError, RuntimeError) as exc:
+            _lib_error = f"native data runtime unavailable: {exc}"
+            raise RuntimeError(_lib_error) from exc
+        lib.nd_last_error.restype = ctypes.c_char_p
+        lib.nd_dataset_open.restype = ctypes.c_void_p
+        lib.nd_dataset_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong),
+        ]
+        lib.nd_dataset_labels.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong)]
+        lib.nd_dataset_load.restype = ctypes.c_int
+        lib.nd_dataset_load.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.nd_dataset_close.argtypes = [ctypes.c_void_p]
+        lib.nd_loader_create.restype = ctypes.c_void_p
+        lib.nd_loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.nd_loader_next.restype = ctypes.c_int
+        lib.nd_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ubyte),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.nd_loader_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    """True when the compiled runtime can be (or was) loaded."""
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _err(lib) -> str:
+    return lib.nd_last_error().decode("utf-8", "replace")
+
+
+class NativeListFileDataset:
+    """Native-decode counterpart of ``data.dataset.ListFileDataset``:
+    same "relative/path label" list contract, decode in C++
+    (PPM/PGM/BMP/NPY-u8), OpenCV-convention bilinear resize."""
+
+    def __init__(self, root_folder: str, source: str,
+                 new_height: int = 0, new_width: int = 0):
+        self._lib = _load()
+        n = ctypes.c_longlong()
+        self._handle = self._lib.nd_dataset_open(
+            root_folder.encode(), source.encode(),
+            int(new_height), int(new_width), ctypes.byref(n),
+        )
+        if not self._handle:
+            raise RuntimeError(_err(self._lib))
+        self._n = int(n.value)
+        self.new_height = int(new_height)
+        self.new_width = int(new_width)
+        labels = np.empty(self._n, np.int64)
+        self._lib.nd_dataset_labels(
+            self._handle,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        )
+        self.labels = labels
+
+    def __len__(self) -> int:
+        return self._n
+
+    def load(self, index: int) -> np.ndarray:
+        if self._handle is None:
+            raise RuntimeError("dataset is closed")
+        if not (self.new_height and self.new_width):
+            raise ValueError(
+                "load() without new_height/new_width needs variable-size "
+                "buffers; set the resize dims (the MultibatchData contract)"
+            )
+        out = np.empty((self.new_height, self.new_width, 3), np.uint8)
+        oh, ow = ctypes.c_int(), ctypes.c_int()
+        rc = self._lib.nd_dataset_load(
+            self._handle, int(index),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.byref(oh), ctypes.byref(ow),
+        )
+        if rc != 0:
+            raise RuntimeError(_err(self._lib))
+        return out
+
+    def load_batch(self, indices) -> np.ndarray:
+        return np.stack([self.load(int(i)) for i in indices])
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.nd_dataset_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePrefetcher:
+    """Iterator of (uint8 images [B,H,W,3], int32 labels [B]) batches,
+    produced by the C++ worker pool — sampling, decode, resize and batch
+    assembly all run off the GIL."""
+
+    def __init__(self, dataset: NativeListFileDataset,
+                 identity_num_per_batch: int, img_num_per_identity: int,
+                 rand_identity: bool = True, shuffle: bool = True,
+                 seed: int = 0, threads: int = 2, prefetch: int = 2):
+        self._ds = dataset  # keep alive: loader holds a raw pointer
+        self._lib = dataset._lib
+        self.batch_size = identity_num_per_batch * img_num_per_identity
+        self.h, self.w = dataset.new_height, dataset.new_width
+        self._handle = self._lib.nd_loader_create(
+            dataset._handle, int(identity_num_per_batch),
+            int(img_num_per_identity), int(bool(rand_identity)),
+            int(bool(shuffle)), int(seed), int(threads), int(prefetch),
+        )
+        if not self._handle:
+            raise RuntimeError(_err(self._lib))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._handle is None:
+            raise StopIteration("loader is closed")
+        images = np.empty((self.batch_size, self.h, self.w, 3), np.uint8)
+        labels = np.empty(self.batch_size, np.int32)
+        rc = self._lib.nd_loader_next(
+            self._handle,
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        )
+        if rc != 0:
+            raise RuntimeError(_err(self._lib))
+        return images, labels
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.nd_loader_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

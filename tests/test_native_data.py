@@ -1,0 +1,244 @@
+"""Native data runtime (native/npair_data.cpp via ctypes).
+
+Checks the C++ pipeline against the pure-Python one: decode parity
+(PPM/PGM/BMP/NPY vs PIL), the documented OpenCV half-pixel resize
+convention vs a NumPy oracle, the identity-balanced batch contract of
+the prefetcher, and the error paths.  Skips when g++ is unavailable.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from npairloss_tpu.data import native as nd
+
+pytestmark = pytest.mark.skipif(
+    not nd.native_available(), reason="native runtime not buildable here"
+)
+
+
+def _write_ppm(path, arr):
+    h, w, _ = arr.shape
+    with open(path, "wb") as f:
+        f.write(b"P6\n# comment\n%d %d\n255\n" % (w, h))
+        f.write(arr.tobytes())
+
+
+def _write_pgm(path, arr):
+    h, w = arr.shape
+    with open(path, "wb") as f:
+        f.write(b"P5\n%d %d\n255\n" % (w, h))
+        f.write(arr.tobytes())
+
+
+def _write_bmp(path, arr):
+    """Minimal bottom-up 24-bit BMP."""
+    h, w, _ = arr.shape
+    stride = (w * 3 + 3) & ~3
+    size = 54 + stride * h
+    hdr = bytearray(54)
+    hdr[0:2] = b"BM"
+    hdr[2:6] = size.to_bytes(4, "little")
+    hdr[10:14] = (54).to_bytes(4, "little")
+    hdr[14:18] = (40).to_bytes(4, "little")
+    hdr[18:22] = w.to_bytes(4, "little")
+    hdr[22:26] = h.to_bytes(4, "little")
+    hdr[26:28] = (1).to_bytes(2, "little")
+    hdr[28:30] = (24).to_bytes(2, "little")
+    with open(path, "wb") as f:
+        f.write(hdr)
+        for y in range(h - 1, -1, -1):
+            row = arr[y, :, ::-1].tobytes()  # RGB -> BGR
+            f.write(row + b"\x00" * (stride - len(row)))
+
+
+def _make_dataset(tmp_path, rng, n_ids=4, per_id=3, h=8, w=10):
+    lines = []
+    images = {}
+    i = 0
+    for ident in range(n_ids):
+        for _ in range(per_id):
+            arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            kind = i % 3
+            if kind == 0:
+                name = f"img_{i}.ppm"
+                _write_ppm(tmp_path / name, arr)
+            elif kind == 1:
+                name = f"img_{i}.bmp"
+                _write_bmp(tmp_path / name, arr)
+            else:
+                name = f"img_{i}.npy"
+                np.save(tmp_path / name, arr)
+            images[name] = arr
+            lines.append(f"{name} {ident}")
+            i += 1
+    src = tmp_path / "list.txt"
+    src.write_text("\n".join(lines) + "\n")
+    return str(src), lines, images
+
+
+def test_decode_parity_no_resize(tmp_path, rng):
+    src, lines, images = _make_dataset(tmp_path, rng)
+    ds = nd.NativeListFileDataset(str(tmp_path), src, 8, 10)
+    assert len(ds) == len(lines)
+    for idx, line in enumerate(lines):
+        name, lbl = line.rsplit(None, 1)
+        np.testing.assert_array_equal(ds.load(idx), images[name], err_msg=name)
+        assert ds.labels[idx] == int(lbl)
+    ds.close()
+
+
+def test_pgm_grayscale_replicates(tmp_path, rng):
+    arr = rng.integers(0, 256, (6, 7), dtype=np.uint8)
+    _write_pgm(tmp_path / "g.pgm", arr)
+    (tmp_path / "l.txt").write_text("g.pgm 0\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 6, 7)
+    out = ds.load(0)
+    for c in range(3):
+        np.testing.assert_array_equal(out[:, :, c], arr)
+
+
+def _resize_oracle(img, dh, dw):
+    """OpenCV INTER_LINEAR convention: src = (dst+0.5)*scale-0.5, clamped."""
+    h, w, _ = img.shape
+    fy = np.clip((np.arange(dh) + 0.5) * (h / dh) - 0.5, 0, None)
+    fx = np.clip((np.arange(dw) + 0.5) * (w / dw) - 0.5, 0, None)
+    y0 = np.minimum(fy.astype(int), h - 1)
+    x0 = np.minimum(fx.astype(int), w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (fy - y0)[:, None, None]
+    wx = (fx - x0)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return (top * (1 - wy) + bot * wy + 0.5).astype(np.uint8)
+
+
+@pytest.mark.parametrize("dh,dw", [(4, 5), (16, 20), (8, 10)])
+def test_resize_matches_convention(tmp_path, rng, dh, dw):
+    arr = rng.integers(0, 256, (8, 10, 3), dtype=np.uint8)
+    _write_ppm(tmp_path / "a.ppm", arr)
+    (tmp_path / "l.txt").write_text("a.ppm 1\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), dh, dw)
+    got = ds.load(0)
+    want = _resize_oracle(arr, dh, dw)
+    # float rounding at half-ULP boundaries may differ by 1 count
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+
+def test_prefetcher_batch_contract(tmp_path, rng):
+    src, lines, images = _make_dataset(tmp_path, rng, n_ids=5, per_id=4)
+    ds = nd.NativeListFileDataset(str(tmp_path), src, 8, 10)
+    with nd.NativePrefetcher(ds, 3, 2, seed=7, threads=3, prefetch=2) as pf:
+        for _ in range(20):
+            imgs, labels = next(pf)
+            assert imgs.shape == (6, 8, 10, 3) and labels.shape == (6,)
+            # identity-balanced: 3 distinct ids x 2 imgs each
+            ids, counts = np.unique(labels, return_counts=True)
+            assert len(ids) == 3 and (counts == 2).all(), labels
+            # every image must be the decode of some dataset item with
+            # that label (content round-trip through the C++ pipeline)
+            for img, lbl in zip(imgs, labels):
+                cands = [
+                    images[line.rsplit(None, 1)[0]]
+                    for line in lines
+                    if int(line.rsplit(None, 1)[1]) == lbl
+                ]
+                assert any(np.array_equal(img, c) for c in cands)
+
+
+def test_prefetcher_no_duplicate_images_within_group(tmp_path, rng):
+    src, _, _ = _make_dataset(tmp_path, rng, n_ids=3, per_id=4)
+    ds = nd.NativeListFileDataset(str(tmp_path), src, 8, 10)
+    with nd.NativePrefetcher(ds, 2, 3, seed=0, threads=1) as pf:
+        for _ in range(10):
+            imgs, labels = next(pf)
+            for lbl in np.unique(labels):
+                group = imgs[labels == lbl]
+                for a in range(len(group)):
+                    for b in range(a + 1, len(group)):
+                        assert not np.array_equal(group[a], group[b])
+
+
+def test_errors(tmp_path):
+    with pytest.raises(RuntimeError, match="cannot open list file"):
+        nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "nope.txt"))
+    (tmp_path / "bad.txt").write_text("missing.ppm 0\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "bad.txt"), 4, 4)
+    with pytest.raises(RuntimeError, match="cannot open file"):
+        ds.load(0)
+    # too few identities for the batch contract
+    (tmp_path / "one.txt").write_text("missing.ppm 0\n")
+    ds2 = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "one.txt"), 4, 4)
+    with pytest.raises(RuntimeError, match="identities"):
+        nd.NativePrefetcher(ds2, 2, 2)
+
+
+def test_multibatch_loader_auto_picks_native(tmp_path, rng):
+    """multibatch_loader(native='auto') routes a PPM list file with fixed
+    resize dims through the C++ runtime and still applies the on-device
+    augmentation stack."""
+    from npairloss_tpu.config.schema import DataLayerConfig, TransformParam
+    from npairloss_tpu.data.loader import (
+        MultibatchLoader, NativeMultibatchLoader, multibatch_loader)
+
+    src, _, _ = _make_dataset(tmp_path, rng, n_ids=4, per_id=3, h=8, w=10)
+    # mixed formats include .bmp/.npy — all native-supported
+    cfg = DataLayerConfig(
+        root_folder=str(tmp_path), source=src, batch_size=4,
+        new_height=8, new_width=10,
+        identity_num_per_batch=2, img_num_per_identity=2,
+        transform=TransformParam(crop_size=6, mirror=True),
+    )
+    with multibatch_loader(cfg, native="auto") as ldr:
+        assert isinstance(ldr, NativeMultibatchLoader)
+        x, lab = next(ldr)
+        assert np.asarray(x).shape == (4, 6, 6, 3)  # cropped on device
+        assert lab.shape == (4,)
+    with multibatch_loader(cfg, native="never") as ldr:
+        assert isinstance(ldr, MultibatchLoader)
+    with pytest.raises(RuntimeError, match="new_height"):
+        multibatch_loader(
+            DataLayerConfig(root_folder=str(tmp_path), source=src),
+            native="require",
+        )
+
+
+def test_use_after_close_raises(tmp_path, rng):
+    """Closed handles must raise, not pass NULL into the C ABI."""
+    src, _, _ = _make_dataset(tmp_path, rng)
+    ds = nd.NativeListFileDataset(str(tmp_path), src, 8, 10)
+    pf = nd.NativePrefetcher(ds, 2, 2)
+    next(pf)
+    pf.close()
+    with pytest.raises(StopIteration):
+        next(pf)
+    ds.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ds.load(0)
+
+
+def test_zero_dim_image_rejected(tmp_path):
+    """A 0x0 PPM must fail cleanly in decode, not segfault in resize."""
+    (tmp_path / "z.ppm").write_bytes(b"P6\n0 0\n255\n")
+    (tmp_path / "l.txt").write_text("z.ppm 0\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 4, 4)
+    with pytest.raises(RuntimeError, match="positive"):
+        ds.load(0)
+
+
+def test_worker_error_surfaces(tmp_path, rng):
+    """A decode failure inside a worker thread must surface in __next__."""
+    arr = rng.integers(0, 256, (4, 4, 3), dtype=np.uint8)
+    _write_ppm(tmp_path / "ok.ppm", arr)
+    (tmp_path / "mix.txt").write_text(
+        "ok.ppm 0\nok.ppm 0\nmissing.ppm 1\nmissing.ppm 1\n"
+    )
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "mix.txt"), 4, 4)
+    pf = nd.NativePrefetcher(ds, 2, 2, seed=0, threads=1, prefetch=1)
+    with pytest.raises(RuntimeError):
+        for _ in range(50):
+            next(pf)
+    pf.close()
