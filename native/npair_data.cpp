@@ -27,7 +27,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
-#include <deque>
+#include <queue>
 #include <fstream>
 #include <mutex>
 #include <random>
@@ -110,8 +110,13 @@ bool decode_pnm(const std::vector<uint8_t>& buf, Image& img) {
   }
   img.w = vals[0];
   img.h = vals[1];
-  // Pixel data starts after exactly one whitespace char past maxval.
+  // Pixel data starts after a single whitespace char past maxval (PNM
+  // spec) — but Windows writers emit "\r\n"; treat CRLF as one
+  // terminator or every pixel decodes one byte out of register.
   size_t offset = static_cast<size_t>(hs.tellg()) + 1;
+  if (offset < buf.size() && buf[offset - 1] == '\r' &&
+      buf[offset] == '\n')
+    ++offset;
   const size_t ch = color ? 3 : 1;
   const size_t need = static_cast<size_t>(img.h) * img.w * ch;
   if (buf.size() < offset + need) {
@@ -422,8 +427,15 @@ struct Sampler {
 // ---------------------------------------------------------------------------
 
 struct Batch {
+  uint64_t seq = 0;             // sampler draw order; delivery is in-order
   std::vector<uint8_t> images;  // batch*h*w*3
   std::vector<int32_t> labels;  // batch
+};
+
+struct BatchSeqGreater {
+  bool operator()(const Batch& a, const Batch& b) const {
+    return a.seq > b.seq;
+  }
 };
 
 struct Loader {
@@ -433,9 +445,14 @@ struct Loader {
   size_t capacity;
 
   std::mutex sampler_mu;
+  uint64_t next_seq = 0;        // guarded by sampler_mu
   std::mutex q_mu;
   std::condition_variable q_not_empty, q_not_full;
-  std::deque<Batch> queue;
+  // Min-heap on seq + in-order release: with threads > 1 workers finish
+  // out of order, but consumers see batches in sampler draw order, so
+  // seeded runs are reproducible like the single-worker Python loader.
+  std::priority_queue<Batch, std::vector<Batch>, BatchSeqGreater> queue;
+  uint64_t next_deliver = 0;    // guarded by q_mu
   std::atomic<bool> stop{false};
   std::string worker_error;  // guarded by q_mu; first error wins
   std::vector<std::thread> workers;
@@ -460,11 +477,14 @@ struct Loader {
   void work() {
     while (!stop.load()) {
       std::vector<int64_t> idx;
+      uint64_t seq;
       {
         std::lock_guard<std::mutex> lk(sampler_mu);
         sampler.next_batch(idx);
+        seq = next_seq++;
       }
       Batch b;
+      b.seq = seq;
       b.images.resize(static_cast<size_t>(batch_size) * h * w * 3);
       b.labels.resize(batch_size);
       bool ok = true;
@@ -491,26 +511,34 @@ struct Loader {
         q_not_empty.notify_all();
         return;
       }
-      q_not_full.wait(lk, [this] {
-        return stop.load() || queue.size() < capacity;
+      // Window = capacity + worker count: the worker holding the next
+      // deliverable seq can always enter, so in-order release cannot
+      // deadlock behind later batches from faster workers.
+      q_not_full.wait(lk, [this, seq] {
+        return stop.load() ||
+               seq < next_deliver + capacity + workers.size();
       });
       if (stop.load()) return;
-      queue.push_back(std::move(b));
-      q_not_empty.notify_one();
+      queue.push(std::move(b));
+      q_not_empty.notify_all();
     }
   }
 
   // 0 ok, 1 failed (see nd_last_error)
   int next(uint8_t* images, int32_t* labels) {
     std::unique_lock<std::mutex> lk(q_mu);
-    q_not_empty.wait(lk, [this] { return stop.load() || !queue.empty(); });
-    if (queue.empty()) {
+    q_not_empty.wait(lk, [this] {
+      return stop.load() ||
+             (!queue.empty() && queue.top().seq == next_deliver);
+    });
+    if (queue.empty() || queue.top().seq != next_deliver) {
       set_error(worker_error.empty() ? "loader stopped" : worker_error);
       return 1;
     }
-    Batch b = std::move(queue.front());
-    queue.pop_front();
-    q_not_full.notify_one();
+    Batch b = std::move(const_cast<Batch&>(queue.top()));
+    queue.pop();
+    ++next_deliver;
+    q_not_full.notify_all();
     lk.unlock();
     std::memcpy(images, b.images.data(), b.images.size());
     std::memcpy(labels, b.labels.data(), b.labels.size() * sizeof(int32_t));

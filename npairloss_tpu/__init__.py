@@ -5,9 +5,10 @@ reference Caffe CUDA+MPI layer ``NPairMultiClassLossLayer`` (quziyan/NPairLoss)
 and its implied host framework.  This top-level module exports the compute
 core: the mined N-pair loss with cross-chip global negative pooling,
 in-training retrieval metrics, and L2 normalization.  Subpackages:
-``parallel`` (device-mesh plumbing), ``config`` (prototxt front-end),
-``data`` (identity-balanced pipeline), ``models`` (embedding zoo),
-``train`` (solver loop).
+``parallel`` (device-mesh plumbing + ring negative pooling), ``config``
+(prototxt front-end), ``data`` (identity-balanced pipeline with the
+native C++ runtime), ``models`` (embedding zoo), ``train`` (solver
+loop), ``utils`` (profiling + numeric debug guards).
 """
 
 from npairloss_tpu.ops.npair_loss import (
@@ -20,6 +21,11 @@ from npairloss_tpu.ops.npair_loss import (
 )
 from npairloss_tpu.ops.metrics import retrieval_metrics
 from npairloss_tpu.ops.normalize import l2_normalize
+from npairloss_tpu.ops.pallas_npair import (
+    blockwise_npair_loss,
+    blockwise_npair_loss_with_aux,
+    blockwise_retrieval_metrics,
+)
 
 __version__ = "0.1.0"
 
@@ -30,6 +36,9 @@ __all__ = [
     "NPairLossConfig",
     "npair_loss",
     "npair_loss_with_aux",
+    "blockwise_npair_loss",
+    "blockwise_npair_loss_with_aux",
+    "blockwise_retrieval_metrics",
     "retrieval_metrics",
     "l2_normalize",
     "__version__",

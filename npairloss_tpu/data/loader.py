@@ -239,17 +239,18 @@ def multibatch_loader(
         from npairloss_tpu.data import native as nd
 
         supported = (".ppm", ".pgm", ".bmp", ".npy")
+        available = nd.native_available()  # cached; check before file I/O
+        if native == "require" and not available:
+            raise RuntimeError("native data runtime unavailable")
         try:
-            if native == "require" or _list_file_all_suffixed(
-                cfg.source, supported
+            if available and (
+                native == "require"
+                or _list_file_all_suffixed(cfg.source, supported)
             ):
-                if nd.native_available():
-                    return NativeMultibatchLoader(
-                        cfg, transformer, train=train, seed=seed,
-                        prefetch=prefetch,
-                    )
-                if native == "require":
-                    raise RuntimeError("native data runtime unavailable")
+                return NativeMultibatchLoader(
+                    cfg, transformer, train=train, seed=seed,
+                    prefetch=prefetch,
+                )
         except OSError:
             pass  # unreadable list file: let the Python path report it
     elif native == "require":

@@ -67,7 +67,15 @@ def _load() -> ctypes.CDLL:
             )
             if stale:
                 _build()
-            lib = ctypes.CDLL(_LIB)
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError:
+                # A present-but-unloadable .so (wrong arch/glibc): rebuild
+                # from source once rather than caching unavailability.
+                if stale or not os.path.exists(_SRC):
+                    raise
+                _build()
+                lib = ctypes.CDLL(_LIB)
         except (OSError, RuntimeError) as exc:
             _lib_error = f"native data runtime unavailable: {exc}"
             raise RuntimeError(_lib_error) from exc

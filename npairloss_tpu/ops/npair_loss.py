@@ -346,8 +346,13 @@ def _forward_core(
         # MPI_Allgather of features and labels (cu:17-43) as in-graph ICI
         # collectives; rank-r block lands at rows [r*N, (r+1)*N) exactly as
         # MPI_Allgather orders recvbuf.
-        total_features = jax.lax.all_gather(features, axis_name, axis=0, tiled=True)
-        total_labels = jax.lax.all_gather(labels, axis_name, axis=0, tiled=True)
+        with jax.named_scope("npair/gather"):
+            total_features = jax.lax.all_gather(
+                features, axis_name, axis=0, tiled=True
+            )
+            total_labels = jax.lax.all_gather(
+                labels, axis_name, axis=0, tiled=True
+            )
         rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
         num_shards = jax.lax.axis_size(axis_name)
 
@@ -355,16 +360,19 @@ def _forward_core(
     # dot_normalizer = 1 in forward per cu:216).  HIGHEST keeps full fp32 on
     # the MXU — the TPU default would truncate fp32 operands to bf16 and
     # break bit-parity with the oracle.
-    sims = jnp.dot(
-        features,
-        total_features.T,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
+    with jax.named_scope("npair/sim"):
+        sims = jnp.dot(
+            features,
+            total_features.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
 
-    same, diff = pair_masks(labels, total_labels, rank, n_local)
-    pos_thr, neg_thr, max_all = mining_thresholds(sims, same, diff, cfg)
-    sel = selection_mask(sims, same, diff, pos_thr, neg_thr, cfg)
+    with jax.named_scope("npair/mine"):
+        same, diff = pair_masks(labels, total_labels, rank, n_local)
+        pos_thr, neg_thr, max_all = mining_thresholds(sims, same, diff, cfg)
+    with jax.named_scope("npair/select"):
+        sel = selection_mask(sims, same, diff, pos_thr, neg_thr, cfg)
 
     sel_pos = same & sel  # _tmp_Select_Ident, cu:355
     sel_neg = diff & sel  # _tmp_Select_Diff, cu:358
@@ -377,17 +385,20 @@ def _forward_core(
     # at all has max_all = -FLT_MAX, so sim_exp overflows to +inf and
     # inf * 0 would poison the row sums with NaN — the reference kernel
     # zeroes non-pair entries before its gemv reductions (cu:152-154).
-    sim_exp = jnp.exp(sims - max_all[:, None])
-    exp_pos = jnp.where(sel_pos, sim_exp, 0.0)  # _innerProd_temp1, cu:373
-    exp_neg = jnp.where(sel_neg, sim_exp, 0.0)  # _innerProd_temp2, cu:376
+    with jax.named_scope("npair/loss"):
+        sim_exp = jnp.exp(sims - max_all[:, None])
+        exp_pos = jnp.where(sel_pos, sim_exp, 0.0)  # _innerProd_temp1, cu:373
+        exp_neg = jnp.where(sel_neg, sim_exp, 0.0)  # _innerProd_temp2, cu:376
 
-    ident_sum = exp_pos.sum(axis=1)  # loss_ident_value I_q, cu:375
-    all_sum = ident_sum + exp_neg.sum(axis=1)  # I_q + D_q, cu:380
+        ident_sum = exp_pos.sum(axis=1)  # loss_ident_value I_q, cu:375
+        all_sum = ident_sum + exp_neg.sum(axis=1)  # I_q + D_q, cu:380
 
-    # ManipulateDIVandLOG (cu:158-171): zero-count queries contribute 0.
-    valid = (ident_sum != 0) & (all_sum != 0)
-    log_q = jnp.where(valid, jnp.log(jnp.where(valid, ident_sum / all_sum, 1.0)), 0.0)
-    loss = -log_q.sum() / jnp.float32(n_local)  # cu:384-385
+        # ManipulateDIVandLOG (cu:158-171): zero-count queries contribute 0.
+        valid = (ident_sum != 0) & (all_sum != 0)
+        log_q = jnp.where(
+            valid, jnp.log(jnp.where(valid, ident_sum / all_sum, 1.0)), 0.0
+        )
+        loss = -log_q.sum() / jnp.float32(n_local)  # cu:384-385
 
     aux = {
         "sim": sims,

@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from npairloss_tpu.ops.metrics import retrieval_metrics
+from npairloss_tpu.utils.debug import assert_all_finite, debug_checks_enabled
 from npairloss_tpu.ops.npair_loss import NPairLossConfig, npair_loss_with_aux
 from npairloss_tpu.train.optim import caffe_sgd, lr_schedule
 
@@ -274,6 +275,10 @@ class Solver:
         self.state, metrics = self._step_fn(
             self.state, jnp.asarray(inputs), jnp.asarray(labels)
         )
+        if debug_checks_enabled():
+            # utils.debug switch: validate every step's scalars on host
+            # (SURVEY.md §5.2 — the reference had no numeric checks).
+            assert_all_finite(metrics, "step metrics")
         return metrics
 
     def evaluate(

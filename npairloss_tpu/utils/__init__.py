@@ -1,0 +1,17 @@
+from npairloss_tpu.utils.profiling import StepTimer, annotate, trace
+from npairloss_tpu.utils.debug import (
+    assert_all_finite,
+    checked,
+    debug_checks_enabled,
+    enable_debug_checks,
+)
+
+__all__ = [
+    "StepTimer",
+    "annotate",
+    "trace",
+    "assert_all_finite",
+    "checked",
+    "debug_checks_enabled",
+    "enable_debug_checks",
+]

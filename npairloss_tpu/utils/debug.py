@@ -1,0 +1,83 @@
+"""Numerical debug guards (SURVEY.md §5.2).
+
+The reference's correctness hazards — the div/log zero-guards
+(reference: npair_multi_class_loss.cu:162-169, cu:412-417) and its
+unchecked mixed CPU/GPU blob writes — have no runtime checks at all.
+Under jit the purity hazard is gone by construction; what remains worth
+guarding is numerics.  This module provides:
+
+  * ``checked(fn)`` — a ``jax.experimental.checkify`` wrapper that
+    errors (with location) on any NaN/Inf produced inside ``fn``,
+    including division guards, usable under jit;
+  * ``assert_all_finite(tree)`` — a host-side assertion for step
+    outputs, cheap for scalars/metrics;
+  * a process-wide debug flag the Solver consults to validate each
+    step's loss/metrics without callers threading a flag through.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import numpy as np
+from jax.experimental import checkify
+
+_debug_checks = False
+
+
+def enable_debug_checks(enabled: bool = True) -> None:
+    """Process-wide switch: when on, the Solver asserts every step's
+    loss/metric scalars are finite (raising with the offending name)."""
+    global _debug_checks
+    _debug_checks = bool(enabled)
+
+
+def debug_checks_enabled() -> bool:
+    return _debug_checks
+
+
+def assert_all_finite(tree: Any, name: str = "value") -> None:
+    """Host-side: raise FloatingPointError naming the first non-finite
+    leaf.  Forces materialization — use on scalars/metrics, not params."""
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind in "fc" and not np.isfinite(arr).all():
+            raise FloatingPointError(
+                f"non-finite {name}{jax.tree_util.keystr(path)}: "
+                f"{arr if arr.size <= 8 else 'array with NaN/Inf'}"
+            )
+
+
+def checked(fn, *, div: bool = True, nan: bool = True, oob: bool = False,
+            jit: bool = True):
+    """Wrap ``fn`` with checkify float/div(/index) error tracking.
+
+    Returns a function with the same signature that raises
+    ``checkify.JaxRuntimeError`` on the host when any op inside produced
+    NaN/Inf or divided by zero — the runtime teeth for the guards the
+    reference hand-rolled at cu:162-169 and cu:412-417.
+
+    The checkified graph is jitted internally (``jit=True``); the error
+    throw happens on the host after the compiled call, so do NOT wrap
+    the result in another ``jax.jit`` (the error state must surface,
+    jit-of-checkify, not checkify-inside-jit).
+    """
+    errors = frozenset(
+        (checkify.float_checks if nan else frozenset())
+        | (checkify.div_checks if div else frozenset())
+        | (checkify.index_checks if oob else frozenset())
+    )
+    checked_fn = checkify.checkify(fn, errors=errors)
+    if jit:
+        checked_fn = jax.jit(checked_fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = checked_fn(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper

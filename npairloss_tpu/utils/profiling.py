@@ -1,0 +1,79 @@
+"""Tracing and profiling (SURVEY.md §5.1).
+
+The reference has no profiling — only commented-out LOG(INFO) wall-clock
+probes around its MPI calls and kernels (reference:
+npair_multi_class_loss.cu:423, cu:464-468, cu:199).  Here the stages of
+the loss graph carry ``jax.named_scope`` annotations (visible in
+XProf/Perfetto and in HLO op names), ``trace`` captures a device profile
+for TensorBoard/XProf, and ``StepTimer`` gives the wall-clock
+steps/sec / embeddings/sec counters the reference never had.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Dict, Optional
+
+import jax
+
+# Stage annotation: ``with annotate("npair/sim"): ...`` names the ops
+# traced inside it, so XProf timelines and HLO dumps show the pipeline
+# stages (gather / sim / mine / select / loss) instead of a fused soup.
+annotate = jax.named_scope
+
+
+@contextlib.contextmanager
+def trace(logdir: str, create_perfetto_trace: bool = False):
+    """Capture a device+host profile under ``logdir`` (XProf/TensorBoard
+    format; optionally a Perfetto trace too).  Wrap a handful of
+    training steps, not the whole run."""
+    jax.profiler.start_trace(
+        logdir, create_perfetto_trace=create_perfetto_trace
+    )
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Sliding-window wall-clock throughput meter.
+
+    ``tick(items)`` marks a step boundary and returns the current window
+    stats; call with the per-step item count (e.g. batch size) to get
+    items/sec (embeddings/sec for this framework's benchmarks).  The
+    first tick only arms the timer.  Remember JAX dispatch is async —
+    call ``jax.block_until_ready`` on a step output before the final
+    tick, or wrap ticks around blocking points.
+    """
+
+    def __init__(self, window: int = 50):
+        self._durations: collections.deque = collections.deque(maxlen=window)
+        self._items: collections.deque = collections.deque(maxlen=window)
+        self._last: Optional[float] = None
+
+    def tick(self, items: int = 0) -> Dict[str, float]:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._durations.append(now - self._last)
+            self._items.append(items)
+        self._last = now
+        return self.stats()
+
+    def stats(self) -> Dict[str, float]:
+        if not self._durations:
+            return {"steps_per_sec": 0.0, "items_per_sec": 0.0,
+                    "mean_step_ms": 0.0}
+        total = sum(self._durations)
+        return {
+            "steps_per_sec": len(self._durations) / total,
+            "items_per_sec": sum(self._items) / total,
+            "mean_step_ms": 1000.0 * total / len(self._durations),
+        }
+
+    def reset(self):
+        self._durations.clear()
+        self._items.clear()
+        self._last = None

@@ -206,6 +206,38 @@ def test_multibatch_loader_auto_picks_native(tmp_path, rng):
         )
 
 
+def test_seeded_runs_deterministic_across_thread_counts(tmp_path, rng):
+    """Batches are released in sampler draw order regardless of worker
+    count, so seeded runs reproduce like the single-worker Python loader."""
+    src, _, _ = _make_dataset(tmp_path, rng, n_ids=6, per_id=4)
+
+    def run(threads):
+        ds = nd.NativeListFileDataset(str(tmp_path), src, 8, 10)
+        out = []
+        with nd.NativePrefetcher(ds, 3, 2, seed=11, threads=threads,
+                                 prefetch=3) as pf:
+            for _ in range(12):
+                imgs, labels = next(pf)
+                out.append((imgs.copy(), labels.copy()))
+        ds.close()
+        return out
+
+    a, b = run(1), run(4)
+    for (ia, la), (ib, lb) in zip(a, b):
+        np.testing.assert_array_equal(la, lb)
+        np.testing.assert_array_equal(ia, ib)
+
+
+def test_crlf_ppm_decodes_in_register(tmp_path, rng):
+    """A PPM whose maxval line ends in CRLF must not shift pixels."""
+    arr = rng.integers(0, 256, (5, 6, 3), dtype=np.uint8)
+    with open(tmp_path / "crlf.ppm", "wb") as f:
+        f.write(b"P6\r\n6 5\r\n255\r\n" + arr.tobytes())
+    (tmp_path / "l.txt").write_text("crlf.ppm 0\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 5, 6)
+    np.testing.assert_array_equal(ds.load(0), arr)
+
+
 def test_use_after_close_raises(tmp_path, rng):
     """Closed handles must raise, not pass NULL into the C ABI."""
     src, _, _ = _make_dataset(tmp_path, rng)
