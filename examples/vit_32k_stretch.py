@@ -1,0 +1,131 @@
+"""BASELINE.json config 5 (stretch): ViT-B/16, 32k-batch N-pair
+contrastive over ICI — the CLIP-scale negative pool.
+
+Two engines, same semantics, both avoiding the dense 32k x 32k pair
+matrix (4+ GB that cannot exist in HBM):
+
+  * multi-chip: ring-blockwise pooling (``parallel.ring``) — the pair
+    matrix streams over ppermute hops, each shard holding only its
+    N_local x N_block tile;
+  * single-chip: Pallas fused blockwise kernels
+    (``blockwise_npair_loss_with_aux``) — (BN x BM) tiles through VMEM.
+
+Run (any JAX backend; sizes scale down automatically for demo):
+
+    python examples/vit_32k_stretch.py --batch 1024 --image 64
+    python examples/vit_32k_stretch.py --batch 32768 --mode pallas  # one v5e chip
+
+The embedding trunk is the registry ViT-B/16; for the loss-path stretch
+demo the images are synthetic identity clusters.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--image", type=int, default=64)
+    ap.add_argument("--mode", choices=["ring", "pallas", "auto"],
+                    default="auto")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (e.g. 8 virtual devices "
+                         "via XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.ops.npair_loss import MiningMethod, NPairLossConfig
+    from npairloss_tpu.data.synthetic import synthetic_identity_batches
+
+    cfg = NPairLossConfig(
+        margin_diff=-0.05, an_mining_method=MiningMethod.HARD
+    )
+    devices = jax.devices()
+    mode = args.mode
+    if mode == "auto":
+        mode = "ring" if len(devices) > 1 else "pallas"
+    print(f"devices={len(devices)} ({devices[0].platform}), mode={mode}")
+
+    model = get_model("vit_b16", dtype=jnp.bfloat16)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((2, args.image, args.image, 3), jnp.float32),
+        train=False,
+    )
+
+    batches = synthetic_identity_batches(
+        args.batch // 2, args.batch // 2, 2,
+        (args.image, args.image, 3), noise=0.5,
+    )
+    x_np, lab_np = next(batches)
+
+    if mode == "pallas":
+        from npairloss_tpu.ops.pallas_npair import (
+            blockwise_npair_loss_with_aux,
+        )
+
+        @jax.jit
+        def step(variables, x, lab):
+            emb = model.apply(variables, x, train=False)
+            loss, _ = blockwise_npair_loss_with_aux(
+                emb, lab, cfg, block_size=512
+            )
+            return loss, jax.grad(
+                lambda e: blockwise_npair_loss_with_aux(
+                    e, lab, cfg, block_size=512
+                )[0]
+            )(emb)
+
+        x, lab = jnp.asarray(x_np), jnp.asarray(lab_np)
+        run = lambda: step(variables, x, lab)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        from npairloss_tpu.parallel import data_parallel_mesh
+        from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
+
+        mesh = data_parallel_mesh(devices)
+
+        def sharded(variables, x, lab):
+            def per_shard(x, lab):
+                emb = model.apply(variables, x, train=False)
+                loss, _ = ring_npair_loss_and_metrics(
+                    emb, lab, cfg, "dp", (1,)
+                )
+                return loss[None]
+
+            losses = jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
+            )(x, lab)
+            return losses.mean()
+
+        step_fn = jax.jit(jax.value_and_grad(sharded, argnums=1))
+        x, lab = jnp.asarray(x_np), jnp.asarray(lab_np)
+        run = lambda: step_fn(variables, x, lab)
+
+    out = run()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        out = run()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / args.steps
+    loss = out[0] if isinstance(out, tuple) else out
+    print(f"loss={float(jnp.asarray(loss).mean()):.4f}  "
+          f"{dt * 1000:.1f} ms/step  "
+          f"{args.batch / dt:.0f} embeddings/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Exact streamed rank selection (k-th smallest) over pair populations.
+
+The reference computes RELATIVE_* mining thresholds by sorting the full
+pair-similarity population on the host (reference:
+npair_multi_class_loss.cu:266-273) and indexing the sorted list
+(cu:285-287 etc.).  For streamed paths that never materialize the pair
+matrix (parallel.ring, ops.pallas_npair), the same element is recovered
+EXACTLY — bit pattern and all — by MSD radix selection over a monotone
+float32 -> uint32 key: four rounds, each histogramming one 8-bit digit
+of the candidates matching the prefix so far, narrow k to a single bit
+pattern.  Each round costs one pass over the (recomputed) pair tiles;
+no sort, no materialization, O(N x 256) state.
+
+This is SURVEY.md §7's "distributed top-k" growth path for GLOBAL
+RELATIVE mining beyond gather-able pool sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FLT_MAX = float(np.finfo(np.float32).max)
+
+# hist_fn(prefix: uint32[N], digit: int) -> int32[N, 256]: counts of the
+# digit values of candidates whose higher digits equal prefix.  For a
+# GLOBAL (population-wide) rank the caller's hist_fn sums counts over
+# queries and broadcasts, so every row narrows identically.
+HistFn = Callable[[jax.Array, int], jax.Array]
+
+
+def sortable_key(v: jax.Array) -> jax.Array:
+    """Monotone float32 -> uint32 bit-key (the radix-sort float trick):
+    key order == value order, so rank selection runs on integer digits
+    and recovers the target element's exact bit pattern."""
+    u = jax.lax.bitcast_convert_type(v.astype(jnp.float32), jnp.uint32)
+    sign = (u & jnp.uint32(0x80000000)) != 0
+    return jnp.where(sign, ~u, u | jnp.uint32(0x80000000))
+
+
+def key_to_float(key: jax.Array) -> jax.Array:
+    sign = (key & jnp.uint32(0x80000000)) != 0
+    u = jnp.where(sign, key ^ jnp.uint32(0x80000000), ~key)
+    return jax.lax.bitcast_convert_type(u, jnp.float32)
+
+
+def radix_select(hist_fn: HistFn, k: jax.Array, empty: jax.Array) -> jax.Array:
+    """Value of the k-th smallest candidate per query (0-based), exact.
+
+    Args:
+      hist_fn: digit histogram oracle over the streamed population.
+      k: int32 [N] target rank per query (pre-clipped to [0, count-1]).
+      empty: bool [N]; rows with no candidates yield +FLT_MAX — the
+        dense path's +FLT_MAX-padded sort yields FLT_MAX at any index.
+    """
+    k = k.astype(jnp.int32)
+    prefix = jnp.zeros(k.shape, jnp.uint32)
+    for digit in range(4):
+        hist = hist_fn(prefix, digit)
+        cum = jnp.cumsum(hist, axis=1)
+        # First digit bin whose cumulative count exceeds k.
+        b = jnp.minimum((cum <= k[:, None]).sum(axis=1), 255)
+        below = jnp.where(
+            b > 0,
+            jnp.take_along_axis(
+                cum, jnp.maximum(b - 1, 0)[:, None], axis=1
+            )[:, 0],
+            0,
+        )
+        k = k - below
+        prefix = (prefix << jnp.uint32(8)) | b.astype(jnp.uint32)
+    return jnp.where(empty, jnp.float32(FLT_MAX), key_to_float(prefix))
+
+
+def digit_of(key: jax.Array, digit: int) -> jax.Array:
+    """8-bit digit ``digit`` (0 = MSB) of a uint32 key, as int32."""
+    shift = 24 - 8 * digit
+    return ((key >> jnp.uint32(shift)) & jnp.uint32(0xFF)).astype(jnp.int32)
+
+
+def prefix_matches(key: jax.Array, prefix: jax.Array, digit: int) -> jax.Array:
+    """True where key's digits above ``digit`` equal ``prefix`` (always
+    True for digit 0)."""
+    if digit == 0:
+        return jnp.ones(key.shape, bool)
+    shift = 32 - 8 * digit
+    return (key >> jnp.uint32(shift)) == prefix
+
+
+def masked_digit_hist(
+    sims: jax.Array, mask: jax.Array, prefix: jax.Array, digit: int
+) -> jax.Array:
+    """int32 [N, 256] histogram of digit values over one masked tile;
+    prefix-mismatched and unmasked entries are dropped (overflow bin)."""
+    key = sortable_key(sims)
+    m = mask & prefix_matches(key, prefix[:, None], digit)
+    d = jnp.where(m, digit_of(key, digit), 256)
+    hist = jax.vmap(lambda row: jnp.bincount(row, length=257))(d)
+    return hist[:, :256].astype(jnp.int32)

@@ -28,12 +28,15 @@ Three ring passes per step:
      reference's MPI_Allreduce produces (cu:462-489) — then merged
      0.5/0.5 with the query-role grad (cu:492-497).
 
-Mining-method support: the absolute methods (HARD / EASY / RAND) are
-exact, since their thresholds are min/max reductions that stream.  The
-RELATIVE_* methods need rank statistics over the full pair population;
-use the dense (gather) path for those — fine through v5e-8 pods, and the
-documented growth path beyond is a distributed-selection pass
-(SURVEY.md §7 hard parts).
+Mining-method support: ALL methods are exact.  Absolute (HARD / EASY /
+RAND) thresholds are streamed min/max reductions.  RELATIVE_* needs
+rank statistics over the full pair population — the reference sorts the
+whole N x (N*G) block on the host (cu:266-273); here the k-th smallest
+masked pair value is recovered EXACTLY by MSD radix selection over
+sortable float bit-keys: 4 ring passes, each histogramming one 8-bit
+digit of the monotone uint32 key, narrow to the target element's exact
+bit pattern (SURVEY.md §7's "distributed top-k" growth path).  Memory
+stays O(N x N_block); a relative threshold costs 4 extra rotations.
 """
 
 from __future__ import annotations
@@ -47,22 +50,26 @@ import numpy as np
 
 from npairloss_tpu.ops.npair_loss import (
     FLT_MAX,
+    MiningMethod,
+    MiningRegion,
     NPairLossConfig,
+    _clamp_negative,
+    _relative_pos,
     absolute_thresholds,
     selection_mask,
-    streaming_supported,
 )
+from npairloss_tpu.ops.rank_select import masked_digit_hist, radix_select
 
-# Same streaming contract as the Pallas-blockwise path (ops.pallas_npair).
-ring_supported = streaming_supported
+_RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
+
+
+def ring_supported(cfg: NPairLossConfig) -> bool:
+    """Every mining configuration streams (RELATIVE_* via radix select)."""
+    return True
 
 
 def _check_cfg(cfg: NPairLossConfig) -> None:
-    if not ring_supported(cfg):
-        raise NotImplementedError(
-            "ring mode streams min/max thresholds only; RELATIVE_* mining "
-            "needs the dense gather path (npair_loss_with_aux)"
-        )
+    pass  # all configs supported; kept for API stability
 
 
 def _tile(
@@ -141,6 +148,10 @@ def _stats_pass(
         "min_within": jnp.full((n_local,), pos),
         "max_between": jnp.full((n_local,), neg),
         "max_all": jnp.full((n_local,), neg),
+        # Pair-population sizes per query, for RELATIVE rank targets
+        # (the list sizes of cu:266-273).
+        "count_same": jnp.zeros((n_local,), jnp.int32),
+        "count_diff": jnp.zeros((n_local,), jnp.int32),
         # Running top-(k+1) non-self sims and a same-label flag for each,
         # for the Recall@k threshold semantics (cu:190-197).
         "top_sims": jnp.full((n_local, top_k_max + 1), neg),
@@ -165,6 +176,8 @@ def _stats_pass(
         c["max_all"] = jnp.maximum(
             c["max_all"], jnp.where(same | diff, sims, neg).max(axis=1)
         )
+        c["count_same"] = c["count_same"] + same.sum(axis=1, dtype=jnp.int32)
+        c["count_diff"] = c["count_diff"] + diff.sum(axis=1, dtype=jnp.int32)
         nonself = same | diff
         cat_sims = jnp.concatenate(
             [c["top_sims"], jnp.where(nonself, sims, neg)], axis=1
@@ -177,6 +190,94 @@ def _stats_pass(
 
     carry, _ = _ring_scan(axis_name, body, carry, rotating)
     return carry
+
+
+# ---------------------------------------------------------------------------
+# Streamed RELATIVE thresholds: exact MSD radix selection over the ring
+# ---------------------------------------------------------------------------
+
+
+def _digit_hist_pass(
+    feats, labels, my_rank, axis_name: str, use_same: bool,
+    prefix: jax.Array, digit: int,
+) -> jax.Array:
+    """One ring rotation accumulating the masked digit histogram
+    (ops.rank_select.masked_digit_hist) over all pair tiles."""
+    n_local = feats.shape[0]
+    carry = {"hist": jnp.zeros((n_local, 256), jnp.int32)}
+    rotating = {"f": feats, "l": labels, "rank": my_rank}
+
+    def body(c, rot, step):
+        sims = _tile(feats, rot["f"])
+        same, diff = _block_masks(
+            labels, rot["l"], my_rank, rot["rank"], n_local
+        )
+        mask = same if use_same else diff
+        c = dict(c)
+        c["hist"] = c["hist"] + masked_digit_hist(sims, mask, prefix, digit)
+        return c, rot
+
+    carry, _ = _ring_scan(axis_name, body, carry, rotating)
+    return carry["hist"]
+
+
+def _streamed_relative_threshold(
+    feats, labels, my_rank, axis_name: str, use_same: bool,
+    sn: float, region: MiningRegion, counts: jax.Array,
+) -> jax.Array:
+    """k-th smallest masked pair value, exactly, without the pair matrix.
+
+    Reproduces the dense ``_local/_global_relative_threshold`` semantics
+    (ascending sort + ``_relative_pos`` index + ``< 0 -> -FLT_MAX``
+    clamp, reference cu:275-337) via ops.rank_select: 4 ring passes of
+    MSD radix selection pin down all 32 bits of the target element.
+    GLOBAL region ranks over this rank's whole flattened N x (N*G)
+    block (cu:296, cu:327), LOCAL per query.  Counts larger than int32
+    (> 2^31 pairs per shard block) are out of scope.
+    """
+    n_local = feats.shape[0]
+    is_global = region == MiningRegion.GLOBAL
+
+    if is_global:
+        total = counts.sum()
+        k = jnp.broadcast_to(_relative_pos(total[None], sn)[0], (n_local,))
+        empty = jnp.broadcast_to(total == 0, (n_local,))
+    else:
+        k = _relative_pos(counts, sn)
+        empty = counts == 0
+
+    def hist_fn(prefix, digit):
+        hist = _digit_hist_pass(
+            feats, labels, my_rank, axis_name, use_same, prefix, digit
+        )
+        if is_global:
+            hist = jnp.broadcast_to(
+                hist.sum(axis=0, keepdims=True), hist.shape
+            )
+        return hist
+
+    return _clamp_negative(radix_select(hist_fn, k, empty))
+
+
+def _ring_thresholds(
+    feats, labels, my_rank, axis_name: str, cfg: NPairLossConfig, stats
+):
+    """(pos_thr, neg_thr) for any mining config: absolute from streamed
+    min/max stats, RELATIVE_* via exact radix selection."""
+    pos_thr, neg_thr = absolute_thresholds(
+        stats["min_within"], stats["max_between"], cfg
+    )
+    if cfg.ap_mining_method in _RELATIVE:
+        pos_thr = _streamed_relative_threshold(
+            feats, labels, my_rank, axis_name, True, cfg.identsn,
+            cfg.ap_mining_region, stats["count_same"],
+        )
+    if cfg.an_mining_method in _RELATIVE:
+        neg_thr = _streamed_relative_threshold(
+            feats, labels, my_rank, axis_name, False, cfg.diffsn,
+            cfg.an_mining_region, stats["count_diff"],
+        )
+    return pos_thr, neg_thr
 
 
 # ---------------------------------------------------------------------------
@@ -322,8 +423,8 @@ def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks):
 
     top_k_max = max(top_ks) if top_ks else 1
     stats = _stats_pass(features, labels, my_rank, axis_name, top_k_max)
-    pos_thr, neg_thr = absolute_thresholds(
-        stats["min_within"], stats["max_between"], cfg
+    pos_thr, neg_thr = _ring_thresholds(
+        features, labels, my_rank, axis_name, cfg, stats
     )
     sums = _loss_pass(
         features, labels, my_rank, pos_thr, neg_thr, stats["max_all"],

@@ -91,18 +91,11 @@ class Solver:
         self.axis = axis
         # Ring-blockwise negative pooling (parallel.ring): streams the
         # pair matrix instead of gathering it — for pools too large to
-        # materialize.  Requires absolute mining methods.
+        # materialize.  All mining methods supported (RELATIVE_* via
+        # exact streamed radix selection).
         self.use_ring = use_ring
-        if use_ring:
-            from npairloss_tpu.parallel.ring import ring_supported
-
-            if mesh is None:
-                raise ValueError("use_ring requires a mesh")
-            if not ring_supported(loss_cfg):
-                raise ValueError(
-                    "ring mode supports absolute mining methods only "
-                    "(HARD/EASY/RAND); use the dense path for RELATIVE_*"
-                )
+        if use_ring and mesh is None:
+            raise ValueError("use_ring requires a mesh")
         self.top_ks = tuple(top_ks)
         self.input_shape = tuple(input_shape)
         self.state: Optional[Dict[str, Any]] = None

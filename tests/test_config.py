@@ -241,3 +241,35 @@ def test_net_without_loss_params_uses_defaults():
     )
     assert net.loss is not None
     assert net.loss.loss == npair_param_to_config(None)
+
+
+def test_example_configs_parse():
+    """Every shipped example prototxt must parse into a coherent config
+    (examples mirror the BASELINE.json workloads)."""
+    from npairloss_tpu.ops.npair_loss import MiningMethod, REFERENCE_CONFIG
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    nets = [os.path.join(repo, "examples", n) for n in (
+        "tiny_net.prototxt", "googlenet_cub.prototxt",
+        "resnet50_sop.prototxt", "resnet50_global_relhard.prototxt")]
+    for path in nets:
+        cfg = load_net(path)
+        assert cfg.data.get("TRAIN") is not None, path
+        assert cfg.loss is not None, path
+
+    cub = load_net(os.path.join(repo, "examples", "googlenet_cub.prototxt"))
+    assert cub.loss.loss.ap_mining_method == MiningMethod.RAND
+    sop = load_net(os.path.join(repo, "examples", "resnet50_sop.prototxt"))
+    assert sop.loss.loss.an_mining_method == MiningMethod.HARD
+    assert sop.loss.loss.margin_diff == -0.05
+    glob_cfg = load_net(
+        os.path.join(repo, "examples", "resnet50_global_relhard.prototxt"))
+    # the shipped def.prototxt mining config, verbatim semantics
+    assert glob_cfg.loss.loss == type(REFERENCE_CONFIG)(
+        **{**REFERENCE_CONFIG.__dict__}
+    )
+
+    solver_cfg, net_path = load_solver(
+        os.path.join(repo, "examples", "googlenet_cub_solver.prototxt"))
+    assert solver_cfg.stepsize == 10000 and solver_cfg.gamma == 0.5
+    assert net_path.endswith("googlenet_cub.prototxt")

@@ -11,6 +11,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from npairloss_tpu.ops.metrics import retrieval_metrics
 from npairloss_tpu.ops.npair_loss import (
+    REFERENCE_CONFIG,
     MiningMethod,
     MiningRegion,
     NPairLossConfig,
@@ -146,14 +147,73 @@ def test_ring_matches_dense_grad(rng, grad_mode):
     np.testing.assert_allclose(rg, dg, rtol=3e-5, atol=1e-6)
 
 
-def test_ring_rejects_relative_mining(rng):
-    cfg = NPairLossConfig(ap_mining_method=MiningMethod.RELATIVE_HARD)
-    assert not ring_supported(cfg)
+REL_CONFIGS = [
+    # The shipped def.prototxt mining config — the flagship workload.
+    REFERENCE_CONFIG,
+    # LOCAL relative on both sides, fraction-valued sn.
+    NPairLossConfig(
+        ap_mining_method=MiningMethod.RELATIVE_EASY, identsn=-0.5,
+        an_mining_method=MiningMethod.RELATIVE_HARD, diffsn=-0.3,
+    ),
+    # Positive sn = absolute rank from the sorted top (cu:285-287).
+    NPairLossConfig(
+        ap_mining_method=MiningMethod.RELATIVE_HARD, identsn=1.0,
+        an_mining_method=MiningMethod.RELATIVE_EASY, diffsn=2.0,
+        margin_diff=0.02,
+    ),
+    # GLOBAL relative on the AN side (block-wide rank, cu:327-334).
+    NPairLossConfig(
+        an_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.RELATIVE_HARD, diffsn=-0.25,
+    ),
+]
+
+
+@pytest.mark.parametrize("cfg_idx", range(len(REL_CONFIGS)))
+def test_ring_relative_matches_dense(rng, cfg_idx):
+    """RELATIVE_* thresholds via streamed radix selection must equal the
+    dense path's host-sort semantics exactly — loss, metrics and grads."""
+    cfg = REL_CONFIGS[cfg_idx]
+    assert ring_supported(cfg)
     mesh = _mesh()
-    with pytest.raises(NotImplementedError):
-        _ring_fns(mesh, cfg)[0](
-            jnp.zeros((8, 4), jnp.float32), jnp.zeros((8,), jnp.int32)
+    g = len(mesh.devices)
+    f, l = _make_inputs(rng, g)
+    dense_v, dense_g = _dense_fns(mesh, cfg)
+    ring_v, ring_g = _ring_fns(mesh, cfg)
+    fj, lj = jnp.asarray(f), jnp.asarray(l)
+    dl, dm = dense_v(fj, lj)
+    rl, rm = ring_v(fj, lj)
+    np.testing.assert_allclose(
+        np.asarray(rl), np.asarray(dl), rtol=2e-5, atol=1e-6
+    )
+    for k in ("retrieve_top1", "retrieve_top5", "retrieve_top10"):
+        np.testing.assert_allclose(
+            np.asarray(rm[k]), np.asarray(dm[k]), rtol=2e-5, err_msg=k
         )
+    np.testing.assert_allclose(
+        np.asarray(ring_g(fj, lj)), np.asarray(dense_g(fj, lj)),
+        rtol=3e-5, atol=1e-6,
+    )
+
+
+def test_ring_relative_clamp_quirk(rng):
+    """A negative-valued relative threshold clamps to -FLT_MAX (cu:288
+    etc.); scaled-down features make every similarity negative-capable."""
+    cfg = NPairLossConfig(
+        ap_mining_method=MiningMethod.RELATIVE_HARD, identsn=-0.9,
+        an_mining_method=MiningMethod.RELATIVE_HARD, diffsn=-0.9,
+    )
+    mesh = _mesh()
+    g = len(mesh.devices)
+    f, l = _make_inputs(rng, g)
+    f = -np.abs(f)  # all-negative features -> negative thresholds
+    dense_v, _ = _dense_fns(mesh, cfg)
+    ring_v, _ = _ring_fns(mesh, cfg)
+    dl, _ = dense_v(jnp.asarray(f), jnp.asarray(l))
+    rl, _ = ring_v(jnp.asarray(f), jnp.asarray(l))
+    np.testing.assert_allclose(
+        np.asarray(rl), np.asarray(dl), rtol=2e-5, atol=1e-6
+    )
 
 
 def test_ring_ident_counts_match_dense(rng):
@@ -226,10 +286,25 @@ def test_solver_ring_step_trains(rng):
     assert min(losses[-4:]) <= max(losses[:4])
 
 
-def test_solver_ring_rejects_relative():
+def test_solver_ring_reference_config_trains(rng):
+    """The flagship GLOBAL/RELATIVE_HARD config runs end-to-end in ring
+    mode (previously dense-only)."""
+    from npairloss_tpu.data import synthetic_identity_batches
     from npairloss_tpu.models import get_model
-    from npairloss_tpu.train import Solver
+    from npairloss_tpu.train import Solver, SolverConfig
 
-    cfg = NPairLossConfig(ap_mining_method=MiningMethod.RELATIVE_HARD)
-    with pytest.raises(ValueError, match="ring mode"):
-        Solver(get_model("mlp"), cfg, mesh=_mesh(), use_ring=True)
+    mesh = _mesh()
+    g = len(mesh.devices)
+    solver = Solver(
+        get_model("mlp", hidden=(16,), embedding_dim=8),
+        REFERENCE_CONFIG,
+        SolverConfig(base_lr=0.1, lr_policy="fixed", display=0, snapshot=0),
+        mesh=mesh,
+        input_shape=(12,),
+        use_ring=True,
+    )
+    batches = synthetic_identity_batches(4 * g, 2 * g, 2, (12,), noise=0.6)
+    for _ in range(4):
+        x, lab = next(batches)
+        m = solver.step(x, lab)
+    assert np.isfinite(float(m["loss"]))
