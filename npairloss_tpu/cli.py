@@ -24,21 +24,32 @@ from typing import Optional
 log = logging.getLogger("npairloss_tpu.cli")
 
 
-def _build_data(net_cfg, phase: str, input_shape, seed: int = 0):
-    """Batches for a phase: real MultibatchData pipeline when the source
-    list file exists, synthetic identity-balanced clusters otherwise."""
+def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
+                synthetic: bool = False):
+    """Batches for a phase: the real MultibatchData pipeline from the
+    net's source list file, or synthetic identity-balanced clusters when
+    ``--synthetic`` was passed explicitly.
+
+    A missing/unreadable source is a hard error unless --synthetic: a
+    typo'd path must never silently "train" on random clusters.
+    """
     d = net_cfg.data.get(phase)
     if d is None:
         return None, None
-    if d.source and os.path.exists(d.source):
-        try:
-            from npairloss_tpu.data import multibatch_loader
-
-            return multibatch_loader(d, net_cfg.transformer, seed=seed), d
-        except ImportError:
-            log.warning(
-                "real-data loader unavailable; falling back to synthetic"
+    if not synthetic:
+        if not d.source:
+            raise SystemExit(
+                f"{phase} data layer has no `source` list file; pass "
+                "--synthetic to train on synthetic identity clusters"
             )
+        if not os.path.exists(d.source):
+            raise SystemExit(
+                f"{phase} data source {d.source!r} does not exist; fix the "
+                "net prototxt or pass --synthetic for synthetic data"
+            )
+        from npairloss_tpu.data import multibatch_loader
+
+        return multibatch_loader(d, net_cfg.transformer, seed=seed), d
     from npairloss_tpu.data import synthetic_identity_batches
 
     ids = d.identity_num_per_batch or max(2, (d.batch_size or 8) // 2)
@@ -116,8 +127,12 @@ def cmd_train(args) -> int:
     if args.resume:
         solver.restore_snapshot(args.resume)
 
-    train_iter, _ = _build_data(net_cfg, "TRAIN", input_shape, seed=0)
-    test_iter, _ = _build_data(net_cfg, "TEST", input_shape, seed=1)
+    train_iter, _ = _build_data(
+        net_cfg, "TRAIN", input_shape, seed=0, synthetic=args.synthetic
+    )
+    test_iter, _ = _build_data(
+        net_cfg, "TEST", input_shape, seed=1, synthetic=args.synthetic
+    )
     if train_iter is None:
         log.error("net %s has no TRAIN MultibatchData layer", net_path)
         return 2
@@ -184,6 +199,11 @@ def main(argv: Optional[list] = None) -> int:
     t.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
     t.add_argument("--resume", help="snapshot path to restore")
     t.add_argument("--snapshot_prefix", help="override snapshot prefix")
+    t.add_argument(
+        "--synthetic", action="store_true",
+        help="train on synthetic identity-balanced clusters instead of the "
+        "net's data source (required opt-in; a missing source is an error)",
+    )
     t.set_defaults(fn=cmd_train)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")

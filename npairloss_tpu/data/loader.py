@@ -227,9 +227,11 @@ def multibatch_loader(
     config can use it (fixed resize dims — the loader's batch contract);
     "never" forces the Python pipeline; "require" raises when the native
     runtime is unavailable.  Decode-format support differs: native reads
+    JPEG (when built against libjpeg — the CUB/SOP case) plus
     PPM/PGM/BMP/NPY-u8; the Python path reads anything PIL does — a
     native worker hitting an unsupported format surfaces the error on
-    the next batch, so "auto" keeps Python for such datasets.
+    the next batch, so "auto" keeps Python for such datasets (routing
+    samples the first ~4k list entries, see _list_file_all_suffixed).
     """
     if train is None:
         train = cfg.phase == "TRAIN"
@@ -238,8 +240,9 @@ def multibatch_loader(
     if native != "never" and cfg.new_height and cfg.new_width:
         from npairloss_tpu.data import native as nd
 
-        supported = (".ppm", ".pgm", ".bmp", ".npy")
         available = nd.native_available()  # cached; check before file I/O
+        # JPEG routes native only when the build linked libjpeg.
+        supported = nd.native_suffixes() if available else ()
         if native == "require" and not available:
             raise RuntimeError("native data runtime unavailable")
         try:
@@ -265,7 +268,15 @@ def multibatch_loader(
     )
 
 
-def _list_file_all_suffixed(source: str, suffixes) -> bool:
+def _list_file_all_suffixed(source: str, suffixes, sample: int = 4096) -> bool:
+    """True when the list file's entries all carry a native-decodable
+    suffix.  Bounded: only the first ``sample`` entries are examined (an
+    O(dataset) pre-scan per loader is not acceptable for million-image
+    lists); datasets are overwhelmingly suffix-homogeneous, and a
+    mixed-format tail misrouted to the native runtime fails loudly at
+    decode time rather than silently.
+    """
+    seen = 0
     with open(source, "r", encoding="utf-8") as f:
         for line in f:
             line = line.strip()
@@ -274,4 +285,7 @@ def _list_file_all_suffixed(source: str, suffixes) -> bool:
             path = line.rsplit(None, 1)[0].lower()
             if not path.endswith(suffixes):
                 return False
+            seen += 1
+            if seen >= sample:
+                break
     return True

@@ -2,8 +2,8 @@
 
 The C++ library is the TPU-side equivalent of the reference's C++
 MultibatchData layer (SURVEY.md §1 L1, §3.5): list-file dataset,
-identity-balanced sampler, PPM/BMP/NPY decode + bilinear resize, and a
-worker-pool prefetch ring — all off the GIL.  It is compiled on demand
+identity-balanced sampler, JPEG (system libjpeg)/PPM/BMP/NPY decode +
+bilinear resize, and a worker-pool prefetch ring — all off the GIL.  It is compiled on demand
 with g++ (no pip deps); when the toolchain or the library is
 unavailable, callers fall back to the pure-Python pipeline
 (``data.loader``), which has identical contract semantics.
@@ -37,18 +37,44 @@ def _build() -> str:
     # concurrent processes never dlopen a half-written .so.
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
     os.close(fd)
-    cmd = [
+    base = [
         "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
         _SRC, "-o", tmp,
     ]
+    # First choice links the system libjpeg (JPEG datasets — CUB/SOP —
+    # stay native).  Retry without JPEG ONLY on a jpeg-specific link
+    # failure (header present, runtime library missing): any other
+    # failure must surface, not silently cache a JPEG-less .so forever.
     try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        subprocess.run(
+            base + ["-ljpeg"], check=True, capture_output=True, text=True
+        )
+        os.replace(tmp, _LIB)
+        return _LIB
     except (subprocess.CalledProcessError, FileNotFoundError) as exc:
-        os.unlink(tmp)
+        stderr = getattr(exc, "stderr", "") or str(exc)
+        if "jpeg" not in stderr.lower():
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise RuntimeError(f"native build failed: {stderr}") from exc
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "libjpeg link failed (%s); rebuilding native runtime without "
+            "JPEG — JPEG datasets will use the Python/PIL path",
+            stderr.strip().splitlines()[-1] if stderr.strip() else exc,
+        )
+    try:
+        subprocess.run(
+            base + ["-DND_NO_JPEG"], check=True, capture_output=True, text=True
+        )
+        os.replace(tmp, _LIB)
+        return _LIB
+    except (subprocess.CalledProcessError, FileNotFoundError) as exc:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
         detail = getattr(exc, "stderr", "") or str(exc)
         raise RuntimeError(f"native build failed: {detail}") from exc
-    os.replace(tmp, _LIB)
-    return _LIB
 
 
 def _load() -> ctypes.CDLL:
@@ -80,6 +106,12 @@ def _load() -> ctypes.CDLL:
             _lib_error = f"native data runtime unavailable: {exc}"
             raise RuntimeError(_lib_error) from exc
         lib.nd_last_error.restype = ctypes.c_char_p
+        lib.nd_has_jpeg.restype = ctypes.c_int
+        lib.nd_dataset_dims.restype = ctypes.c_int
+        lib.nd_dataset_dims.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
         lib.nd_dataset_open.restype = ctypes.c_void_p
         lib.nd_dataset_open.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
@@ -118,6 +150,23 @@ def native_available() -> bool:
         return False
 
 
+def native_jpeg_supported() -> bool:
+    """True when the compiled runtime decodes JPEG (linked libjpeg)."""
+    try:
+        return bool(_load().nd_has_jpeg())
+    except RuntimeError:
+        return False
+
+
+def native_suffixes() -> Tuple[str, ...]:
+    """Image-file suffixes the loaded native runtime decodes itself —
+    the routing contract for data.loader.multibatch_loader."""
+    base = (".ppm", ".pgm", ".bmp", ".npy")
+    if native_jpeg_supported():
+        return base + (".jpg", ".jpeg")
+    return base
+
+
 def _err(lib) -> str:
     return lib.nd_last_error().decode("utf-8", "replace")
 
@@ -125,7 +174,8 @@ def _err(lib) -> str:
 class NativeListFileDataset:
     """Native-decode counterpart of ``data.dataset.ListFileDataset``:
     same "relative/path label" list contract, decode in C++
-    (PPM/PGM/BMP/NPY-u8), OpenCV-convention bilinear resize."""
+    (JPEG when built with libjpeg, PPM/PGM/BMP/NPY-u8),
+    OpenCV-convention bilinear resize."""
 
     def __init__(self, root_folder: str, source: str,
                  new_height: int = 0, new_width: int = 0):
@@ -149,6 +199,19 @@ class NativeListFileDataset:
 
     def __len__(self) -> int:
         return self._n
+
+    def dims(self, index: int) -> Tuple[int, int]:
+        """(h, w) of the item's output buffer before loading: the fixed
+        resize dims, or the decoded native dims when unset."""
+        if self._handle is None:
+            raise RuntimeError("dataset is closed")
+        oh, ow = ctypes.c_int(), ctypes.c_int()
+        rc = self._lib.nd_dataset_dims(
+            self._handle, int(index), ctypes.byref(oh), ctypes.byref(ow)
+        )
+        if rc != 0:
+            raise RuntimeError(_err(self._lib))
+        return int(oh.value), int(ow.value)
 
     def load(self, index: int) -> np.ndarray:
         if self._handle is None:

@@ -150,13 +150,20 @@ def _relative_pos(count: jax.Array, sn: float) -> jax.Array:
       sn <  0 : int(size - 1 + sn * size)     (top |sn| fraction)
     using C truncation-toward-zero (cu:285-287, cu:300-302, cu:316-318,
     cu:331-333).  Out-of-range indices are UB in the reference; we clamp.
+
+    An int64 ``count`` (GLOBAL-region pair populations beyond 2^31, only
+    representable under jax_enable_x64) keeps 64-bit index math; the
+    fraction path then uses float64 so the truncated rank stays exact.
     """
-    count = count.astype(jnp.int32)
+    big = count.dtype == jnp.int64
+    idt = jnp.int64 if big else jnp.int32
+    count = count.astype(idt)
     if sn >= 0:
         pos = count - 1 - int(sn)
     else:
-        cf = count.astype(jnp.float32)
-        pos = jnp.trunc(cf - 1.0 + jnp.float32(sn) * cf).astype(jnp.int32)
+        fdt = jnp.float64 if big else jnp.float32
+        cf = count.astype(fdt)
+        pos = jnp.trunc(cf - 1.0 + fdt(sn) * cf).astype(idt)
     return jnp.clip(pos, 0, jnp.maximum(count - 1, 0))
 
 
@@ -238,10 +245,12 @@ def mining_thresholds(
 
 
 def streaming_supported(cfg: "NPairLossConfig") -> bool:
-    """True when the mining config needs only streamable min/max thresholds
-    (absolute methods); RELATIVE_* needs rank statistics over the full pair
-    population, which only the dense path computes.  Shared contract for
-    the ring (parallel.ring) and Pallas-blockwise (ops.pallas_npair) paths."""
+    """True when the mining config needs only single-pass min/max thresholds
+    (absolute methods).  Both streaming engines (parallel.ring and
+    ops.pallas_npair) support EVERY config — RELATIVE_* via exact radix
+    selection — but a False here means the config pays 4 extra streamed
+    passes over the pair tiles per relative threshold; use this as the
+    cost signal, not a support gate."""
     return (
         cfg.ap_mining_method in _ABSOLUTE and cfg.an_mining_method in _ABSOLUTE
     )

@@ -28,9 +28,15 @@ across a gemm:
     axis innermost), so each output block stays VMEM-resident across
     its whole accumulation.
 
-Mining-method support matches the ring path (``parallel.ring``): the
-absolute methods (HARD / EASY / RAND) stream exactly; RELATIVE_* needs
-rank statistics over the full pair population — use the dense path.
+Mining-method support matches the ring path (``parallel.ring``): ALL
+methods are exact.  Absolute (HARD / EASY / RAND) thresholds stream as
+min/max reductions inside ``_stats_kernel``; RELATIVE_* thresholds —
+rank statistics over the full pair population, which the reference
+obtains by sorting the whole matrix on the host (cu:266-273) — are
+recovered exactly by MSD radix selection (``ops.rank_select``): four
+extra streamed passes over the pair tiles, each histogramming one 8-bit
+digit of the monotone sortable float key, narrow the target rank to a
+single bit pattern without ever materializing the population.
 
 On non-TPU backends the kernels run in Pallas interpreter mode, which is
 how the CPU test suite checks bit-parity against the dense path.
@@ -49,22 +55,27 @@ from jax.experimental.pallas import tpu as pltpu
 
 from npairloss_tpu.ops.npair_loss import (
     FLT_MAX,
+    MiningMethod,
+    MiningRegion,
     NPairLossConfig,
+    _clamp_negative,
+    _relative_pos,
     absolute_thresholds,
     selection_predicates,
-    streaming_supported,
+)
+from npairloss_tpu.ops.rank_select import (
+    masked_digit_hist,
+    population_count_dtype,
+    radix_select,
 )
 
-# Same streaming contract as the ring path (parallel.ring).
-blockwise_supported = streaming_supported
+_RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
 
 
-def _check_cfg(cfg: NPairLossConfig) -> None:
-    if not blockwise_supported(cfg):
-        raise NotImplementedError(
-            "blockwise kernels stream min/max thresholds only; RELATIVE_* "
-            "mining needs the dense path (npair_loss_with_aux)"
-        )
+def blockwise_supported(cfg: NPairLossConfig) -> bool:
+    """Every mining configuration streams (RELATIVE_* via radix select),
+    matching the ring path's support matrix."""
+    return True
 
 
 def _default_interpret() -> bool:
@@ -150,7 +161,7 @@ def _selection(sims, same, diff, pt, nt, cfg: NPairLossConfig):
 
 def _stats_kernel(
     scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
-    min_w_ref, max_b_ref, max_a_ref,
+    min_w_ref, max_b_ref, max_a_ref, cnt_s_ref, cnt_d_ref,
 ):
     # grid = (num_q_blocks, num_pool_blocks)
     qi, ii = pl.program_id(0), pl.program_id(1)
@@ -163,6 +174,8 @@ def _stats_kernel(
         min_w_ref[:] = jnp.full_like(min_w_ref, pos)
         max_b_ref[:] = jnp.full_like(max_b_ref, neg)
         max_a_ref[:] = jnp.full_like(max_a_ref, neg)
+        cnt_s_ref[:] = jnp.zeros_like(cnt_s_ref)
+        cnt_d_ref[:] = jnp.zeros_like(cnt_d_ref)
 
     sims = _sim_tile(feats_ref, pool_ref)
     same, diff = _tile_masks(scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm)
@@ -176,6 +189,10 @@ def _stats_kernel(
         max_a_ref[:],
         jnp.where(same | diff, sims, neg).max(axis=1, keepdims=True).T,
     )
+    # Pair-population sizes (the ragged list sizes of cu:266-273) feed the
+    # RELATIVE_* rank targets.
+    cnt_s_ref[:] += same.sum(axis=1, keepdims=True).astype(jnp.int32).T
+    cnt_d_ref[:] += diff.sum(axis=1, keepdims=True).astype(jnp.int32).T
 
 
 def _make_loss_kernel(cfg: NPairLossConfig):
@@ -353,12 +370,14 @@ def _run_stats(feats_p, labels_p, pool_p, pool_labels_p, scal,
                bn, bm, interpret):
     npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
     npi = pool_p.shape[0] // bm
+    n_p = feats_p.shape[0]
     out = pl.pallas_call(
         _stats_kernel,
         grid=(npq, npi),
         in_specs=_data_specs(bn, bm, dim, 0),
-        out_specs=[_qvec(bn, 0)] * 3,
-        out_shape=[jax.ShapeDtypeStruct((1, feats_p.shape[0]), jnp.float32)] * 3,
+        out_specs=[_qvec(bn, 0)] * 5,
+        out_shape=[jax.ShapeDtypeStruct((1, n_p), jnp.float32)] * 3
+        + [jax.ShapeDtypeStruct((1, n_p), jnp.int32)] * 2,
         interpret=interpret,
     )(scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p))
     return tuple(o[0, :] for o in out)
@@ -416,6 +435,90 @@ def _run_bwd(feats_p, labels_p, pool_p, pool_labels_p, scal,
 
 
 # ---------------------------------------------------------------------------
+# Streamed RELATIVE_* thresholds: exact MSD radix selection over tiles
+# ---------------------------------------------------------------------------
+
+
+def _streamed_relative_threshold(
+    features, labels, use_same: bool, sn: float, region: MiningRegion,
+    counts, block: int,
+):
+    """k-th smallest masked pair value over the self-pool, exactly,
+    without the pair matrix.
+
+    Reproduces the dense ``_local/_global_relative_threshold`` semantics
+    (ascending sort + ``_relative_pos`` index + ``< 0 -> -FLT_MAX``
+    clamp, reference cu:275-337) via ops.rank_select: 4 streamed passes
+    of MSD radix selection — each a lax.scan over pool tiles recomputing
+    the sim tile and histogramming one 8-bit digit — pin down all 32
+    bits of the target element.  GLOBAL region ranks over the whole
+    flattened population (cu:296, cu:327), LOCAL per query.
+    """
+    n, dim = features.shape
+    is_global = region == MiningRegion.GLOBAL
+
+    if is_global:
+        # Self-pool population is at most n x n pairs; beyond int32 the
+        # counts (and the rank walk) must be 64-bit or fail loudly.
+        cdt = population_count_dtype(n * n)
+        total = counts.astype(cdt).sum()
+        k = jnp.broadcast_to(_relative_pos(total[None], sn)[0], (n,))
+        empty = jnp.broadcast_to(total == 0, (n,))
+    else:
+        cdt = jnp.int32  # per-query counts are bounded by the pool size
+        k = _relative_pos(counts, sn)
+        empty = counts == 0
+
+    pool = _pad_rows(features, block).reshape(-1, block, dim)
+    pool_l = _pad_rows(labels, block).reshape(-1, block)
+    nblocks = pool.shape[0]
+    row = jnp.arange(n, dtype=jnp.int32)[:, None]
+
+    def hist_fn(prefix, digit):
+        def step(hist, blk):
+            bf, bl, idx = blk
+            sims = jnp.dot(
+                features, bf.T,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            col = idx * block + jnp.arange(block, dtype=jnp.int32)[None, :]
+            valid = (col < n) & (col != row)  # padding + self-pair (cu:54)
+            same_lbl = labels[:, None] == bl[None, :]
+            mask = (same_lbl if use_same else ~same_lbl) & valid
+            return hist + masked_digit_hist(sims, mask, prefix, digit), None
+
+        hist, _ = jax.lax.scan(
+            step, jnp.zeros((n, 256), jnp.int32),
+            (pool, pool_l, jnp.arange(nblocks, dtype=jnp.int32)),
+        )
+        if is_global:
+            hist = jnp.broadcast_to(
+                hist.sum(axis=0, keepdims=True, dtype=cdt), (n, 256)
+            )
+        return hist
+
+    return _clamp_negative(radix_select(hist_fn, k, empty))
+
+
+def _thresholds(features, labels_i, min_w, max_b, cnt_s, cnt_d, cfg, bm):
+    """(pos_thr, neg_thr) for ANY mining config: absolute methods from the
+    streamed min/max stats, RELATIVE_* via exact radix selection."""
+    pos_thr, neg_thr = absolute_thresholds(min_w, max_b, cfg)
+    if cfg.ap_mining_method in _RELATIVE:
+        pos_thr = _streamed_relative_threshold(
+            features, labels_i, True, cfg.identsn, cfg.ap_mining_region,
+            cnt_s, bm,
+        )
+    if cfg.an_mining_method in _RELATIVE:
+        neg_thr = _streamed_relative_threshold(
+            features, labels_i, False, cfg.diffsn, cfg.an_mining_region,
+            cnt_d, bm,
+        )
+    return pos_thr, neg_thr
+
+
+# ---------------------------------------------------------------------------
 # Public API: self-pool loss with custom VJP (dense-path parity, G = 1)
 # ---------------------------------------------------------------------------
 
@@ -436,11 +539,13 @@ def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret):
     pool_labels_p = _pad_rows(labels_i, bm)
     scal = jnp.array([n, 0, n], jnp.int32)  # [m_real, self_offset, n_real]
 
-    min_w, max_b, max_all = _run_stats(
+    min_w, max_b, max_all, cnt_s, cnt_d = _run_stats(
         feats_p, labels_qp, pool_p, pool_labels_p, scal, bn, bm, interpret
     )
     min_w, max_b, max_all = min_w[:n], max_b[:n], max_all[:n]
-    pos_thr, neg_thr = absolute_thresholds(min_w, max_b, cfg)
+    pos_thr, neg_thr = _thresholds(
+        features, labels_i, min_w, max_b, cnt_s[:n], cnt_d[:n], cfg, bm
+    )
     out = _run_loss(
         feats_p, labels_qp, pool_p, pool_labels_p, scal,
         _pad_rows(pos_thr, bn), _pad_rows(neg_thr, bn), _pad_rows(max_all, bn),
@@ -523,15 +628,15 @@ def blockwise_npair_loss_with_aux(
     """N-pair loss over a self-pool too large for the dense N x N matrix.
 
     Semantically identical (loss and gradient) to
-    ``npair_loss_with_aux(features, labels, cfg)`` for absolute mining
-    methods, but peak memory is O(q_block x D + block x D + q_block x
+    ``npair_loss_with_aux(features, labels, cfg)`` for every mining
+    configuration (RELATIVE_* thresholds via streamed radix selection),
+    but peak memory is O(q_block x D + block x D + q_block x
     block) VMEM per tile — the pair matrix is produced and consumed
     tile-by-tile inside Pallas kernels.  ``aux`` carries the
     streaming-computable monitors (pair counts, thresholds) — the full
     similarity matrices of the dense aux are exactly what this path
     exists to avoid.
     """
-    _check_cfg(cfg)
     if interpret is None:
         interpret = _default_interpret()
     n = features.shape[0]

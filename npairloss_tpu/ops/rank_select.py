@@ -51,15 +51,19 @@ def radix_select(hist_fn: HistFn, k: jax.Array, empty: jax.Array) -> jax.Array:
     """Value of the k-th smallest candidate per query (0-based), exact.
 
     Args:
-      hist_fn: digit histogram oracle over the streamed population.
-      k: int32 [N] target rank per query (pre-clipped to [0, count-1]).
+      hist_fn: digit histogram oracle over the streamed population.  Its
+        count dtype must match ``k``'s: int32 for populations below
+        2^31, int64 (requires jax_enable_x64) beyond — int32 cumulative
+        counts would wrap negative and silently select the wrong rank.
+      k: int [N] target rank per query (pre-clipped to [0, count-1]).
       empty: bool [N]; rows with no candidates yield +FLT_MAX — the
         dense path's +FLT_MAX-padded sort yields FLT_MAX at any index.
     """
-    k = k.astype(jnp.int32)
+    idt = jnp.int64 if k.dtype == jnp.int64 else jnp.int32
+    k = k.astype(idt)
     prefix = jnp.zeros(k.shape, jnp.uint32)
     for digit in range(4):
-        hist = hist_fn(prefix, digit)
+        hist = hist_fn(prefix, digit).astype(idt)
         cum = jnp.cumsum(hist, axis=1)
         # First digit bin whose cumulative count exceeds k.
         b = jnp.minimum((cum <= k[:, None]).sum(axis=1), 255)
@@ -68,11 +72,30 @@ def radix_select(hist_fn: HistFn, k: jax.Array, empty: jax.Array) -> jax.Array:
             jnp.take_along_axis(
                 cum, jnp.maximum(b - 1, 0)[:, None], axis=1
             )[:, 0],
-            0,
+            idt(0),
         )
         k = k - below
         prefix = (prefix << jnp.uint32(8)) | b.astype(jnp.uint32)
     return jnp.where(empty, jnp.float32(FLT_MAX), key_to_float(prefix))
+
+
+def population_count_dtype(max_population: int):
+    """Count dtype for a (statically bounded) pair population.
+
+    GLOBAL-region rank targets sum per-query pair counts over the whole
+    block — up to N x M pairs — so int32 wraps negative beyond 2^31 and
+    radix selection would silently pick the wrong element.  Raises
+    loudly when 64-bit counts are needed but jax_enable_x64 is off.
+    """
+    if max_population <= 2**31 - 1:
+        return jnp.int32
+    if not jax.config.jax_enable_x64:
+        raise NotImplementedError(
+            f"GLOBAL RELATIVE_* mining over a pair population of up to "
+            f"{max_population} (> 2^31 - 1) needs 64-bit streamed counts; "
+            "enable jax_enable_x64"
+        )
+    return jnp.int64
 
 
 def digit_of(key: jax.Array, digit: int) -> jax.Array:

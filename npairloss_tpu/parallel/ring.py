@@ -36,7 +36,9 @@ masked pair value is recovered EXACTLY by MSD radix selection over
 sortable float bit-keys: 4 ring passes, each histogramming one 8-bit
 digit of the monotone uint32 key, narrow to the target element's exact
 bit pattern (SURVEY.md §7's "distributed top-k" growth path).  Memory
-stays O(N x N_block); a relative threshold costs 4 extra rotations.
+stays O(N x N_block); a relative threshold costs 4 extra ring passes
+(4*G rotations), each recomputing every N x N_block pair tile — 8
+passes when both AP and AN are RELATIVE_*.
 """
 
 from __future__ import annotations
@@ -58,7 +60,11 @@ from npairloss_tpu.ops.npair_loss import (
     absolute_thresholds,
     selection_mask,
 )
-from npairloss_tpu.ops.rank_select import masked_digit_hist, radix_select
+from npairloss_tpu.ops.rank_select import (
+    masked_digit_hist,
+    population_count_dtype,
+    radix_select,
+)
 
 _RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
 
@@ -232,17 +238,21 @@ def _streamed_relative_threshold(
     clamp, reference cu:275-337) via ops.rank_select: 4 ring passes of
     MSD radix selection pin down all 32 bits of the target element.
     GLOBAL region ranks over this rank's whole flattened N x (N*G)
-    block (cu:296, cu:327), LOCAL per query.  Counts larger than int32
-    (> 2^31 pairs per shard block) are out of scope.
+    block (cu:296, cu:327), LOCAL per query.  Block populations beyond
+    2^31 pairs use 64-bit counts (requires jax_enable_x64) or fail
+    loudly at trace time — int32 would wrap and silently mis-rank.
     """
     n_local = feats.shape[0]
     is_global = region == MiningRegion.GLOBAL
 
     if is_global:
-        total = counts.sum()
+        g = jax.lax.axis_size(axis_name)
+        cdt = population_count_dtype(n_local * n_local * g)
+        total = counts.astype(cdt).sum()
         k = jnp.broadcast_to(_relative_pos(total[None], sn)[0], (n_local,))
         empty = jnp.broadcast_to(total == 0, (n_local,))
     else:
+        cdt = jnp.int32  # per-query counts are bounded by the pool size
         k = _relative_pos(counts, sn)
         empty = counts == 0
 
@@ -252,7 +262,8 @@ def _streamed_relative_threshold(
         )
         if is_global:
             hist = jnp.broadcast_to(
-                hist.sum(axis=0, keepdims=True), hist.shape
+                hist.sum(axis=0, keepdims=True, dtype=cdt),
+                (n_local, 256),
             )
         return hist
 

@@ -1,0 +1,62 @@
+"""CLI contract tests: the ``caffe train`` counterpart must never train
+on data the user did not ask for — a missing/absent data source is a hard
+error unless synthetic data was explicitly opted into (--synthetic)."""
+
+import os
+
+import pytest
+
+from npairloss_tpu.cli import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _repo_cwd(monkeypatch):
+    # The tiny solver references its net relative to the repo root, as
+    # Caffe resolves net paths relative to the CWD.
+    monkeypatch.chdir(REPO)
+
+
+def test_train_without_source_fails_loudly():
+    """The tiny net's MultibatchData has no `source`: training it without
+    --synthetic must exit with an error, not silently fabricate data."""
+    with pytest.raises(SystemExit, match="source|synthetic"):
+        main([
+            "train", "--solver", "examples/tiny_solver.prototxt",
+            "--model", "mlp", "--max_iter", "2",
+        ])
+
+
+def test_train_missing_source_path_fails_loudly(tmp_path):
+    """A typo'd source path is a hard error (VERDICT r1: the CLI used to
+    silently 'succeed' on random clusters)."""
+    net = tmp_path / "net.prototxt"
+    net.write_text("""
+name: "TinyMLP"
+layer {
+  name: "d" type: "MultibatchData" top: "d" top: "l"
+  include { phase: TRAIN }
+  transform_param { crop_size: 8 }
+  multi_batch_data_param {
+    batch_size: 16 identity_num_per_batch: 8 img_num_per_identity: 2
+    source: "/nonexistent/list.txt"
+  }
+}
+""")
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{net}"\nbase_lr: 0.01\nlr_policy: "fixed"\nmax_iter: 2\n'
+        "display: 0\nsnapshot: 0\ntest_interval: 0\ntest_iter: 0\n"
+    )
+    with pytest.raises(SystemExit, match="does not exist"):
+        main(["train", "--solver", str(solver), "--model", "mlp",
+              "--max_iter", "2"])
+
+
+def test_train_synthetic_opt_in_runs():
+    rc = main([
+        "train", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--max_iter", "2", "--synthetic",
+    ])
+    assert rc == 0

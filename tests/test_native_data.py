@@ -261,6 +261,132 @@ def test_zero_dim_image_rejected(tmp_path):
         ds.load(0)
 
 
+def test_jpeg_decode_matches_pil(tmp_path, rng):
+    """Native JPEG decode (system libjpeg) vs PIL's decode of the same
+    file: both sit on libjpeg, so pixels agree (<= 1 count of IDCT
+    wiggle).  This is the CUB/SOP format (usage/def.prototxt:17-24) —
+    the workload the native runtime was built for."""
+    if not nd.native_jpeg_supported():
+        pytest.skip("native runtime built without libjpeg")
+    from PIL import Image
+
+    arr = rng.integers(0, 256, (24, 32, 3), dtype=np.uint8)
+    p = tmp_path / "x.jpg"
+    Image.fromarray(arr).save(p, quality=92)
+    want = np.asarray(Image.open(p).convert("RGB"))
+    (tmp_path / "l.txt").write_text("x.jpg 0\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 24, 32)
+    got = ds.load(0)
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+    ds.close()
+
+
+def test_jpeg_grayscale_and_progressive(tmp_path, rng):
+    if not nd.native_jpeg_supported():
+        pytest.skip("native runtime built without libjpeg")
+    from PIL import Image
+
+    gray = rng.integers(0, 256, (16, 16), dtype=np.uint8)
+    Image.fromarray(gray, mode="L").save(tmp_path / "g.jpg", quality=95)
+    rgbarr = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
+    Image.fromarray(rgbarr).save(
+        tmp_path / "p.jpg", quality=95, progressive=True
+    )
+    (tmp_path / "l.txt").write_text("g.jpg 0\np.jpg 1\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 16, 16)
+    g = ds.load(0)
+    want_g = np.asarray(Image.open(tmp_path / "g.jpg").convert("RGB"))
+    assert np.abs(g.astype(int) - want_g.astype(int)).max() <= 1
+    p = ds.load(1)
+    want_p = np.asarray(Image.open(tmp_path / "p.jpg").convert("RGB"))
+    assert np.abs(p.astype(int) - want_p.astype(int)).max() <= 1
+    ds.close()
+
+
+def test_jpeg_list_file_routes_native(tmp_path, rng):
+    """A JPEG list file keeps the C++ runtime when libjpeg is linked
+    (VERDICT r1: real datasets silently fell back to the PIL path)."""
+    if not nd.native_jpeg_supported():
+        pytest.skip("native runtime built without libjpeg")
+    from PIL import Image
+
+    from npairloss_tpu.config.schema import DataLayerConfig, TransformParam
+    from npairloss_tpu.data.loader import (
+        NativeMultibatchLoader, multibatch_loader)
+
+    lines = []
+    for ident in range(4):
+        for j in range(2):
+            arr = rng.integers(0, 256, (10, 12, 3), dtype=np.uint8)
+            name = f"i{ident}_{j}.jpg"
+            Image.fromarray(arr).save(tmp_path / name, quality=90)
+            lines.append(f"{name} {ident}")
+    src = tmp_path / "list.txt"
+    src.write_text("\n".join(lines) + "\n")
+    cfg = DataLayerConfig(
+        root_folder=str(tmp_path), source=str(src), batch_size=4,
+        new_height=10, new_width=12,
+        identity_num_per_batch=2, img_num_per_identity=2,
+        transform=TransformParam(),
+    )
+    with multibatch_loader(cfg, native="auto") as ldr:
+        assert isinstance(ldr, NativeMultibatchLoader)
+        x, lab = next(ldr)
+        assert np.asarray(x).shape == (4, 10, 12, 3)
+
+
+def test_corrupt_jpeg_errors_cleanly(tmp_path, rng):
+    if not nd.native_jpeg_supported():
+        pytest.skip("native runtime built without libjpeg")
+    (tmp_path / "bad.jpg").write_bytes(
+        b"\xff\xd8\xff\xe0" + bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+    )
+    (tmp_path / "l.txt").write_text("bad.jpg 0\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 8, 8)
+    with pytest.raises(RuntimeError, match="JPEG"):
+        ds.load(0)
+
+
+def test_pnm_long_comment_header(tmp_path, rng):
+    """Headers with > 512 bytes of comments parse (ADVICE r1: the old
+    bounded-window parser rejected them)."""
+    arr = rng.integers(0, 256, (4, 5, 3), dtype=np.uint8)
+    with open(tmp_path / "c.ppm", "wb") as f:
+        f.write(b"P6\n" + b"# " + b"x" * 700 + b"\n5 4\n255\n" + arr.tobytes())
+    (tmp_path / "l.txt").write_text("c.ppm 0\n")
+    ds = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 4, 5)
+    np.testing.assert_array_equal(ds.load(0), arr)
+
+
+def test_truncated_pnm_header_fails_cleanly(tmp_path):
+    """A header that ends at EOF must error, not compute an offset from
+    tellg() == -1 (ADVICE r1 UB fix)."""
+    for payload in (b"P6", b"P6\n5", b"P6\n5 4\n255"):
+        (tmp_path / "t.ppm").write_bytes(payload)
+        (tmp_path / "l.txt").write_text("t.ppm 0\n")
+        ds = nd.NativeListFileDataset(
+            str(tmp_path), str(tmp_path / "l.txt"), 4, 5
+        )
+        with pytest.raises(RuntimeError, match="PNM"):
+            ds.load(0)
+        ds.close()
+
+
+def test_dataset_dims_abi(tmp_path, rng):
+    """nd_dataset_dims reports the output buffer shape before loading —
+    fixed resize dims, or native dims when unset (ADVICE r1: the sizing
+    contract used to be unsatisfiable outside Python)."""
+    arr = rng.integers(0, 256, (6, 9, 3), dtype=np.uint8)
+    _write_ppm(tmp_path / "d.ppm", arr)
+    (tmp_path / "l.txt").write_text("d.ppm 0\n")
+    fixed = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"), 4, 5)
+    assert fixed.dims(0) == (4, 5)
+    fixed.close()
+    free = nd.NativeListFileDataset(str(tmp_path), str(tmp_path / "l.txt"))
+    assert free.dims(0) == (6, 9)
+    free.close()
+
+
 def test_worker_error_surfaces(tmp_path, rng):
     """A decode failure inside a worker thread must surface in __next__."""
     arr = rng.integers(0, 256, (4, 4, 3), dtype=np.uint8)

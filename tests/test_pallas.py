@@ -17,6 +17,7 @@ from npairloss_tpu.ops.npair_loss import (
     MiningMethod,
     MiningRegion,
     NPairLossConfig,
+    REFERENCE_CONFIG,
     npair_loss_with_aux,
 )
 from npairloss_tpu.ops.metrics import retrieval_metrics
@@ -85,13 +86,108 @@ def test_blockwise_grad_matches_dense(rng, cfg):
     np.testing.assert_allclose(gb, gd, rtol=1e-5, atol=1e-7)
 
 
-def test_blockwise_rejects_relative():
-    cfg = NPairLossConfig(ap_mining_method=MiningMethod.RELATIVE_HARD)
-    assert not blockwise_supported(cfg)
-    with pytest.raises(NotImplementedError):
-        blockwise_npair_loss_with_aux(
-            jnp.zeros((4, 8)), jnp.zeros((4,), jnp.int32), cfg
+REL_CONFIGS = [
+    # The shipped def.prototxt mining config — the flagship workload
+    # (GLOBAL/RELATIVE_HARD AP): previously dense-only on one chip, now
+    # streamed via radix selection so the 32k stretch runs blockwise.
+    REFERENCE_CONFIG,
+    # LOCAL relative on both sides, fraction-valued sn.
+    NPairLossConfig(
+        ap_mining_method=MiningMethod.RELATIVE_EASY, identsn=-0.5,
+        an_mining_method=MiningMethod.RELATIVE_HARD, diffsn=-0.3,
+    ),
+    # Positive sn = absolute rank from the sorted top (cu:285-287).
+    NPairLossConfig(
+        ap_mining_method=MiningMethod.RELATIVE_HARD, identsn=1.0,
+        an_mining_method=MiningMethod.RELATIVE_EASY, diffsn=2.0,
+        margin_diff=0.02,
+    ),
+    # GLOBAL relative on the AN side (block-wide rank, cu:327-334).
+    NPairLossConfig(
+        an_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.RELATIVE_HARD, diffsn=-0.25,
+    ),
+]
+
+
+@pytest.mark.parametrize("cfg_idx", range(len(REL_CONFIGS)))
+@pytest.mark.parametrize("block", [4, 5])
+def test_blockwise_relative_matches_dense(rng, cfg_idx, block):
+    """RELATIVE_* thresholds via streamed radix selection must equal the
+    dense path's host-sort semantics exactly — loss, aux and grads."""
+    cfg = REL_CONFIGS[cfg_idx]
+    assert blockwise_supported(cfg)
+    (f,), (l,) = make_identity_batch(rng, num_ids=6, imgs_per_id=3, dim=16)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+    loss_d, aux_d = npair_loss_with_aux(f, l, cfg)
+    loss_b, aux_b = blockwise_npair_loss_with_aux(f, l, cfg, block_size=block)
+    np.testing.assert_allclose(loss_b, loss_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(aux_b["ident_num"], aux_d["ident_num"])
+    np.testing.assert_allclose(aux_b["diff_num"], aux_d["diff_num"])
+    # Radix selection is bit-exact on the streamed population, but the
+    # streamed sim tiles themselves can differ from the one big dense
+    # matmul by 1 ULP (different XLA kernel shapes accumulate in a
+    # different order) — hence rtol, not equality.
+    np.testing.assert_allclose(
+        aux_b["pos_threshold"], aux_d["pos_threshold"], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        aux_b["neg_threshold"], aux_d["neg_threshold"], rtol=1e-6
+    )
+    gd = jax.grad(lambda x: npair_loss_with_aux(x, l, cfg)[0])(f)
+    gb = jax.grad(
+        lambda x: blockwise_npair_loss_with_aux(x, l, cfg, block_size=block)[0]
+    )(f)
+    np.testing.assert_allclose(gb, gd, rtol=1e-5, atol=1e-7)
+
+
+def test_blockwise_global_relative_int32_overflow_guard():
+    """GLOBAL RELATIVE rank targets sum pair counts over the whole block:
+    beyond 2^31 pairs int32 wraps and would silently mis-rank (caught in
+    review) — without x64 the trace must fail loudly instead."""
+    cfg = NPairLossConfig(
+        an_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.RELATIVE_HARD,
+        diffsn=-0.3,
+    )
+    n = 50_000  # n*n > 2^31 - 1
+    f = jax.ShapeDtypeStruct((n, 8), jnp.float32)
+    l = jax.ShapeDtypeStruct((n,), jnp.int32)
+    with pytest.raises(NotImplementedError, match="2\\^31|64-bit"):
+        jax.eval_shape(
+            lambda f_, l_: blockwise_npair_loss_with_aux(
+                f_, l_, cfg, block_size=512
+            )[0],
+            f, l,
         )
+    # Under the bound the same config traces fine.
+    small = jax.ShapeDtypeStruct((64, 8), jnp.float32)
+    small_l = jax.ShapeDtypeStruct((64,), jnp.int32)
+    jax.eval_shape(
+        lambda f_, l_: blockwise_npair_loss_with_aux(
+            f_, l_, cfg, block_size=32
+        )[0],
+        small, small_l,
+    )
+
+
+def test_blockwise_relative_clamp_quirk(rng):
+    """A negative-valued relative threshold clamps to -FLT_MAX (cu:288
+    etc.); all-negative features force the quirk on the blockwise path."""
+    cfg = NPairLossConfig(
+        ap_mining_method=MiningMethod.RELATIVE_HARD, identsn=-0.9,
+        an_mining_method=MiningMethod.RELATIVE_HARD, diffsn=-0.9,
+    )
+    (f,), (l,) = make_identity_batch(rng, num_ids=5, imgs_per_id=2, dim=8)
+    f = -np.abs(f)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+    loss_d, aux_d = npair_loss_with_aux(f, l, cfg)
+    loss_b, aux_b = blockwise_npair_loss_with_aux(f, l, cfg, block_size=4)
+    np.testing.assert_allclose(loss_b, loss_d, rtol=1e-6)
+    # The clamp replaces the looked-up value with -FLT_MAX exactly.
+    np.testing.assert_allclose(
+        aux_b["pos_threshold"], aux_d["pos_threshold"], rtol=1e-6
+    )
 
 
 def test_blockwise_zero_count_queries(rng):
