@@ -35,6 +35,11 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (e.g. 8 virtual devices "
                          "via XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--mining", choices=["flagship", "absolute"],
+                    default="flagship",
+                    help="flagship = the shipped def.prototxt config "
+                         "(GLOBAL/RELATIVE_HARD AP, streamed radix "
+                         "selection); absolute = LOCAL/HARD only")
     args = ap.parse_args()
 
     import jax
@@ -44,12 +49,19 @@ def main():
     import jax.numpy as jnp
 
     from npairloss_tpu.models import get_model
-    from npairloss_tpu.ops.npair_loss import MiningMethod, NPairLossConfig
+    from npairloss_tpu.ops.npair_loss import (
+        REFERENCE_CONFIG,
+        MiningMethod,
+        NPairLossConfig,
+    )
     from npairloss_tpu.data.synthetic import synthetic_identity_batches
 
-    cfg = NPairLossConfig(
-        margin_diff=-0.05, an_mining_method=MiningMethod.HARD
-    )
+    if args.mining == "flagship":
+        cfg = REFERENCE_CONFIG
+    else:
+        cfg = NPairLossConfig(
+            margin_diff=-0.05, an_mining_method=MiningMethod.HARD
+        )
     devices = jax.devices()
     mode = args.mode
     if mode == "auto":

@@ -63,6 +63,14 @@ def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
 
 
 def cmd_train(args) -> int:
+    # The MPI_COMM_WORLD replacement: must run before the first backend
+    # query (exactly as MPI_Init precedes any communicator use).
+    from npairloss_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        args.coordinator, args.num_processes, args.process_id
+    )
+
     import jax
 
     from npairloss_tpu.config import load_net, load_solver
@@ -204,6 +212,13 @@ def main(argv: Optional[list] = None) -> int:
         help="train on synthetic identity-balanced clusters instead of the "
         "net's data source (required opt-in; a missing source is an error)",
     )
+    t.add_argument(
+        "--coordinator",
+        help="multi-process coordinator HOST:PORT (the mpirun counterpart); "
+        "omit on TPU pods for autodetect",
+    )
+    t.add_argument("--num-processes", type=int, help="total host processes")
+    t.add_argument("--process-id", type=int, help="this process's rank")
     t.set_defaults(fn=cmd_train)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")

@@ -66,7 +66,9 @@ from npairloss_tpu.ops.npair_loss import (
 from npairloss_tpu.ops.rank_select import (
     masked_digit_hist,
     population_count_dtype,
-    radix_select,
+    radix_begin,
+    radix_finish,
+    radix_update,
 )
 
 _RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
@@ -439,43 +441,64 @@ def _run_bwd(feats_p, labels_p, pool_p, pool_labels_p, scal,
 # ---------------------------------------------------------------------------
 
 
-def _streamed_relative_threshold(
-    features, labels, use_same: bool, sn: float, region: MiningRegion,
-    counts, block: int,
-):
-    """k-th smallest masked pair value over the self-pool, exactly,
-    without the pair matrix.
+def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
+    """(pos_thr, neg_thr) for ANY mining config: absolute methods from the
+    streamed min/max stats, RELATIVE_* via exact stepwise radix selection.
 
     Reproduces the dense ``_local/_global_relative_threshold`` semantics
     (ascending sort + ``_relative_pos`` index + ``< 0 -> -FLT_MAX``
     clamp, reference cu:275-337) via ops.rank_select: 4 streamed passes
     of MSD radix selection — each a lax.scan over pool tiles recomputing
     the sim tile and histogramming one 8-bit digit — pin down all 32
-    bits of the target element.  GLOBAL region ranks over the whole
-    flattened population (cu:296, cu:327), LOCAL per query.
+    bits of the target element.  The sim tile is computed ONCE per pass
+    and feeds both the AP and the AN histogram, so relative mining costs
+    4 passes whether one or both sides are relative.  GLOBAL region
+    ranks over the whole flattened population (cu:296, cu:327), LOCAL
+    per query; populations beyond 2^31 pairs need 64-bit counts
+    (jax_enable_x64) or fail loudly at trace time.
     """
+    pos_thr, neg_thr = absolute_thresholds(min_w, max_b, cfg)
+    sides = {}
+    if cfg.ap_mining_method in _RELATIVE:
+        sides["ap"] = (True, cfg.identsn, cfg.ap_mining_region, cnt_s)
+    if cfg.an_mining_method in _RELATIVE:
+        sides["an"] = (False, cfg.diffsn, cfg.an_mining_region, cnt_d)
+    if not sides:
+        return pos_thr, neg_thr
+
     n, dim = features.shape
-    is_global = region == MiningRegion.GLOBAL
-
-    if is_global:
-        # Self-pool population is at most n x n pairs; beyond int32 the
-        # counts (and the rank walk) must be 64-bit or fail loudly.
-        cdt = population_count_dtype(n * n)
-        total = counts.astype(cdt).sum()
-        k = jnp.broadcast_to(_relative_pos(total[None], sn)[0], (n,))
-        empty = jnp.broadcast_to(total == 0, (n,))
-    else:
-        cdt = jnp.int32  # per-query counts are bounded by the pool size
-        k = _relative_pos(counts, sn)
-        empty = counts == 0
-
     pool = _pad_rows(features, block).reshape(-1, block, dim)
     pool_l = _pad_rows(labels, block).reshape(-1, block)
     nblocks = pool.shape[0]
     row = jnp.arange(n, dtype=jnp.int32)[:, None]
 
-    def hist_fn(prefix, digit):
-        def step(hist, blk):
+    def prep_hist(side, hist):
+        _, _, region, _ = sides[side]
+        if region == MiningRegion.GLOBAL:
+            cdt = population_count_dtype(n * n)
+            hist = jnp.broadcast_to(
+                hist.sum(axis=0, keepdims=True, dtype=cdt), (n, 256)
+            )
+        return hist
+
+    states, empties = {}, {}
+    for s, (use_same, sn, region, counts) in sides.items():
+        if region == MiningRegion.GLOBAL:
+            # Self-pool population is at most n x n pairs; beyond int32
+            # the counts (and the rank walk) must be 64-bit or fail.
+            cdt = population_count_dtype(n * n)
+            total = counts.astype(cdt).sum()
+            k = jnp.broadcast_to(_relative_pos(total[None], sn)[0], (n,))
+            empties[s] = jnp.broadcast_to(total == 0, (n,))
+        else:
+            k = _relative_pos(counts, sn)
+            empties[s] = counts == 0
+        states[s] = radix_begin(k)
+
+    for digit in range(4):
+        prefixes = {s: states[s][1] for s in sides}
+
+        def step(hists, blk):
             bf, bl, idx = blk
             sims = jnp.dot(
                 features, bf.T,
@@ -485,37 +508,27 @@ def _streamed_relative_threshold(
             col = idx * block + jnp.arange(block, dtype=jnp.int32)[None, :]
             valid = (col < n) & (col != row)  # padding + self-pair (cu:54)
             same_lbl = labels[:, None] == bl[None, :]
-            mask = (same_lbl if use_same else ~same_lbl) & valid
-            return hist + masked_digit_hist(sims, mask, prefix, digit), None
+            out = dict(hists)
+            for s, (use_same, _, _, _) in sides.items():
+                mask = (same_lbl if use_same else ~same_lbl) & valid
+                out[s] = out[s] + masked_digit_hist(
+                    sims, mask, prefixes[s], digit
+                )
+            return out, None
 
-        hist, _ = jax.lax.scan(
-            step, jnp.zeros((n, 256), jnp.int32),
+        hists, _ = jax.lax.scan(
+            step,
+            {s: jnp.zeros((n, 256), jnp.int32) for s in sides},
             (pool, pool_l, jnp.arange(nblocks, dtype=jnp.int32)),
         )
-        if is_global:
-            hist = jnp.broadcast_to(
-                hist.sum(axis=0, keepdims=True, dtype=cdt), (n, 256)
-            )
-        return hist
+        for s in sides:
+            states[s] = radix_update(states[s], prep_hist(s, hists[s]))
 
-    return _clamp_negative(radix_select(hist_fn, k, empty))
-
-
-def _thresholds(features, labels_i, min_w, max_b, cnt_s, cnt_d, cfg, bm):
-    """(pos_thr, neg_thr) for ANY mining config: absolute methods from the
-    streamed min/max stats, RELATIVE_* via exact radix selection."""
-    pos_thr, neg_thr = absolute_thresholds(min_w, max_b, cfg)
-    if cfg.ap_mining_method in _RELATIVE:
-        pos_thr = _streamed_relative_threshold(
-            features, labels_i, True, cfg.identsn, cfg.ap_mining_region,
-            cnt_s, bm,
-        )
-    if cfg.an_mining_method in _RELATIVE:
-        neg_thr = _streamed_relative_threshold(
-            features, labels_i, False, cfg.diffsn, cfg.an_mining_region,
-            cnt_d, bm,
-        )
-    return pos_thr, neg_thr
+    vals = {
+        s: _clamp_negative(radix_finish(states[s], empties[s]))
+        for s in sides
+    }
+    return vals.get("ap", pos_thr), vals.get("an", neg_thr)
 
 
 # ---------------------------------------------------------------------------

@@ -47,6 +47,42 @@ def key_to_float(key: jax.Array) -> jax.Array:
     return jax.lax.bitcast_convert_type(u, jnp.float32)
 
 
+def radix_begin(k: jax.Array):
+    """(k, prefix) state for a stepwise 4-digit MSD radix selection.
+
+    The stepwise API lets callers drive SEVERAL selections through one
+    shared data pass per digit (the pair tiles are the expensive part —
+    one sim-tile sweep can feed both the AP and the AN histogram) and
+    source the digit-0 histogram from an earlier pass (digit 0 needs no
+    prefix, so the mining-stats sweep can produce it for free).
+    """
+    idt = jnp.int64 if k.dtype == jnp.int64 else jnp.int32
+    return k.astype(idt), jnp.zeros(k.shape, jnp.uint32)
+
+
+def radix_update(state, hist: jax.Array):
+    """Consume one digit histogram; narrow (k, prefix) by 8 bits."""
+    k, prefix = state
+    idt = k.dtype
+    cum = jnp.cumsum(hist.astype(idt), axis=1)
+    # First digit bin whose cumulative count exceeds k.
+    b = jnp.minimum((cum <= k[:, None]).sum(axis=1), 255)
+    below = jnp.where(
+        b > 0,
+        jnp.take_along_axis(
+            cum, jnp.maximum(b - 1, 0)[:, None], axis=1
+        )[:, 0],
+        jnp.asarray(0, idt),
+    )
+    return k - below, (prefix << jnp.uint32(8)) | b.astype(jnp.uint32)
+
+
+def radix_finish(state, empty: jax.Array) -> jax.Array:
+    """Selected value after 4 updates; empty rows yield +FLT_MAX."""
+    _, prefix = state
+    return jnp.where(empty, jnp.float32(FLT_MAX), key_to_float(prefix))
+
+
 def radix_select(hist_fn: HistFn, k: jax.Array, empty: jax.Array) -> jax.Array:
     """Value of the k-th smallest candidate per query (0-based), exact.
 
@@ -59,24 +95,10 @@ def radix_select(hist_fn: HistFn, k: jax.Array, empty: jax.Array) -> jax.Array:
       empty: bool [N]; rows with no candidates yield +FLT_MAX — the
         dense path's +FLT_MAX-padded sort yields FLT_MAX at any index.
     """
-    idt = jnp.int64 if k.dtype == jnp.int64 else jnp.int32
-    k = k.astype(idt)
-    prefix = jnp.zeros(k.shape, jnp.uint32)
+    state = radix_begin(k)
     for digit in range(4):
-        hist = hist_fn(prefix, digit).astype(idt)
-        cum = jnp.cumsum(hist, axis=1)
-        # First digit bin whose cumulative count exceeds k.
-        b = jnp.minimum((cum <= k[:, None]).sum(axis=1), 255)
-        below = jnp.where(
-            b > 0,
-            jnp.take_along_axis(
-                cum, jnp.maximum(b - 1, 0)[:, None], axis=1
-            )[:, 0],
-            idt(0),
-        )
-        k = k - below
-        prefix = (prefix << jnp.uint32(8)) | b.astype(jnp.uint32)
-    return jnp.where(empty, jnp.float32(FLT_MAX), key_to_float(prefix))
+        state = radix_update(state, hist_fn(state[1], digit))
+    return radix_finish(state, empty)
 
 
 def population_count_dtype(max_population: int):

@@ -1,5 +1,10 @@
-"""Distribution: device-mesh plumbing + ring-blockwise negative pooling."""
+"""Distribution: multi-process runtime, device-mesh plumbing +
+ring-blockwise negative pooling."""
 
+from npairloss_tpu.parallel.distributed import (
+    initialize_distributed,
+    process_local_batch,
+)
 from npairloss_tpu.parallel.mesh import (
     DEFAULT_AXIS,
     data_parallel_mesh,
@@ -14,6 +19,8 @@ from npairloss_tpu.parallel.ring import (
 __all__ = [
     "DEFAULT_AXIS",
     "data_parallel_mesh",
+    "initialize_distributed",
+    "process_local_batch",
     "shard_batch",
     "sharded_npair_loss_fn",
     "ring_npair_loss_and_metrics",

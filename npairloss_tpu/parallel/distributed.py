@@ -1,0 +1,84 @@
+"""Multi-process (multi-host) runtime — the MPI_COMM_WORLD replacement.
+
+The reference runs one MPI process per GPU; every collective spans
+``MPI_COMM_WORLD`` (reference: npair_multi_class_loss.cu:32, cu:467),
+launched as ``mpirun -np G caffe train ...``.  The TPU-native equivalent
+is JAX's multi-controller runtime: every host process calls
+``jax.distributed.initialize`` against a shared coordinator, after which
+``jax.devices()`` spans ALL processes and a single 1-D mesh over it makes
+the in-graph ``all_gather``/``psum`` collectives ride ICI within a host
+and DCN across hosts — no code change in the loss or solver.
+
+Launch recipe (the mpirun counterpart):
+
+    # process 0 .. N-1, each on its own host (or simulated on one):
+    python -m npairloss_tpu train --solver ... \
+        --coordinator HOST:PORT --num-processes N --process-id I
+
+On Cloud TPU pods the three flags can be omitted: ``initialize()``
+autodetects from the TPU metadata environment.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("npairloss_tpu.distributed")
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-process runtime (idempotent for single-process).
+
+    Must run before the first JAX backend query in the process — JAX
+    binds local devices at initialization, exactly as MPI_Init must
+    precede any communicator use.  With all arguments ``None`` on a
+    non-TPU-pod host this is a no-op (single-process run).
+    """
+    import jax
+
+    if coordinator_address is None and num_processes is None:
+        return  # single-process / TPU-pod autodetect not requested
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "distributed runtime up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def process_local_batch(mesh, batch, axis: str = "dp"):
+    """Assemble a global sharded array from THIS process's batch shard.
+
+    The reference's data model is per-rank loading: each MPI rank's
+    MultibatchData produces its own N-row batch, and the gathered pool is
+    their concatenation in rank order (cu:17-43).  Multi-controller JAX
+    mirrors that: each process passes its local rows; the result is a
+    global array whose shard on process p is p's data, concatenated in
+    process order along the batch axis.  Single-process meshes fall back
+    to a plain device_put.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), sharding), batch
+        )
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        batch,
+    )

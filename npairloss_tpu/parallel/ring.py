@@ -36,9 +36,10 @@ masked pair value is recovered EXACTLY by MSD radix selection over
 sortable float bit-keys: 4 ring passes, each histogramming one 8-bit
 digit of the monotone uint32 key, narrow to the target element's exact
 bit pattern (SURVEY.md §7's "distributed top-k" growth path).  Memory
-stays O(N x N_block); a relative threshold costs 4 extra ring passes
-(4*G rotations), each recomputing every N x N_block pair tile — 8
-passes when both AP and AN are RELATIVE_*.
+stays O(N x N_block); RELATIVE mining costs 3 extra ring passes (3*G
+rotations, each recomputing every N x N_block pair tile) REGARDLESS of
+whether one or both sides are relative — the digit-0 histogram rides
+the stats pass for free, and digits 1-3 share one pass across sides.
 """
 
 from __future__ import annotations
@@ -63,7 +64,9 @@ from npairloss_tpu.ops.npair_loss import (
 from npairloss_tpu.ops.rank_select import (
     masked_digit_hist,
     population_count_dtype,
-    radix_select,
+    radix_begin,
+    radix_finish,
+    radix_update,
 )
 
 _RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
@@ -144,11 +147,16 @@ def _ring_scan(axis_name: str, body, carry, rotating):
 
 
 def _stats_pass(
-    feats, labels, my_rank, axis_name: str, top_k_max: int
+    feats, labels, my_rank, axis_name: str, top_k_max: int,
+    hist0_same: bool = False, hist0_diff: bool = False,
 ):
+    """Mining statistics in one ring pass; optionally also the digit-0
+    radix histograms for RELATIVE_* sides — digit 0 needs no prefix, so
+    accumulating it here saves one whole ring pass per relative side."""
     n_local = feats.shape[0]
     neg = jnp.float32(-FLT_MAX)
     pos = jnp.float32(FLT_MAX)
+    zero_prefix = jnp.zeros((n_local,), jnp.uint32)
 
     carry = {
         "min_within": jnp.full((n_local,), pos),
@@ -163,6 +171,10 @@ def _stats_pass(
         "top_sims": jnp.full((n_local, top_k_max + 1), neg),
         "top_same": jnp.zeros((n_local, top_k_max + 1), bool),
     }
+    if hist0_same:
+        carry["hist0_same"] = jnp.zeros((n_local, 256), jnp.int32)
+    if hist0_diff:
+        carry["hist0_diff"] = jnp.zeros((n_local, 256), jnp.int32)
     rotating = {
         "f": feats,
         "l": labels,
@@ -184,6 +196,14 @@ def _stats_pass(
         )
         c["count_same"] = c["count_same"] + same.sum(axis=1, dtype=jnp.int32)
         c["count_diff"] = c["count_diff"] + diff.sum(axis=1, dtype=jnp.int32)
+        if hist0_same:
+            c["hist0_same"] = c["hist0_same"] + masked_digit_hist(
+                sims, same, zero_prefix, 0
+            )
+        if hist0_diff:
+            c["hist0_diff"] = c["hist0_diff"] + masked_digit_hist(
+                sims, diff, zero_prefix, 0
+            )
         nonself = same | diff
         cat_sims = jnp.concatenate(
             [c["top_sims"], jnp.where(nonself, sims, neg)], axis=1
@@ -203,14 +223,18 @@ def _stats_pass(
 # ---------------------------------------------------------------------------
 
 
-def _digit_hist_pass(
-    feats, labels, my_rank, axis_name: str, use_same: bool,
-    prefix: jax.Array, digit: int,
-) -> jax.Array:
-    """One ring rotation accumulating the masked digit histogram
-    (ops.rank_select.masked_digit_hist) over all pair tiles."""
+def _multi_digit_hist_pass(
+    feats, labels, my_rank, axis_name: str, sides, digit: int,
+):
+    """One ring rotation accumulating masked digit histograms for EVERY
+    active RELATIVE side at once — the N x N_block sim tile (the
+    expensive part) is computed once and feeds both masks.
+
+    ``sides``: dict side-name -> (use_same, prefix).
+    Returns dict side-name -> int32 [N, 256].
+    """
     n_local = feats.shape[0]
-    carry = {"hist": jnp.zeros((n_local, 256), jnp.int32)}
+    carry = {s: jnp.zeros((n_local, 256), jnp.int32) for s in sides}
     rotating = {"f": feats, "l": labels, "rank": my_rank}
 
     def body(c, rot, step):
@@ -218,77 +242,87 @@ def _digit_hist_pass(
         same, diff = _block_masks(
             labels, rot["l"], my_rank, rot["rank"], n_local
         )
-        mask = same if use_same else diff
         c = dict(c)
-        c["hist"] = c["hist"] + masked_digit_hist(sims, mask, prefix, digit)
+        for s, (use_same, prefix) in sides.items():
+            mask = same if use_same else diff
+            c[s] = c[s] + masked_digit_hist(sims, mask, prefix, digit)
         return c, rot
 
     carry, _ = _ring_scan(axis_name, body, carry, rotating)
-    return carry["hist"]
-
-
-def _streamed_relative_threshold(
-    feats, labels, my_rank, axis_name: str, use_same: bool,
-    sn: float, region: MiningRegion, counts: jax.Array,
-) -> jax.Array:
-    """k-th smallest masked pair value, exactly, without the pair matrix.
-
-    Reproduces the dense ``_local/_global_relative_threshold`` semantics
-    (ascending sort + ``_relative_pos`` index + ``< 0 -> -FLT_MAX``
-    clamp, reference cu:275-337) via ops.rank_select: 4 ring passes of
-    MSD radix selection pin down all 32 bits of the target element.
-    GLOBAL region ranks over this rank's whole flattened N x (N*G)
-    block (cu:296, cu:327), LOCAL per query.  Block populations beyond
-    2^31 pairs use 64-bit counts (requires jax_enable_x64) or fail
-    loudly at trace time — int32 would wrap and silently mis-rank.
-    """
-    n_local = feats.shape[0]
-    is_global = region == MiningRegion.GLOBAL
-
-    if is_global:
-        g = jax.lax.axis_size(axis_name)
-        cdt = population_count_dtype(n_local * n_local * g)
-        total = counts.astype(cdt).sum()
-        k = jnp.broadcast_to(_relative_pos(total[None], sn)[0], (n_local,))
-        empty = jnp.broadcast_to(total == 0, (n_local,))
-    else:
-        cdt = jnp.int32  # per-query counts are bounded by the pool size
-        k = _relative_pos(counts, sn)
-        empty = counts == 0
-
-    def hist_fn(prefix, digit):
-        hist = _digit_hist_pass(
-            feats, labels, my_rank, axis_name, use_same, prefix, digit
-        )
-        if is_global:
-            hist = jnp.broadcast_to(
-                hist.sum(axis=0, keepdims=True, dtype=cdt),
-                (n_local, 256),
-            )
-        return hist
-
-    return _clamp_negative(radix_select(hist_fn, k, empty))
+    return carry
 
 
 def _ring_thresholds(
     feats, labels, my_rank, axis_name: str, cfg: NPairLossConfig, stats
 ):
     """(pos_thr, neg_thr) for any mining config: absolute from streamed
-    min/max stats, RELATIVE_* via exact radix selection."""
+    min/max stats, RELATIVE_* via exact stepwise radix selection.
+
+    Reproduces the dense ``_local/_global_relative_threshold`` semantics
+    (ascending sort + ``_relative_pos`` index + ``< 0 -> -FLT_MAX``
+    clamp, reference cu:275-337) without the pair matrix.  GLOBAL region
+    ranks over this rank's whole flattened N x (N*G) block (cu:296,
+    cu:327), LOCAL per query; block populations beyond 2^31 pairs use
+    64-bit counts (requires jax_enable_x64) or fail loudly at trace
+    time — int32 would wrap and silently mis-rank.
+
+    Cost: the digit-0 histogram comes FREE from the stats pass (digit 0
+    has no prefix), and digits 1-3 share one ring pass per digit across
+    the AP and AN sides — so RELATIVE mining costs 3 extra ring passes
+    total whether one or both sides are relative (down from 4 per side).
+    """
     pos_thr, neg_thr = absolute_thresholds(
         stats["min_within"], stats["max_between"], cfg
     )
+    sides = {}
     if cfg.ap_mining_method in _RELATIVE:
-        pos_thr = _streamed_relative_threshold(
-            feats, labels, my_rank, axis_name, True, cfg.identsn,
-            cfg.ap_mining_region, stats["count_same"],
-        )
+        sides["ap"] = (True, cfg.identsn, cfg.ap_mining_region,
+                       stats["count_same"], stats["hist0_same"])
     if cfg.an_mining_method in _RELATIVE:
-        neg_thr = _streamed_relative_threshold(
-            feats, labels, my_rank, axis_name, False, cfg.diffsn,
-            cfg.an_mining_region, stats["count_diff"],
+        sides["an"] = (False, cfg.diffsn, cfg.an_mining_region,
+                       stats["count_diff"], stats["hist0_diff"])
+    if not sides:
+        return pos_thr, neg_thr
+
+    n_local = feats.shape[0]
+    g = jax.lax.axis_size(axis_name)
+
+    def prep_hist(side, hist):
+        """Global-region sides rank over the whole block: sum the
+        per-query histograms (in the overflow-safe dtype) and share."""
+        _, _, region, _, _ = sides[side]
+        if region == MiningRegion.GLOBAL:
+            cdt = population_count_dtype(n_local * n_local * g)
+            hist = jnp.broadcast_to(
+                hist.sum(axis=0, keepdims=True, dtype=cdt), (n_local, 256)
+            )
+        return hist
+
+    states, empties = {}, {}
+    for s, (use_same, sn, region, counts, hist0) in sides.items():
+        if region == MiningRegion.GLOBAL:
+            cdt = population_count_dtype(n_local * n_local * g)
+            total = counts.astype(cdt).sum()
+            k = jnp.broadcast_to(_relative_pos(total[None], sn)[0], (n_local,))
+            empties[s] = jnp.broadcast_to(total == 0, (n_local,))
+        else:
+            k = _relative_pos(counts, sn)
+            empties[s] = counts == 0
+        states[s] = radix_update(radix_begin(k), prep_hist(s, hist0))
+
+    for digit in range(1, 4):
+        hists = _multi_digit_hist_pass(
+            feats, labels, my_rank, axis_name,
+            {s: (sides[s][0], states[s][1]) for s in sides}, digit,
         )
-    return pos_thr, neg_thr
+        for s in sides:
+            states[s] = radix_update(states[s], prep_hist(s, hists[s]))
+
+    vals = {
+        s: _clamp_negative(radix_finish(states[s], empties[s]))
+        for s in sides
+    }
+    return vals.get("ap", pos_thr), vals.get("an", neg_thr)
 
 
 # ---------------------------------------------------------------------------
@@ -433,7 +467,11 @@ def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks):
     my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
 
     top_k_max = max(top_ks) if top_ks else 1
-    stats = _stats_pass(features, labels, my_rank, axis_name, top_k_max)
+    stats = _stats_pass(
+        features, labels, my_rank, axis_name, top_k_max,
+        hist0_same=cfg.ap_mining_method in _RELATIVE,
+        hist0_diff=cfg.an_mining_method in _RELATIVE,
+    )
     pos_thr, neg_thr = _ring_thresholds(
         features, labels, my_rank, axis_name, cfg, stats
     )

@@ -144,7 +144,19 @@ class Solver:
         }
         if self.mesh is not None:
             replicated = NamedSharding(self.mesh, P())
-            self.state = jax.device_put(self.state, replicated)
+            if jax.process_count() > 1:
+                # Multi-controller: every process holds identical values
+                # (same seed); assemble them into one replicated global
+                # array per leaf — device_put cannot place onto devices
+                # another process owns.
+                self.state = jax.tree_util.tree_map(
+                    lambda x: jax.make_array_from_process_local_data(
+                        replicated, np.asarray(x)
+                    ),
+                    self.state,
+                )
+            else:
+                self.state = jax.device_put(self.state, replicated)
         return self.state
 
     # -- compiled step ----------------------------------------------------
@@ -257,6 +269,19 @@ class Solver:
 
     # -- public API -------------------------------------------------------
 
+    def _put_batch(self, inputs, labels):
+        """Device placement for one batch.  Multi-process meshes follow
+        the reference's per-rank data model (each MPI rank loads its own
+        N rows, cu:17-43): the local batch becomes this process's shard
+        of the global batch, concatenated in process order."""
+        if self.mesh is not None and jax.process_count() > 1:
+            from npairloss_tpu.parallel.distributed import process_local_batch
+
+            return process_local_batch(
+                self.mesh, (np.asarray(inputs), np.asarray(labels)), self.axis
+            )
+        return jnp.asarray(inputs), jnp.asarray(labels)
+
     def step(self, inputs: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
         """One training iteration; returns the step's metric dict."""
         if self.state is None:
@@ -265,9 +290,8 @@ class Solver:
             self.init(np.asarray(inputs)[:2])
         if self._step_fn is None:
             self._make_step()
-        self.state, metrics = self._step_fn(
-            self.state, jnp.asarray(inputs), jnp.asarray(labels)
-        )
+        x, lab = self._put_batch(inputs, labels)
+        self.state, metrics = self._step_fn(self.state, x, lab)
         if debug_checks_enabled():
             # utils.debug switch: validate every step's scalars on host
             # (SURVEY.md §5.2 — the reference had no numeric checks).
@@ -286,7 +310,8 @@ class Solver:
                 self.init(np.asarray(inputs)[:2])
             if self._eval_fn is None:
                 self._make_step()
-            m = self._eval_fn(self.state, jnp.asarray(inputs), jnp.asarray(labels))
+            x, lab = self._put_batch(inputs, labels)
+            m = self._eval_fn(self.state, x, lab)
             for k, v in m.items():
                 acc[k] += float(v)
             n += 1

@@ -1,0 +1,275 @@
+"""Generate the accuracy baseline artifact (ACCURACY.md + curves JSON).
+
+The reference publishes no accuracy numbers (SURVEY.md §6) and its
+datasets (CUB-200-2011 / Stanford Online Products) are not fetchable in
+this environment, so the baseline the framework is judged against is
+generated: for each BASELINE.json mining configuration, train an
+embedding model on synthetic separable identity clusters at a realistic
+batch shape and record the loss / Recall@k curves until Recall@1
+converges to ~1.0.  The reference's own convergence criterion is its
+retrieve_top1 top (npair_multi_class_loss.cu:390-398); a correct
+implementation of the loss + mining + gradient must drive that metric to
+1.0 on separable data — a broken gradient, mis-mined pairs, or wrong
+metric semantics all show up as a flat curve.
+
+Engines covered: dense XLA graph, ring-ppermute over the 8-device mesh,
+and the Pallas blockwise kernels (single chip) — the same config trains
+through all three, pinning training-level engine parity, not just
+per-step numerics.
+
+Usage: python scripts/accuracy_baseline.py [--steps N] [--out DIR]
+Writes <repo>/accuracy/curves.json and <repo>/ACCURACY.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_config(name, loss_cfg, model_name, model_kw, input_shape, num_ids,
+               ids_per_batch, steps, lr, use_ring=False, use_blockwise=False,
+               record_every=10, seed=0, noise=0.6):
+    import jax
+    import numpy as np
+
+    from npairloss_tpu.data import synthetic_identity_batches
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    mesh = None
+    if use_ring:
+        from npairloss_tpu.parallel import data_parallel_mesh
+
+        mesh = data_parallel_mesh(jax.devices()[:8])
+
+    solver = Solver(
+        get_model(model_name, **model_kw),
+        loss_cfg,
+        SolverConfig(
+            base_lr=lr, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
+            display=0, test_interval=0, snapshot=0, random_seed=seed,
+        ),
+        mesh=mesh,
+        input_shape=input_shape,
+        use_ring=use_ring,
+    )
+    if use_blockwise:
+        # Swap the dense loss for the Pallas blockwise engine inside the
+        # solver's step (single-chip self-pool).
+        from npairloss_tpu.ops.pallas_npair import (
+            blockwise_npair_loss_with_aux,
+            blockwise_retrieval_metrics,
+        )
+
+        def loss_and_metrics(emb, labels):
+            loss, _ = blockwise_npair_loss_with_aux(
+                emb, labels, loss_cfg, block_size=64
+            )
+            metrics = blockwise_retrieval_metrics(
+                jax.lax.stop_gradient(emb), labels, solver.top_ks,
+                block_size=64,
+            )
+            return loss, metrics
+
+        solver._loss_and_metrics = loss_and_metrics
+
+    batches = synthetic_identity_batches(
+        num_ids, ids_per_batch, 2, input_shape, noise=noise, seed=seed
+    )
+    curve = []
+    t0 = time.time()
+    for it in range(steps):
+        x, lab = next(batches)
+        m = solver.step(x, lab)
+        if it % record_every == 0 or it == steps - 1:
+            curve.append({
+                "step": it,
+                "loss": round(float(m["loss"]), 6),
+                "retrieve_top1": round(float(m["retrieve_top1"]), 4),
+                "retrieve_top5": round(float(m.get("retrieve_top5", 0.0)), 4),
+            })
+    final = curve[-1]
+    print(
+        f"  {name}: loss {curve[0]['loss']:.3f} -> {final['loss']:.3f}, "
+        f"R@1 {curve[0]['retrieve_top1']:.3f} -> "
+        f"{final['retrieve_top1']:.3f} ({time.time() - t0:.1f}s)",
+        flush=True,
+    )
+    return {
+        "name": name,
+        "engine": "ring" if use_ring else (
+            "blockwise" if use_blockwise else "dense"),
+        "steps": steps,
+        "final_loss": final["loss"],
+        "final_recall_at_1": final["retrieve_top1"],
+        "curve": curve,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--out", default=os.path.join(REPO, "accuracy"))
+    ap.add_argument(
+        "--only", nargs="*", default=None,
+        help="run only configs whose name contains any of these substrings",
+    )
+    ap.add_argument(
+        "--tpu", action="store_true",
+        help="run on the default (TPU) backend; without this flag the CPU "
+        "platform is forced BEFORE any backend query — even probing the "
+        "default backend hangs when the TPU tunnel is wedged",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from npairloss_tpu import NPairLossConfig, REFERENCE_CONFIG
+    from npairloss_tpu.ops.npair_loss import MiningMethod, MiningRegion
+
+    s = args.steps
+    mlp = dict(model_name="mlp", model_kw=dict(hidden=(64,), embedding_dim=32),
+               input_shape=(32,), num_ids=32, ids_per_batch=16, lr=0.5)
+    wide = dict(model_name="mlp", model_kw=dict(hidden=(64,), embedding_dim=32),
+                input_shape=(32,), num_ids=64, ids_per_batch=32, lr=0.5)
+    runs = [
+        # usage/def.prototxt flagship mining config (BASELINE.json cfg 1).
+        ("flagship_def_prototxt",
+         lambda: run_config("flagship_def_prototxt", REFERENCE_CONFIG,
+                            steps=s, **mlp)),
+        # Paper-baseline LOCAL/RAND (BASELINE.json cfg 2: CUB).
+        ("local_rand_cub",
+         lambda: run_config("local_rand_cub", NPairLossConfig(),
+                            steps=s, **mlp)),
+        # LOCAL/HARD both sides (BASELINE.json cfg 3: SOP).
+        ("local_hard_sop",
+         lambda: run_config(
+             "local_hard_sop",
+             NPairLossConfig(
+                 margin_ident=0.1, margin_diff=-0.05,
+                 ap_mining_method=MiningMethod.HARD,
+                 an_mining_method=MiningMethod.HARD,
+             ),
+             steps=s, **mlp)),
+        # GLOBAL/RELATIVE_HARD with cross-chip gathered negatives
+        # (BASELINE.json cfg 4) — dense engine on the 8-device mesh.
+        ("global_relhard_mesh_dense",
+         lambda: run_config("global_relhard_mesh_dense", REFERENCE_CONFIG,
+                            steps=s, **wide)),
+        # Same config, ring-ppermute engine (streamed radix RELATIVE).
+        ("global_relhard_mesh_ring",
+         lambda: run_config("global_relhard_mesh_ring", REFERENCE_CONFIG,
+                            steps=s, use_ring=True, **wide)),
+        # Same config, Pallas blockwise engine (the 32k-stretch path,
+        # BASELINE.json cfg 5's engine) at test scale.
+        ("global_relhard_blockwise",
+         lambda: run_config("global_relhard_blockwise", REFERENCE_CONFIG,
+                            steps=s, use_blockwise=True, **mlp)),
+        # Conv trunk end-to-end: ResNet-18 (the reduced proxy of
+        # BASELINE.json cfg 3's ResNet-50/SOP run) with LOCAL/HARD
+        # mining.  GoogLeNet is deliberately NOT trained from scratch
+        # here: a randomly-initialized BN-free Inception-v1 collapses
+        # (all pairwise sims ~0.9999 at init — the original needed aux
+        # classifiers + ImageNet-scale schedules), which a synthetic
+        # CPU-budget artifact cannot honestly overcome; the GoogLeNet
+        # trunk's fwd+bwd is exercised by bench.py and __graft_entry__.
+        ("resnet18_small",
+         lambda: run_config(
+             "resnet18_small",
+             NPairLossConfig(
+                 margin_ident=0.1, margin_diff=-0.05,
+                 ap_mining_method=MiningMethod.HARD,
+                 an_mining_method=MiningMethod.HARD,
+             ),
+             steps=max(60, s // 5),
+             model_name="resnet18",
+             model_kw=dict(
+                 dtype=__import__("jax.numpy", fromlist=["x"]).float32),
+             input_shape=(32, 32, 3),
+             num_ids=8, ids_per_batch=8, lr=0.1, record_every=5,
+             noise=0.5)),
+    ]
+    if args.only:
+        runs = [(n, t) for n, t in runs
+                if any(sub in n for sub in args.only)]
+
+    print("accuracy baseline runs:", flush=True)
+    results = [thunk() for _, thunk in runs]
+
+    # Merge with prior partial runs so --only invocations compose.
+    os.makedirs(args.out, exist_ok=True)
+    curves_path = os.path.join(args.out, "curves.json")
+    merged = {}
+    if os.path.exists(curves_path):
+        with open(curves_path) as f:
+            for r in json.load(f).get("results", []):
+                merged[r["name"]] = r
+    for r in results:
+        merged[r["name"]] = r
+    results = list(merged.values())
+
+    payload = {
+        "generated_by": "scripts/accuracy_baseline.py",
+        "backend": jax.default_backend(),
+        "steps": s,
+        "results": results,
+    }
+    with open(curves_path, "w") as f:
+        json.dump(payload, f, indent=1)
+
+    lines = [
+        "# Accuracy baseline (generated)",
+        "",
+        "The reference publishes no accuracy numbers and its datasets are",
+        "not fetchable here (SURVEY.md §6), so the baseline is generated:",
+        "each BASELINE.json mining config trains on synthetic separable",
+        "identity clusters until Recall@1 converges.  A broken gradient,",
+        "mis-mined pairs or wrong metric semantics would flatten these",
+        "curves.  Reproduce with `python scripts/accuracy_baseline.py`;",
+        "raw curves in `accuracy/curves.json`.",
+        "",
+        "| config | engine | steps | final loss | final Recall@1 |",
+        "|---|---|---|---|---|",
+    ]
+    for r in results:
+        lines.append(
+            f"| {r['name']} | {r['engine']} | {r['steps']} | "
+            f"{r['final_loss']:.4f} | {r['final_recall_at_1']:.3f} |"
+        )
+    lines += [
+        "",
+        f"Backend: `{jax.default_backend()}`.  All configs must reach "
+        "Recall@1 >= 0.95 (the conv-trunk run >= 0.85); "
+        "`tests/test_accuracy_baseline.py` replays a short run in CI.",
+        "",
+        "GoogLeNet is not trained from scratch in this artifact: a",
+        "randomly-initialized BN-free Inception-v1 collapses at init",
+        "(all pairwise sims ≈ 0.9999; the original relied on aux",
+        "classifiers and ImageNet-scale schedules).  Its fwd+bwd path is",
+        "exercised by `bench.py` and `__graft_entry__.py`; the conv-trunk",
+        "learning curve here uses the BatchNorm-bearing ResNet-18.",
+        "",
+    ]
+    with open(os.path.join(REPO, "ACCURACY.md"), "w") as f:
+        f.write("\n".join(lines))
+
+    bad = [r for r in results
+           if r["final_recall_at_1"] < (0.85 if "resnet" in r["name"]
+                                        else 0.95)]
+    if bad:
+        print(f"FAILED configs: {[r['name'] for r in bad]}", file=sys.stderr)
+        return 1
+    print(f"wrote {args.out}/curves.json and ACCURACY.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,59 @@ def test_googlenet_embedding_shape_and_norm():
     np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
 
 
+def test_googlenet_trunk_topology_matches_def_prototxt():
+    """Pin the Inception-v1 trunk to the reference net's topology
+    (usage/def.prototxt:85-120): conv1 is 64x7x7 stride 2 (the one conv
+    the template spells out, def.prototxt:85-111), the inception stages
+    produce the canonical GoogLeNet channel widths at the canonical
+    strides on a 224 input, and pool5/7x7_s1 pools 7x7x1024 -> 1024
+    (the embedding fed to L2Normalize, def.prototxt:115-120)."""
+    m = get_model("googlenet", dtype=jnp.float32)
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: m.init(jax.random.PRNGKey(0), x, train=False)
+    )
+    params = variables["params"]
+
+    # conv1/7x7_s2: num_output 64, kernel 7, stride 2 (def.prototxt:98-101).
+    assert params["conv1"]["Conv_0"]["kernel"].shape == (7, 7, 3, 64)
+
+    # Stage output shapes on the canonical 224 input: spatial halvings at
+    # conv1 / pool1 / pool2 / pool3 / pool4, channel widths from the
+    # Inception-v1 plan the prototxt's "..." elides.
+    _, inter = jax.eval_shape(
+        lambda v: m.apply(
+            v, x, train=False, capture_intermediates=True, mutable=["intermediates"]
+        ),
+        variables,
+    )
+    outs = {
+        name: shapes["__call__"][0]
+        for name, shapes in inter["intermediates"].items()
+        if name.startswith("inception_")
+    }
+    want = {
+        "inception_3a": (1, 28, 28, 256),
+        "inception_3b": (1, 28, 28, 480),
+        "inception_4a": (1, 14, 14, 512),
+        "inception_4b": (1, 14, 14, 512),
+        "inception_4c": (1, 14, 14, 512),
+        "inception_4d": (1, 14, 14, 528),
+        "inception_4e": (1, 14, 14, 832),
+        "inception_5a": (1, 7, 7, 832),
+        "inception_5b": (1, 7, 7, 1024),
+    }
+    for name, shape in want.items():
+        assert outs[name].shape == shape, (name, outs[name].shape)
+
+    # 9 inception blocks, each with the 6-conv plan (1x1, 3x3red, 3x3,
+    # 5x5red, 5x5, pool_proj) — 2 stem conv blocks + conv2_reduce.
+    assert len(outs) == 9
+    for blk in ("b1x1", "b3x3_reduce", "b3x3", "b5x5_reduce", "b5x5",
+                "pool_proj"):
+        assert blk in params["inception_3a"], blk
+
+
 def test_resnet50_embedding_shape():
     m = get_model("resnet50", dtype=jnp.float32)
     x = jnp.ones((2, 64, 64, 3), jnp.float32)

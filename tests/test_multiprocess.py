@@ -1,0 +1,56 @@
+"""Multi-process runtime test: 2 real OS processes, one CPU device each,
+joined via jax.distributed — the TPU-native counterpart of the
+reference's one-MPI-rank-per-GPU launch (npair_multi_class_loss.cu:32).
+
+The worker (mp_worker.py) asserts the gathered negative pool spans both
+processes and that per-rank losses match the NumPy oracle on the
+concatenated pod batch.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_two_process_pool_spans_processes(tmp_path, nproc):
+    port = _free_port()
+    env = dict(os.environ)
+    # One CPU device per process (drop the conftest's 8-device forcing),
+    # and no TPU plugin on the path — pure multi-controller CPU.
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = REPO
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "mp_worker.py"),
+             str(i), str(nproc), str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+    for i in range(nproc):
+        assert (tmp_path / f"ok_{i}").exists(), f"process {i} wrote no marker"
