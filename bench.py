@@ -13,6 +13,13 @@ batch-32 on a Maxwell Titan X scaled to batch 120, plus the loss layer's
 per-step host mining loop and CPU-buffer MPI round trips). North-star
 target is >= 4x (BASELINE.json).
 
+Timing discipline: the tunneled axon backend neither blocks in
+``block_until_ready`` nor re-executes identical dispatches (it memoizes
+them), so every measurement here chains DISTINCT computations (solver
+state threading, or per-step input perturbation inside one lax.scan),
+synchronizes by fetching a scalar to the host, and subtracts the
+measured dispatch+fetch latency floor (``_fetch_floor``).
+
 Robustness contract (this script must ALWAYS print one JSON line):
 the top-level process imports no jax — every measurement runs in a child
 subprocess under a wall-clock timeout, with escalating fallbacks:
@@ -140,18 +147,50 @@ def child_probe(platform: str) -> int:
     return 0
 
 
-def _measure(step, args_list, warmup: int, steps: int, block):
+def _fetch_floor(jax):
+    """Dispatch+fetch latency floor of the backend, measured.
+
+    On tunneled backends (axon) ``block_until_ready`` can return before
+    device compute finishes and identical dispatches may be served from a
+    memo cache — so every timing in this file (a) chains DISTINCT
+    computations and (b) synchronizes by fetching a scalar to the host,
+    then subtracts this floor (observed ~66 ms per round trip on the
+    axon tunnel, microseconds locally).
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def tiny(x):
+        return x.sum()
+
+    float(np.asarray(tiny(jnp.full((8, 8), 1.0))))
+    ts = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        float(np.asarray(tiny(jnp.full((8, 8), float(i + 2)))))
+        ts.append(time.perf_counter() - t0)
+    floor = min(ts)
+    _log(f"fetch floor: {floor * 1e3:.1f} ms")
+    return floor
+
+
+def _measure(step, args_list, warmup: int, steps: int, fetch, floor=0.0):
+    """Time ``steps`` sequential calls; sync via ``fetch`` (a host
+    device_get), subtract the dispatch/fetch ``floor``.  The ``step``
+    calls must be genuinely distinct computations (chained state or
+    varying inputs) — see ``_fetch_floor`` for why."""
     for i in range(warmup):
         _log(f"warmup {i + 1}/{warmup}")
         out = step(*args_list)
-        block(out)
+        fetch(out)
     _log(f"timing {steps} steps...")
     t0 = time.perf_counter()
     out = None
     for _ in range(steps):
         out = step(*args_list)
-    block(out)
-    return time.perf_counter() - t0
+    fetch(out)
+    return max(time.perf_counter() - t0 - floor, 1e-9)
 
 
 def child_full(platform: str, steps: int, warmup: int) -> int:
@@ -179,13 +218,17 @@ def child_full(platform: str, steps: int, warmup: int) -> int:
     x = jax.device_put(jnp.asarray(images))
     lab = jax.device_put(jnp.asarray(labels))
 
+    floor = _fetch_floor(jax)
     _log("compiling + warming up (first TPU compile can take minutes)...")
+    # Successive solver.step calls chain through the optimizer state, so
+    # each dispatch is a distinct computation (no memo-cache hazard).
     dt = _measure(
         lambda a, b: solver.step(a, b),
         [x, lab],
         warmup,
         steps,
-        lambda m: jax.block_until_ready(m["loss"]),
+        lambda m: float(np.asarray(m["loss"])),
+        floor,
     )
     emb_per_sec = BATCH * steps / dt
     _log(f"flagship: {emb_per_sec:.1f} emb/s ({dt / steps * 1e3:.1f} ms/step)")
@@ -207,9 +250,15 @@ def child_full(platform: str, steps: int, warmup: int) -> int:
 
     extras = {}
     try:
-        extras = _engine_extras(jax, jnp, np)
+        extras = _engine_extras(jax, jnp, np, floor)
     except Exception as e:
         _log(f"engine extras failed: {e}")
+    try:
+        extras["batch_scaling"] = _batch_scaling_extras(
+            jax, jnp, np, dev, floor
+        )
+    except Exception as e:
+        _log(f"batch scaling extras failed: {e}")
 
     record = {
         "metric": "googlenet_npair_train_embeddings_per_sec_per_chip",
@@ -231,15 +280,27 @@ def child_full(platform: str, steps: int, warmup: int) -> int:
     return 0
 
 
-def _engine_extras(jax, jnp, np):
+def _engine_extras(jax, jnp, np, floor):
     """Loss-engine comparison at a large self-pool: dense XLA graph vs the
     Pallas blockwise kernels (compiled by Mosaic when on TPU — this is the
-    on-hardware validation of ops/pallas_npair.py), fwd+bwd each."""
+    on-hardware validation of ops/pallas_npair.py) vs the ring engine on a
+    1-device mesh, fwd+bwd each.
+
+    Each engine is timed as ``steps`` loss+grad evaluations inside
+    ONE jitted ``lax.scan`` (inputs perturbed per step so no two steps are
+    identical), synced by a single host fetch — robust against the
+    non-blocking/memoizing tunnel backend (see ``_fetch_floor``).
+    """
+    from jax.sharding import PartitionSpec as P
+
     from npairloss_tpu import NPairLossConfig, REFERENCE_CONFIG
     from npairloss_tpu.ops.npair_loss import npair_loss
     from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss
+    from npairloss_tpu.parallel.mesh import data_parallel_mesh
+    from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 
     n, d = 4096, 512
+    steps = 10
     rng = np.random.default_rng(1)
     f = rng.standard_normal((n, d)).astype(np.float32)
     f /= np.linalg.norm(f, axis=1, keepdims=True)
@@ -247,8 +308,8 @@ def _engine_extras(jax, jnp, np):
     labels = jax.device_put(
         jnp.asarray(np.repeat(np.arange(n // 2), 2).astype(np.int32))
     )
-    # Absolute-mining config both engines support; plus the flagship
-    # RELATIVE config on the blockwise path (streamed radix selection).
+    # Absolute-mining config (single-pass thresholds) plus the flagship
+    # RELATIVE config (streamed radix selection) on every engine.
     from npairloss_tpu.ops.npair_loss import MiningMethod, MiningRegion
 
     abs_cfg = NPairLossConfig(
@@ -257,21 +318,62 @@ def _engine_extras(jax, jnp, np):
         an_mining_method=MiningMethod.HARD,
         an_mining_region=MiningRegion.LOCAL,
     )
-    extras = {"pool": n}
+    extras = {"pool": n, "steps": steps}
 
-    def bench_one(name, fn):
-        step = jax.jit(jax.value_and_grad(fn))
+    def bench_one(name, loss_fn):
+        """loss_fn(features, labels) -> scalar loss; timed fwd+bwd."""
+        vg = jax.value_and_grad(loss_fn)
+
+        @jax.jit
+        def many(f_, l_):
+            def body(acc, s):
+                # Perturb the input per step: every scan iteration is a
+                # distinct computation, and the gradient feeds the carry
+                # so no step can be elided.
+                loss, grad = vg(f_ * (1.0 + s * 1e-6), l_)
+                return acc + loss + grad[0, 0], loss
+
+            acc, losses = jax.lax.scan(
+                body, jnp.float32(0.0), jnp.arange(steps, dtype=jnp.float32)
+            )
+            return acc, losses[0]
+
         _log(f"extras: compiling {name}...")
-        dt = _measure(
-            step, [feats, labels], 1, 5, lambda o: jax.block_until_ready(o[0])
-        )
-        loss = float(step(feats, labels)[0])
+        acc, l0 = many(feats, labels)
+        float(np.asarray(acc))  # warm (compile + first run)
+        # Second warm run: the first executable a process times otherwise
+        # absorbs one-time backend setup (observed ~40 ms/step of phantom
+        # cost on the first-timed program only).
+        acc, l0 = many(feats * 1.0, labels)
+        float(np.asarray(acc))
+        t0 = time.perf_counter()
+        acc, l0 = many(feats, labels * 1)  # distinct dispatch, same math
+        float(np.asarray(acc))
+        dt = max(time.perf_counter() - t0 - floor, 1e-9)
+        loss = float(np.asarray(l0))
         extras[name] = {
-            "emb_per_sec": round(n * 5 / dt, 1),
-            "ms_per_step": round(dt / 5 * 1e3, 2),
+            "emb_per_sec": round(n * steps / dt, 1),
+            "ms_per_step": round(dt / steps * 1e3, 2),
             "loss": round(loss, 6),
         }
+        _log(f"extras: {name}: {extras[name]}")
         return loss
+
+    mesh = data_parallel_mesh(jax.devices()[:1])
+
+    def ring_loss(cfg):
+        # top_ks=() keeps the comparison fair: dense/blockwise are timed
+        # as loss+grad only, so the ring must not pay for streamed
+        # retrieval-metric top-k maintenance the others skip.
+        fn = jax.shard_map(
+            lambda f_, l_: ring_npair_loss_and_metrics(
+                f_, l_, cfg, "dp", top_ks=()
+            )[0][None],
+            mesh=mesh,
+            in_specs=(P("dp"), P("dp")),
+            out_specs=P("dp"),
+        )
+        return lambda f_, l_: fn(f_, l_).sum()
 
     l_dense = bench_one(
         "dense_abs", lambda f_, l_: npair_loss(f_, l_, abs_cfg)
@@ -289,7 +391,63 @@ def _engine_extras(jax, jnp, np):
         lambda f_, l_: blockwise_npair_loss(f_, l_, REFERENCE_CONFIG),
     )
     extras["dense_blockwise_flagship_delta"] = abs(l_dense_rel - l_block_rel)
+    # Ring engine on a 1-device mesh: same pool, same math — isolates the
+    # ring machinery's overhead (multi-pass tile recompute + ppermute)
+    # against dense at an identical problem size (VERDICT r2 item 7).
+    l_ring = bench_one("ring_abs", ring_loss(abs_cfg))
+    extras["dense_ring_abs_delta"] = abs(l_dense - l_ring)
+    l_ring_rel = bench_one("ring_flagship", ring_loss(REFERENCE_CONFIG))
+    extras["dense_ring_flagship_delta"] = abs(l_dense_rel - l_ring_rel)
     return extras
+
+
+def _batch_scaling_extras(jax, jnp, np, dev, floor):
+    """Flagship solver throughput at batch 120/240/480 — does a bigger
+    per-chip batch lift emb/s/chip (VERDICT r2 item 4)?"""
+    from npairloss_tpu import REFERENCE_CONFIG
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    rows = {}
+    for batch in (120, 240, 480):
+        solver = Solver(
+            get_model("googlenet", dtype=jnp.bfloat16),
+            REFERENCE_CONFIG,
+            SolverConfig(
+                base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
+                momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
+            ),
+            input_shape=(IMAGE, IMAGE, 3),
+        )
+        rng = np.random.default_rng(0)
+        x = jax.device_put(jnp.asarray(
+            rng.standard_normal((batch, IMAGE, IMAGE, 3)).astype(np.float32)
+        ))
+        lab = jax.device_put(jnp.asarray(
+            np.repeat(np.arange(batch // 2), 2).astype(np.int32)
+        ))
+        _log(f"batch scaling: compiling batch {batch}...")
+        steps = 10
+        dt = _measure(
+            lambda a, b: solver.step(a, b), [x, lab], 1, steps,
+            lambda m: float(np.asarray(m["loss"])), floor,
+        )
+        mfu = None
+        try:
+            compiled = solver._step_fn.lower(solver.state, x, lab).compile()
+            step_flops = _cost_flops(compiled)
+            peak = _peak_flops(dev.device_kind)
+            if step_flops and peak:
+                mfu = round((step_flops * steps / dt) / peak, 4)
+        except Exception as e:
+            _log(f"batch {batch} mfu estimate failed: {e}")
+        rows[str(batch)] = {
+            "emb_per_sec": round(batch * steps / dt, 1),
+            "ms_per_step": round(dt / steps * 1e3, 2),
+            **({"mfu": mfu} if mfu is not None else {}),
+        }
+        _log(f"batch scaling: {batch}: {rows[str(batch)]}")
+    return rows
 
 
 def child_smoke(platform: str) -> int:
@@ -314,7 +472,7 @@ def child_smoke(platform: str) -> int:
     lab = jnp.asarray(np.repeat(np.arange(batch // 2), 2).astype(np.int32))
     dt = _measure(
         lambda a, b: solver.step(a, b), [x, lab], 1, 5,
-        lambda m: jax.block_until_ready(m["loss"]),
+        lambda m: float(np.asarray(m["loss"])), _fetch_floor(jax),
     )
     emb_per_sec = batch * 5 / dt
     print(

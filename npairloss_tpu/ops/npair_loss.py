@@ -47,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from npairloss_tpu.ops.rank_select import masked_digit_hist, radix_select
+
 FLT_MAX = float(np.finfo(np.float32).max)
 
 
@@ -175,20 +177,37 @@ def _clamp_negative(value: jax.Array) -> jax.Array:
 def _local_relative_threshold(
     sims: jax.Array, mask: jax.Array, sn: float
 ) -> jax.Array:
-    """Per-query threshold from the ascending sort of masked row entries."""
-    rows = jnp.sort(jnp.where(mask, sims, jnp.float32(FLT_MAX)), axis=1)
+    """Per-query threshold: the ``_relative_pos``-th smallest masked row
+    entry, recovered exactly by MSD radix selection over the materialized
+    sims (the reference's per-query ascending std::sort, cu:269-273, needs
+    only ONE rank statistic — a full sort is O(M log M) work and, on TPU,
+    a bitonic network; NUM_DIGITS fused compare-and-reduce passes over the
+    row recover the identical element)."""
     count = mask.sum(axis=1)
-    pos = _relative_pos(count, sn)
-    val = jnp.take_along_axis(rows, pos[:, None], axis=1)[:, 0]
+    k = _relative_pos(count, sn)
+    val = radix_select(
+        lambda prefix, digit: masked_digit_hist(sims, mask, prefix, digit),
+        k,
+        count == 0,
+    )
     return _clamp_negative(val)
 
 
 def _global_relative_threshold(sims: jax.Array, mask: jax.Array, sn: float) -> jax.Array:
-    """Scalar threshold from the ascending sort of ALL masked block entries."""
-    flat = jnp.sort(jnp.where(mask, sims, jnp.float32(FLT_MAX)).ravel())
-    count = mask.sum()
-    pos = _relative_pos(count, sn)
-    return _clamp_negative(flat[pos])
+    """Scalar threshold: the ``_relative_pos``-th smallest masked entry of
+    the WHOLE block (the reference's global ascending std::sort of the
+    flattened pair population, cu:266-268), via the same radix selection
+    with the block flattened to a single population row."""
+    flat = sims.reshape(1, -1)
+    fmask = mask.reshape(1, -1)
+    count = fmask.sum(axis=1)
+    k = _relative_pos(count, sn)
+    val = radix_select(
+        lambda prefix, digit: masked_digit_hist(flat, fmask, prefix, digit),
+        k,
+        count == 0,
+    )
+    return _clamp_negative(val[0])
 
 
 def mining_thresholds(

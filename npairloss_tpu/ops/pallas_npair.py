@@ -33,10 +33,11 @@ methods are exact.  Absolute (HARD / EASY / RAND) thresholds stream as
 min/max reductions inside ``_stats_kernel``; RELATIVE_* thresholds —
 rank statistics over the full pair population, which the reference
 obtains by sorting the whole matrix on the host (cu:266-273) — are
-recovered exactly by MSD radix selection (``ops.rank_select``): four
-extra streamed passes over the pair tiles, each histogramming one 8-bit
-digit of the monotone sortable float key, narrow the target rank to a
-single bit pattern without ever materializing the population.
+recovered exactly by MSD radix selection (``ops.rank_select``): a few
+extra streamed passes over the pair tiles, each histogramming one
+RADIX_BITS-bit digit of the monotone sortable float key via
+scatter-free compare-and-reduce, narrow the target rank to a single
+bit pattern without ever materializing the population.
 
 On non-TPU backends the kernels run in Pallas interpreter mode, which is
 how the CPU test suite checks bit-parity against the dense path.
@@ -64,6 +65,8 @@ from npairloss_tpu.ops.npair_loss import (
     selection_predicates,
 )
 from npairloss_tpu.ops.rank_select import (
+    NUM_DIGITS,
+    RADIX_BINS,
     masked_digit_hist,
     population_count_dtype,
     radix_begin,
@@ -447,12 +450,13 @@ def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
 
     Reproduces the dense ``_local/_global_relative_threshold`` semantics
     (ascending sort + ``_relative_pos`` index + ``< 0 -> -FLT_MAX``
-    clamp, reference cu:275-337) via ops.rank_select: 4 streamed passes
-    of MSD radix selection — each a lax.scan over pool tiles recomputing
-    the sim tile and histogramming one 8-bit digit — pin down all 32
-    bits of the target element.  The sim tile is computed ONCE per pass
-    and feeds both the AP and the AN histogram, so relative mining costs
-    4 passes whether one or both sides are relative.  GLOBAL region
+    clamp, reference cu:275-337) via ops.rank_select: NUM_DIGITS
+    streamed passes of MSD radix selection — each a lax.scan over pool
+    tiles recomputing the sim tile and histogramming one RADIX_BITS-bit
+    digit via scatter-free compare-and-reduce — pin down all 32 bits of
+    the target element.  The sim tile is computed ONCE per pass and
+    feeds both the AP and the AN histogram, so relative mining costs
+    NUM_DIGITS passes whether one or both sides are relative.  GLOBAL
     ranks over the whole flattened population (cu:296, cu:327), LOCAL
     per query; populations beyond 2^31 pairs need 64-bit counts
     (jax_enable_x64) or fail loudly at trace time.
@@ -477,7 +481,7 @@ def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
         if region == MiningRegion.GLOBAL:
             cdt = population_count_dtype(n * n)
             hist = jnp.broadcast_to(
-                hist.sum(axis=0, keepdims=True, dtype=cdt), (n, 256)
+                hist.sum(axis=0, keepdims=True, dtype=cdt), (n, RADIX_BINS)
             )
         return hist
 
@@ -495,7 +499,7 @@ def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
             empties[s] = counts == 0
         states[s] = radix_begin(k)
 
-    for digit in range(4):
+    for digit in range(NUM_DIGITS):
         prefixes = {s: states[s][1] for s in sides}
 
         def step(hists, blk):
@@ -518,7 +522,7 @@ def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
 
         hists, _ = jax.lax.scan(
             step,
-            {s: jnp.zeros((n, 256), jnp.int32) for s in sides},
+            {s: jnp.zeros((n, RADIX_BINS), jnp.int32) for s in sides},
             (pool, pool_l, jnp.arange(nblocks, dtype=jnp.int32)),
         )
         for s in sides:

@@ -6,13 +6,24 @@ npair_multi_class_loss.cu:266-273) and indexing the sorted list
 (cu:285-287 etc.).  For streamed paths that never materialize the pair
 matrix (parallel.ring, ops.pallas_npair), the same element is recovered
 EXACTLY — bit pattern and all — by MSD radix selection over a monotone
-float32 -> uint32 key: four rounds, each histogramming one 8-bit digit
-of the candidates matching the prefix so far, narrow k to a single bit
-pattern.  Each round costs one pass over the (recomputed) pair tiles;
-no sort, no materialization, O(N x 256) state.
+float32 -> uint32 key: ``NUM_DIGITS`` rounds, each histogramming one
+``RADIX_BITS``-bit digit of the candidates matching the prefix so far,
+narrow k to a single bit pattern.  Each round costs one pass over the
+(recomputed) pair tiles; no sort, no materialization, O(N x RADIX_BINS)
+state.
+
+The digit width is a pure VPU trade: each halving of RADIX_BITS doubles
+the number of passes but shrinks the per-pass histogram work by the
+same factor AND keeps it as a compare-and-reduce XLA fuses into the
+row reduction (a 256-bin histogram needs either a scatter/bincount —
+serialized on TPU — or 256 whole-tile compares; 16 bins need 16).  At
+4 bits the histogram adds ~16 ops/pair/pass, far below the sim-tile
+matmul it rides on.
 
 This is SURVEY.md §7's "distributed top-k" growth path for GLOBAL
-RELATIVE mining beyond gather-able pool sizes.
+RELATIVE mining beyond gather-able pool sizes; the dense engine reuses
+the same machinery over its materialized pair matrix in place of a full
+sort (one rank statistic never needs O(E log E) work).
 """
 
 from __future__ import annotations
@@ -25,10 +36,15 @@ import numpy as np
 
 FLT_MAX = float(np.finfo(np.float32).max)
 
-# hist_fn(prefix: uint32[N], digit: int) -> int32[N, 256]: counts of the
-# digit values of candidates whose higher digits equal prefix.  For a
-# GLOBAL (population-wide) rank the caller's hist_fn sums counts over
-# queries and broadcasts, so every row narrows identically.
+# 4-bit digits: 8 passes x 16-bin compare-and-reduce histograms.
+RADIX_BITS = 4
+RADIX_BINS = 1 << RADIX_BITS
+NUM_DIGITS = 32 // RADIX_BITS
+
+# hist_fn(prefix: uint32[N], digit: int) -> int32[N, RADIX_BINS]: counts
+# of the digit values of candidates whose higher digits equal prefix.
+# For a GLOBAL (population-wide) rank the caller's hist_fn sums counts
+# over queries and broadcasts, so every row narrows identically.
 HistFn = Callable[[jax.Array, int], jax.Array]
 
 
@@ -48,7 +64,7 @@ def key_to_float(key: jax.Array) -> jax.Array:
 
 
 def radix_begin(k: jax.Array):
-    """(k, prefix) state for a stepwise 4-digit MSD radix selection.
+    """(k, prefix) state for a stepwise NUM_DIGITS-round MSD selection.
 
     The stepwise API lets callers drive SEVERAL selections through one
     shared data pass per digit (the pair tiles are the expensive part —
@@ -61,12 +77,12 @@ def radix_begin(k: jax.Array):
 
 
 def radix_update(state, hist: jax.Array):
-    """Consume one digit histogram; narrow (k, prefix) by 8 bits."""
+    """Consume one digit histogram; narrow (k, prefix) by RADIX_BITS bits."""
     k, prefix = state
     idt = k.dtype
     cum = jnp.cumsum(hist.astype(idt), axis=1)
     # First digit bin whose cumulative count exceeds k.
-    b = jnp.minimum((cum <= k[:, None]).sum(axis=1), 255)
+    b = jnp.minimum((cum <= k[:, None]).sum(axis=1), RADIX_BINS - 1)
     below = jnp.where(
         b > 0,
         jnp.take_along_axis(
@@ -74,11 +90,11 @@ def radix_update(state, hist: jax.Array):
         )[:, 0],
         jnp.asarray(0, idt),
     )
-    return k - below, (prefix << jnp.uint32(8)) | b.astype(jnp.uint32)
+    return k - below, (prefix << jnp.uint32(RADIX_BITS)) | b.astype(jnp.uint32)
 
 
 def radix_finish(state, empty: jax.Array) -> jax.Array:
-    """Selected value after 4 updates; empty rows yield +FLT_MAX."""
+    """Selected value after NUM_DIGITS updates; empty rows yield +FLT_MAX."""
     _, prefix = state
     return jnp.where(empty, jnp.float32(FLT_MAX), key_to_float(prefix))
 
@@ -96,7 +112,7 @@ def radix_select(hist_fn: HistFn, k: jax.Array, empty: jax.Array) -> jax.Array:
         dense path's +FLT_MAX-padded sort yields FLT_MAX at any index.
     """
     state = radix_begin(k)
-    for digit in range(4):
+    for digit in range(NUM_DIGITS):
         state = radix_update(state, hist_fn(state[1], digit))
     return radix_finish(state, empty)
 
@@ -121,9 +137,11 @@ def population_count_dtype(max_population: int):
 
 
 def digit_of(key: jax.Array, digit: int) -> jax.Array:
-    """8-bit digit ``digit`` (0 = MSB) of a uint32 key, as int32."""
-    shift = 24 - 8 * digit
-    return ((key >> jnp.uint32(shift)) & jnp.uint32(0xFF)).astype(jnp.int32)
+    """Digit ``digit`` (0 = MSB) of a uint32 key, as int32."""
+    shift = 32 - RADIX_BITS * (digit + 1)
+    return (
+        (key >> jnp.uint32(shift)) & jnp.uint32(RADIX_BINS - 1)
+    ).astype(jnp.int32)
 
 
 def prefix_matches(key: jax.Array, prefix: jax.Array, digit: int) -> jax.Array:
@@ -131,17 +149,23 @@ def prefix_matches(key: jax.Array, prefix: jax.Array, digit: int) -> jax.Array:
     True for digit 0)."""
     if digit == 0:
         return jnp.ones(key.shape, bool)
-    shift = 32 - 8 * digit
+    shift = 32 - RADIX_BITS * digit
     return (key >> jnp.uint32(shift)) == prefix
 
 
 def masked_digit_hist(
     sims: jax.Array, mask: jax.Array, prefix: jax.Array, digit: int
 ) -> jax.Array:
-    """int32 [N, 256] histogram of digit values over one masked tile;
-    prefix-mismatched and unmasked entries are dropped (overflow bin)."""
+    """int32 [N, RADIX_BINS] histogram of digit values over one masked
+    tile; prefix-mismatched and unmasked entries are dropped.
+
+    Bincount/scatter-free: one broadcast compare per bin, which XLA
+    fuses straight into the row reduction (no [N, M, BINS] intermediate
+    ever materializes) — TPU scatters serialize, a 16-way compare
+    vectorizes.
+    """
     key = sortable_key(sims)
     m = mask & prefix_matches(key, prefix[:, None], digit)
-    d = jnp.where(m, digit_of(key, digit), 256)
-    hist = jax.vmap(lambda row: jnp.bincount(row, length=257))(d)
-    return hist[:, :256].astype(jnp.int32)
+    d = jnp.where(m, digit_of(key, digit), RADIX_BINS)
+    bins = jnp.arange(RADIX_BINS, dtype=jnp.int32)
+    return (d[:, :, None] == bins).sum(axis=1, dtype=jnp.int32)

@@ -33,13 +33,14 @@ RAND) thresholds are streamed min/max reductions.  RELATIVE_* needs
 rank statistics over the full pair population — the reference sorts the
 whole N x (N*G) block on the host (cu:266-273); here the k-th smallest
 masked pair value is recovered EXACTLY by MSD radix selection over
-sortable float bit-keys: 4 ring passes, each histogramming one 8-bit
-digit of the monotone uint32 key, narrow to the target element's exact
-bit pattern (SURVEY.md §7's "distributed top-k" growth path).  Memory
-stays O(N x N_block); RELATIVE mining costs 3 extra ring passes (3*G
-rotations, each recomputing every N x N_block pair tile) REGARDLESS of
-whether one or both sides are relative — the digit-0 histogram rides
-the stats pass for free, and digits 1-3 share one pass across sides.
+sortable float bit-keys: NUM_DIGITS ring passes, each histogramming one
+RADIX_BITS-bit digit of the monotone uint32 key via scatter-free
+compare-and-reduce, narrow to the target element's exact bit pattern
+(SURVEY.md §7's "distributed top-k" growth path).  Memory stays
+O(N x N_block); RELATIVE mining costs NUM_DIGITS-1 extra ring passes
+(each G rotations recomputing every N x N_block pair tile) REGARDLESS
+of whether one or both sides are relative — the digit-0 histogram rides
+the stats pass for free, and later digits share one pass across sides.
 """
 
 from __future__ import annotations
@@ -62,6 +63,8 @@ from npairloss_tpu.ops.npair_loss import (
     selection_mask,
 )
 from npairloss_tpu.ops.rank_select import (
+    NUM_DIGITS,
+    RADIX_BINS,
     masked_digit_hist,
     population_count_dtype,
     radix_begin,
@@ -172,9 +175,9 @@ def _stats_pass(
         "top_same": jnp.zeros((n_local, top_k_max + 1), bool),
     }
     if hist0_same:
-        carry["hist0_same"] = jnp.zeros((n_local, 256), jnp.int32)
+        carry["hist0_same"] = jnp.zeros((n_local, RADIX_BINS), jnp.int32)
     if hist0_diff:
-        carry["hist0_diff"] = jnp.zeros((n_local, 256), jnp.int32)
+        carry["hist0_diff"] = jnp.zeros((n_local, RADIX_BINS), jnp.int32)
     rotating = {
         "f": feats,
         "l": labels,
@@ -231,10 +234,10 @@ def _multi_digit_hist_pass(
     expensive part) is computed once and feeds both masks.
 
     ``sides``: dict side-name -> (use_same, prefix).
-    Returns dict side-name -> int32 [N, 256].
+    Returns dict side-name -> int32 [N, RADIX_BINS].
     """
     n_local = feats.shape[0]
-    carry = {s: jnp.zeros((n_local, 256), jnp.int32) for s in sides}
+    carry = {s: jnp.zeros((n_local, RADIX_BINS), jnp.int32) for s in sides}
     rotating = {"f": feats, "l": labels, "rank": my_rank}
 
     def body(c, rot, step):
@@ -267,9 +270,9 @@ def _ring_thresholds(
     time — int32 would wrap and silently mis-rank.
 
     Cost: the digit-0 histogram comes FREE from the stats pass (digit 0
-    has no prefix), and digits 1-3 share one ring pass per digit across
-    the AP and AN sides — so RELATIVE mining costs 3 extra ring passes
-    total whether one or both sides are relative (down from 4 per side).
+    has no prefix), and later digits share one ring pass per digit
+    across the AP and AN sides — so RELATIVE mining costs NUM_DIGITS-1
+    extra ring passes total whether one or both sides are relative.
     """
     pos_thr, neg_thr = absolute_thresholds(
         stats["min_within"], stats["max_between"], cfg
@@ -294,7 +297,8 @@ def _ring_thresholds(
         if region == MiningRegion.GLOBAL:
             cdt = population_count_dtype(n_local * n_local * g)
             hist = jnp.broadcast_to(
-                hist.sum(axis=0, keepdims=True, dtype=cdt), (n_local, 256)
+                hist.sum(axis=0, keepdims=True, dtype=cdt),
+                (n_local, RADIX_BINS),
             )
         return hist
 
@@ -310,7 +314,7 @@ def _ring_thresholds(
             empties[s] = counts == 0
         states[s] = radix_update(radix_begin(k), prep_hist(s, hist0))
 
-    for digit in range(1, 4):
+    for digit in range(1, NUM_DIGITS):
         hists = _multi_digit_hist_pass(
             feats, labels, my_rank, axis_name,
             {s: (sides[s][0], states[s][1]) for s in sides}, digit,
