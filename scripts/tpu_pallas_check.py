@@ -87,9 +87,9 @@ def main() -> int:
                 x, labels, cfg, block_size=args.block)))
         ld, gd = dense(feats)
         lb, gb = block(feats)
-        jax.block_until_ready((ld, gd, lb, gb))
         dl = abs(float(ld) - float(lb))
-        dg = float(jnp.max(jnp.abs(gd - gb)))
+        # jitted delta: eager reductions on the axon tunnel are hazardous
+        dg = float(jax.jit(lambda a, b: jnp.max(jnp.abs(a - b)))(gd, gb))
         rel_ok = dl <= 1e-4 * max(1.0, abs(float(ld))) and dg <= 1e-5
         record["parity"][name] = {
             "loss_dense": float(ld), "loss_blockwise": float(lb),
@@ -107,27 +107,58 @@ def main() -> int:
     feats_s = jax.device_put(jnp.asarray(fs))
     labels_s = jax.device_put(
         jnp.asarray(np.repeat(np.arange(ns // 2), 2).astype(np.int32)))
+    # Timing discipline (see bench.py): the tunneled backend neither
+    # blocks in block_until_ready nor re-executes identical dispatches,
+    # so time `reps` perturbed fwd+bwd steps inside ONE jitted lax.scan,
+    # sync via host fetch, and subtract the measured dispatch floor.
+    @jax.jit
+    def _tiny(x):
+        return x.sum()
+
+    float(np.asarray(_tiny(jnp.full((8, 8), 1.0))))
+    t0 = time.perf_counter()
+    float(np.asarray(_tiny(jnp.full((8, 8), 2.0))))
+    floor = time.perf_counter() - t0
+
+    reps = 3
     for name, cfg in configs:
         print(f"[tpu-check] stretch {ns}: {name}...",
               file=sys.stderr, flush=True)
-        step = jax.jit(jax.value_and_grad(
+        vg = jax.value_and_grad(
             lambda x: blockwise_npair_loss(
-                x, labels_s, cfg, block_size=args.block)))
-        out = step(feats_s)
-        jax.block_until_ready(out)
+                x, labels_s, cfg, block_size=args.block))
+
+        @jax.jit
+        def many(x):
+            def body(acc, s):
+                loss, grad = vg(x * (1.0 + s * 1e-6))
+                return acc + loss + grad[0, 0], loss
+
+            acc, losses = jax.lax.scan(
+                body, jnp.float32(0.0), jnp.arange(reps, dtype=jnp.float32))
+            return acc, losses[0]
+
+        acc, l0 = many(feats_s)
+        float(np.asarray(acc))  # compile + warm
+        acc, l0 = many(feats_s * 1.0)
+        float(np.asarray(acc))  # second warm (first-program phantom cost)
         t0 = time.perf_counter()
-        reps = 3
-        for _ in range(reps):
-            out = step(feats_s)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / reps
+        acc, l0 = many(feats_s * 1.0)
+        float(np.asarray(acc))
+        dt = max(time.perf_counter() - t0 - floor, 1e-9) / reps
         record["stretch"][name] = {
-            "loss": float(out[0]),
+            "loss": float(np.asarray(l0)),
             "ms_per_step": round(dt * 1e3, 2),
             "embeddings_per_sec": round(ns / dt, 1),
         }
         print(f"[tpu-check]   {dt * 1e3:.1f} ms/step, "
               f"{ns / dt:.0f} emb/s", file=sys.stderr, flush=True)
+    try:
+        stats = dev.memory_stats() or {}
+        record["peak_bytes_in_use"] = int(stats.get("peak_bytes_in_use", 0))
+    except Exception as e:
+        print(f"[tpu-check] memory stats unavailable: {e}",
+              file=sys.stderr, flush=True)
 
     record["ok"] = ok
     record["mosaic_compiled"] = on_tpu
