@@ -317,6 +317,20 @@ class Solver:
             n += 1
         return {k: v / max(n, 1) for k, v in acc.items()}
 
+    @property
+    def iteration(self) -> int:
+        """Current solver iteration — the Caffe solverstate ``iter``.
+
+        Reads the optimizer's step counter, which every snapshot persists
+        and ``restore_snapshot`` brings back, so display/test/snapshot
+        cadence AND the lr schedule resume from the same single source of
+        truth (the reference resumes from ``.solverstate`` files the same
+        way, solver.prototxt:15-16 semantics).
+        """
+        if self.state is None:
+            return 0
+        return int(jax.device_get(self.state["opt"].step))
+
     def train(
         self,
         train_batches: Iterator[Tuple[np.ndarray, np.ndarray]],
@@ -324,18 +338,34 @@ class Solver:
         test_batches: Optional[Iterator[Tuple[np.ndarray, np.ndarray]]] = None,
         log_fn: Callable[[str], None] = log.info,
     ) -> Dict[str, float]:
-        """The Caffe Solver::Solve loop: train/display/test/snapshot cadence."""
+        """The Caffe Solver::Solve loop: train/display/test/snapshot cadence.
+
+        ``num_iters`` is the TOTAL iteration target (Caffe ``max_iter``):
+        a solver restored from the iteration-k snapshot continues at k+1
+        and runs ``num_iters - k`` more steps, keeping every cadence
+        aligned (next snapshot lands at k + ``snapshot``).
+        """
         cfg = self.cfg
         num_iters = num_iters if num_iters is not None else cfg.max_iter
+        start = self.iteration
+        if start:
+            log_fn(f"resuming from iteration {start}")
+            if start >= num_iters:
+                log_fn(
+                    f"nothing to do: restored iteration {start} >= "
+                    f"target {num_iters} (num_iters is the TOTAL "
+                    "max_iter target, not an increment)"
+                )
         if (
-            cfg.test_initialization
+            start == 0
+            and cfg.test_initialization
             and test_batches is not None
             and cfg.test_iter > 0
         ):
             m = self.evaluate(test_batches, cfg.test_iter)
             log_fn(f"iter 0 TEST {_fmt(m)}")
         last = {}
-        for it in range(num_iters):
+        for it in range(start, num_iters):
             inputs, labels = next(train_batches)
             # Keep metrics as device scalars so the loop never blocks on a
             # host sync; floats are materialized only at display/test/return
