@@ -18,6 +18,10 @@ from npairloss_tpu.models.vit import ViTEmbedding
 _REGISTRY: Dict[str, Callable[..., Any]] = {
     "googlenet": GoogLeNetEmbedding,
     "googlenet_embedding": GoogLeNetEmbedding,
+    # Inception-BN: the from-scratch-trainable GoogLeNet (BN after every
+    # conv, no LRN) — use for training runs without pretrained weights.
+    "googlenet_bn": lambda **kw: GoogLeNetEmbedding(use_bn=True, **kw),
+    "inception_bn": lambda **kw: GoogLeNetEmbedding(use_bn=True, **kw),
     "resnet50": lambda **kw: ResNetEmbedding(stage_sizes=(3, 4, 6, 3), **kw),
     "resnet18": lambda **kw: ResNetEmbedding(stage_sizes=(2, 2, 2, 2), width=64, **kw),
     "vit_b16": ViTEmbedding,

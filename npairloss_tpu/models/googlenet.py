@@ -39,17 +39,21 @@ _INCEPTION_PLAN = {
 class Inception(nn.Module):
     plan: Tuple[int, int, int, int, int, int]
     dtype: Any = jnp.float32
+    use_bn: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         p1, p3r, p3, p5r, p5, pp = self.plan
-        b1 = ConvBlock(p1, (1, 1), dtype=self.dtype, name="b1x1")(x)
-        b3 = ConvBlock(p3r, (1, 1), dtype=self.dtype, name="b3x3_reduce")(x)
-        b3 = ConvBlock(p3, (3, 3), dtype=self.dtype, name="b3x3")(b3)
-        b5 = ConvBlock(p5r, (1, 1), dtype=self.dtype, name="b5x5_reduce")(x)
-        b5 = ConvBlock(p5, (5, 5), dtype=self.dtype, name="b5x5")(b5)
+        conv = lambda f, k, name: ConvBlock(
+            f, k, dtype=self.dtype, use_bn=self.use_bn, name=name
+        )
+        b1 = conv(p1, (1, 1), "b1x1")(x, train)
+        b3 = conv(p3r, (1, 1), "b3x3_reduce")(x, train)
+        b3 = conv(p3, (3, 3), "b3x3")(b3, train)
+        b5 = conv(p5r, (1, 1), "b5x5_reduce")(x, train)
+        b5 = conv(p5, (5, 5), "b5x5")(b5, train)
         bp = max_pool(x, 3, 1, "SAME")
-        bp = ConvBlock(pp, (1, 1), dtype=self.dtype, name="pool_proj")(bp)
+        bp = conv(pp, (1, 1), "pool_proj")(bp, train)
         return jnp.concatenate([b1, b3, b5, bp], axis=-1)
 
 
@@ -63,27 +67,45 @@ class GoogLeNetEmbedding(nn.Module):
     dtype: Any = jnp.bfloat16
     normalize: bool = True
     use_lrn: bool = True
+    # Inception-BN: BatchNorm after every conv (bias dropped), LRN off —
+    # the variant that trains from scratch; the BN-free v1 trunk collapses
+    # at random init (see ACCURACY.md).  Parameter-parity with the
+    # reference's prototxt trunk keeps use_bn=False the default.
+    use_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        use_lrn = self.use_lrn and not self.use_bn
         x = x.astype(self.dtype)
-        x = ConvBlock(64, (7, 7), (2, 2), dtype=self.dtype, name="conv1")(x)
+        x = ConvBlock(
+            64, (7, 7), (2, 2), dtype=self.dtype, use_bn=self.use_bn,
+            name="conv1",
+        )(x, train)
         x = max_pool(x, 3, 2)
-        if self.use_lrn:
+        if use_lrn:
             x = local_response_norm(x)
-        x = ConvBlock(64, (1, 1), dtype=self.dtype, name="conv2_reduce")(x)
-        x = ConvBlock(192, (3, 3), dtype=self.dtype, name="conv2")(x)
-        if self.use_lrn:
+        x = ConvBlock(
+            64, (1, 1), dtype=self.dtype, use_bn=self.use_bn,
+            name="conv2_reduce",
+        )(x, train)
+        x = ConvBlock(
+            192, (3, 3), dtype=self.dtype, use_bn=self.use_bn, name="conv2"
+        )(x, train)
+        if use_lrn:
             x = local_response_norm(x)
         x = max_pool(x, 3, 2)
-        x = Inception(_INCEPTION_PLAN["3a"], self.dtype, name="inception_3a")(x)
-        x = Inception(_INCEPTION_PLAN["3b"], self.dtype, name="inception_3b")(x)
+        incep = lambda key: Inception(
+            _INCEPTION_PLAN[key], self.dtype, self.use_bn,
+            name=f"inception_{key}",
+        )
+        x = incep("3a")(x, train)
+        x = incep("3b")(x, train)
         x = max_pool(x, 3, 2)
         for key in ("4a", "4b", "4c", "4d", "4e"):
-            x = Inception(_INCEPTION_PLAN[key], self.dtype, name=f"inception_{key}")(x)
+            x = incep(key)(x, train)
         x = max_pool(x, 3, 2)
-        x = Inception(_INCEPTION_PLAN["5a"], self.dtype, name="inception_5a")(x)
-        x = Inception(_INCEPTION_PLAN["5b"], self.dtype, name="inception_5b")(x)
+        x = incep("5a")(x, train)
+        x = incep("5b")(x, train)
         x = global_avg_pool(x)  # pool5/7x7_s1 -> (N, 1024)
         x = x.astype(jnp.float32)
         if self.normalize:

@@ -36,25 +36,37 @@ def local_response_norm(
 
 
 class ConvBlock(nn.Module):
-    """Conv + bias + ReLU, Caffe-style 'xavier' init (def.prototxt:98-110)."""
+    """Conv + bias + ReLU, Caffe-style 'xavier' init (def.prototxt:98-110).
+
+    ``use_bn=True`` switches to conv (no bias) + BatchNorm + ReLU — the
+    Inception-BN recipe.  A BN-free Inception-v1 from random init
+    collapses (all embeddings align; the original needed aux classifiers
+    + ImageNet schedules), so the BN variant is what trains from scratch.
+    """
 
     features: int
     kernel: Tuple[int, int]
     strides: Tuple[int, int] = (1, 1)
     padding: Any = "SAME"
     dtype: Any = jnp.float32
+    use_bn: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = False):
         x = nn.Conv(
             self.features,
             self.kernel,
             strides=self.strides,
             padding=self.padding,
             dtype=self.dtype,
+            use_bias=not self.use_bn,
             kernel_init=nn.initializers.xavier_uniform(),
             bias_init=nn.initializers.constant(0.2),
         )(x)
+        if self.use_bn:
+            x = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, dtype=self.dtype
+            )(x)
         return nn.relu(x)
 
 

@@ -67,11 +67,13 @@ from npairloss_tpu.ops.npair_loss import (
 from npairloss_tpu.ops.rank_select import (
     NUM_DIGITS,
     RADIX_BINS,
-    masked_digit_hist,
+    digit_of,
     population_count_dtype,
+    prefix_matches,
     radix_begin,
     radix_finish,
     radix_update,
+    sortable_key,
 )
 
 _RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
@@ -164,40 +166,112 @@ def _selection(sims, same, diff, pt, nt, cfg: NPairLossConfig):
 # ---------------------------------------------------------------------------
 
 
-def _stats_kernel(
-    scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
-    min_w_ref, max_b_ref, max_a_ref, cnt_s_ref, cnt_d_ref,
-):
-    # grid = (num_q_blocks, num_pool_blocks)
-    qi, ii = pl.program_id(0), pl.program_id(1)
-    bn, bm = feats_ref.shape[0], pool_ref.shape[0]
-    neg = jnp.float32(-FLT_MAX)
-    pos = jnp.float32(FLT_MAX)
+def _digit_hist_rows(sims, mask, digit: int, prefix=None):
+    """(RADIX_BINS, bn) histogram of one radix digit over a masked tile —
+    kernel-side compare-and-reduce (no scatter): one lane-reduction per
+    bin, each landing as a (1, bn) row.  ``prefix`` (optional, (bn, 1)
+    uint32) restricts to entries whose higher digits match."""
+    key = sortable_key(sims)
+    m = mask
+    if prefix is not None:
+        m = m & prefix_matches(key, prefix, digit)
+    d = jnp.where(m, digit_of(key, digit), RADIX_BINS)
+    rows = [
+        (d == b).sum(axis=1, keepdims=True).astype(jnp.int32).T
+        for b in range(RADIX_BINS)
+    ]
+    return jnp.concatenate(rows, axis=0)
 
-    @pl.when(ii == 0)
-    def _():
-        min_w_ref[:] = jnp.full_like(min_w_ref, pos)
-        max_b_ref[:] = jnp.full_like(max_b_ref, neg)
-        max_a_ref[:] = jnp.full_like(max_a_ref, neg)
-        cnt_s_ref[:] = jnp.zeros_like(cnt_s_ref)
-        cnt_d_ref[:] = jnp.zeros_like(cnt_d_ref)
 
-    sims = _sim_tile(feats_ref, pool_ref)
-    same, diff = _tile_masks(scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm)
-    min_w_ref[:] = jnp.minimum(
-        min_w_ref[:], jnp.where(same, sims, pos).min(axis=1, keepdims=True).T
-    )
-    max_b_ref[:] = jnp.maximum(
-        max_b_ref[:], jnp.where(diff, sims, neg).max(axis=1, keepdims=True).T
-    )
-    max_a_ref[:] = jnp.maximum(
-        max_a_ref[:],
-        jnp.where(same | diff, sims, neg).max(axis=1, keepdims=True).T,
-    )
-    # Pair-population sizes (the ragged list sizes of cu:266-273) feed the
-    # RELATIVE_* rank targets.
-    cnt_s_ref[:] += same.sum(axis=1, keepdims=True).astype(jnp.int32).T
-    cnt_d_ref[:] += diff.sum(axis=1, keepdims=True).astype(jnp.int32).T
+def _make_stats_kernel(hist_same: bool, hist_diff: bool):
+    """Mining-stats kernel; optionally also the digit-0 radix histograms
+    for RELATIVE_* sides (digit 0 needs no prefix, so accumulating it in
+    this sweep saves one whole pass per relative side)."""
+
+    def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+               *out_refs):
+        (min_w_ref, max_b_ref, max_a_ref, cnt_s_ref, cnt_d_ref), rest = (
+            out_refs[:5], list(out_refs[5:]))
+        h_s_ref = rest.pop(0) if hist_same else None
+        h_d_ref = rest.pop(0) if hist_diff else None
+        # grid = (num_q_blocks, num_pool_blocks)
+        qi, ii = pl.program_id(0), pl.program_id(1)
+        bn, bm = feats_ref.shape[0], pool_ref.shape[0]
+        neg = jnp.float32(-FLT_MAX)
+        pos = jnp.float32(FLT_MAX)
+
+        @pl.when(ii == 0)
+        def _():
+            min_w_ref[:] = jnp.full_like(min_w_ref, pos)
+            max_b_ref[:] = jnp.full_like(max_b_ref, neg)
+            max_a_ref[:] = jnp.full_like(max_a_ref, neg)
+            cnt_s_ref[:] = jnp.zeros_like(cnt_s_ref)
+            cnt_d_ref[:] = jnp.zeros_like(cnt_d_ref)
+            if h_s_ref is not None:
+                h_s_ref[:] = jnp.zeros_like(h_s_ref)
+            if h_d_ref is not None:
+                h_d_ref[:] = jnp.zeros_like(h_d_ref)
+
+        sims = _sim_tile(feats_ref, pool_ref)
+        same, diff = _tile_masks(
+            scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm
+        )
+        min_w_ref[:] = jnp.minimum(
+            min_w_ref[:],
+            jnp.where(same, sims, pos).min(axis=1, keepdims=True).T,
+        )
+        max_b_ref[:] = jnp.maximum(
+            max_b_ref[:],
+            jnp.where(diff, sims, neg).max(axis=1, keepdims=True).T,
+        )
+        max_a_ref[:] = jnp.maximum(
+            max_a_ref[:],
+            jnp.where(same | diff, sims, neg).max(axis=1, keepdims=True).T,
+        )
+        # Pair-population sizes (the ragged list sizes of cu:266-273)
+        # feed the RELATIVE_* rank targets.
+        cnt_s_ref[:] += same.sum(axis=1, keepdims=True).astype(jnp.int32).T
+        cnt_d_ref[:] += diff.sum(axis=1, keepdims=True).astype(jnp.int32).T
+        if h_s_ref is not None:
+            h_s_ref[:] += _digit_hist_rows(sims, same, 0)
+        if h_d_ref is not None:
+            h_d_ref[:] += _digit_hist_rows(sims, diff, 0)
+
+    return kernel
+
+
+def _make_hist_kernel(sides, digit: int):
+    """Radix digit-histogram kernel for digits >= 1: one fused sweep
+    recomputes the sim tile on the MXU and accumulates the prefix-matched
+    digit histogram for every active RELATIVE side (the streamed
+    counterpart of the reference's host std::sort, cu:266-273).
+
+    ``sides``: tuple of bools — use_same per side, in output order.
+    Inputs after the data refs: one (1, bn) uint32 prefix vector per
+    side; outputs: one (RADIX_BINS, bn) int32 histogram per side.
+    """
+
+    def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+               *rest):
+        prefix_refs = rest[:len(sides)]
+        out_refs = rest[len(sides):]
+        qi, ii = pl.program_id(0), pl.program_id(1)
+        bn, bm = feats_ref.shape[0], pool_ref.shape[0]
+
+        @pl.when(ii == 0)
+        def _():
+            for o in out_refs:
+                o[:] = jnp.zeros_like(o)
+
+        sims = _sim_tile(feats_ref, pool_ref)
+        same, diff = _tile_masks(
+            scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm
+        )
+        for use_same, p_ref, o_ref in zip(sides, prefix_refs, out_refs):
+            mask = same if use_same else diff
+            o_ref[:] += _digit_hist_rows(sims, mask, digit, p_ref[:].T)
+
+    return kernel
 
 
 def _make_loss_kernel(cfg: NPairLossConfig):
@@ -371,21 +445,58 @@ def _data_specs(bn: int, bm: int, dim: int, q_axis: int):
     ]
 
 
+def _hist_block(bn: int):
+    """(RADIX_BINS, bn) histogram BlockSpec indexed by the grid's query
+    axis (bins on sublanes, queries on lanes)."""
+    return pl.BlockSpec(
+        (RADIX_BINS, bn), lambda q, i: (0, q), memory_space=pltpu.VMEM
+    )
+
+
 def _run_stats(feats_p, labels_p, pool_p, pool_labels_p, scal,
-               bn, bm, interpret):
+               bn, bm, interpret, hist_same=False, hist_diff=False):
     npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
     npi = pool_p.shape[0] // bm
     n_p = feats_p.shape[0]
+    n_hists = int(hist_same) + int(hist_diff)
     out = pl.pallas_call(
-        _stats_kernel,
+        _make_stats_kernel(hist_same, hist_diff),
         grid=(npq, npi),
         in_specs=_data_specs(bn, bm, dim, 0),
-        out_specs=[_qvec(bn, 0)] * 5,
+        out_specs=[_qvec(bn, 0)] * 5 + [_hist_block(bn)] * n_hists,
         out_shape=[jax.ShapeDtypeStruct((1, n_p), jnp.float32)] * 3
-        + [jax.ShapeDtypeStruct((1, n_p), jnp.int32)] * 2,
+        + [jax.ShapeDtypeStruct((1, n_p), jnp.int32)] * 2
+        + [jax.ShapeDtypeStruct((RADIX_BINS, n_p), jnp.int32)] * n_hists,
         interpret=interpret,
     )(scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p))
-    return tuple(o[0, :] for o in out)
+    flat = [o[0, :] for o in out[:5]]
+    hists = [o.T for o in out[5:]]  # -> [n_p, RADIX_BINS]
+    h_s = hists.pop(0) if hist_same else None
+    h_d = hists.pop(0) if hist_diff else None
+    return (*flat, h_s, h_d)
+
+
+def _run_hist(feats_p, labels_p, pool_p, pool_labels_p, scal,
+              use_same_flags, prefixes_p, digit, bn, bm, interpret):
+    """One fused sweep -> per-side [n_p, RADIX_BINS] digit histograms."""
+    npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
+    npi = pool_p.shape[0] // bm
+    n_p = feats_p.shape[0]
+    k = len(use_same_flags)
+    out = pl.pallas_call(
+        _make_hist_kernel(tuple(use_same_flags), digit),
+        grid=(npq, npi),
+        in_specs=_data_specs(bn, bm, dim, 0) + [_qvec(bn, 0)] * k,
+        out_specs=[_hist_block(bn)] * k,
+        out_shape=[
+            jax.ShapeDtypeStruct((RADIX_BINS, n_p), jnp.int32)
+        ] * k,
+        interpret=interpret,
+    )(
+        scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
+        *[_row(p) for p in prefixes_p],
+    )
+    return [o.T for o in out]
 
 
 def _run_loss(feats_p, labels_p, pool_p, pool_labels_p, scal,
@@ -444,40 +555,37 @@ def _run_bwd(feats_p, labels_p, pool_p, pool_labels_p, scal,
 # ---------------------------------------------------------------------------
 
 
-def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
+def _thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
+                min_w, max_b, cnt_s, cnt_d, h0_s, h0_d,
+                cfg, bn, bm, interpret, n):
     """(pos_thr, neg_thr) for ANY mining config: absolute methods from the
     streamed min/max stats, RELATIVE_* via exact stepwise radix selection.
 
     Reproduces the dense ``_local/_global_relative_threshold`` semantics
     (ascending sort + ``_relative_pos`` index + ``< 0 -> -FLT_MAX``
-    clamp, reference cu:275-337) via ops.rank_select: NUM_DIGITS
-    streamed passes of MSD radix selection — each a lax.scan over pool
-    tiles recomputing the sim tile and histogramming one RADIX_BITS-bit
-    digit via scatter-free compare-and-reduce — pin down all 32 bits of
-    the target element.  The sim tile is computed ONCE per pass and
-    feeds both the AP and the AN histogram, so relative mining costs
-    NUM_DIGITS passes whether one or both sides are relative.  GLOBAL
-    ranks over the whole flattened population (cu:296, cu:327), LOCAL
-    per query; populations beyond 2^31 pairs need 64-bit counts
-    (jax_enable_x64) or fail loudly at trace time.
+    clamp, reference cu:275-337) via ops.rank_select, entirely inside
+    Pallas sweeps: the digit-0 histograms ride the stats kernel for free
+    (digit 0 needs no prefix), and each remaining digit is one fused
+    ``_make_hist_kernel`` sweep — sim tile on the MXU, prefix-matched
+    compare-and-reduce histogram on the VPU, shared across the AP and AN
+    sides.  So relative mining costs NUM_DIGITS - 1 extra kernel sweeps
+    whether one or both sides are relative.  GLOBAL ranks over the whole
+    flattened population (cu:296, cu:327), LOCAL per query; populations
+    beyond 2^31 pairs need 64-bit counts (jax_enable_x64) or fail loudly
+    at trace time.
     """
     pos_thr, neg_thr = absolute_thresholds(min_w, max_b, cfg)
     sides = {}
     if cfg.ap_mining_method in _RELATIVE:
-        sides["ap"] = (True, cfg.identsn, cfg.ap_mining_region, cnt_s)
+        sides["ap"] = (True, cfg.identsn, cfg.ap_mining_region, cnt_s, h0_s)
     if cfg.an_mining_method in _RELATIVE:
-        sides["an"] = (False, cfg.diffsn, cfg.an_mining_region, cnt_d)
+        sides["an"] = (False, cfg.diffsn, cfg.an_mining_region, cnt_d, h0_d)
     if not sides:
         return pos_thr, neg_thr
 
-    n, dim = features.shape
-    pool = _pad_rows(features, block).reshape(-1, block, dim)
-    pool_l = _pad_rows(labels, block).reshape(-1, block)
-    nblocks = pool.shape[0]
-    row = jnp.arange(n, dtype=jnp.int32)[:, None]
-
     def prep_hist(side, hist):
-        _, _, region, _ = sides[side]
+        _, _, region, _, _ = sides[side]
+        hist = hist[:n]
         if region == MiningRegion.GLOBAL:
             cdt = population_count_dtype(n * n)
             hist = jnp.broadcast_to(
@@ -486,7 +594,7 @@ def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
         return hist
 
     states, empties = {}, {}
-    for s, (use_same, sn, region, counts) in sides.items():
+    for s, (use_same, sn, region, counts, hist0) in sides.items():
         if region == MiningRegion.GLOBAL:
             # Self-pool population is at most n x n pairs; beyond int32
             # the counts (and the rank walk) must be 64-bit or fail.
@@ -497,36 +605,18 @@ def _thresholds(features, labels, min_w, max_b, cnt_s, cnt_d, cfg, block):
         else:
             k = _relative_pos(counts, sn)
             empties[s] = counts == 0
-        states[s] = radix_begin(k)
+        states[s] = radix_update(radix_begin(k), prep_hist(s, hist0))
 
-    for digit in range(NUM_DIGITS):
-        prefixes = {s: states[s][1] for s in sides}
-
-        def step(hists, blk):
-            bf, bl, idx = blk
-            sims = jnp.dot(
-                features, bf.T,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
-            col = idx * block + jnp.arange(block, dtype=jnp.int32)[None, :]
-            valid = (col < n) & (col != row)  # padding + self-pair (cu:54)
-            same_lbl = labels[:, None] == bl[None, :]
-            out = dict(hists)
-            for s, (use_same, _, _, _) in sides.items():
-                mask = (same_lbl if use_same else ~same_lbl) & valid
-                out[s] = out[s] + masked_digit_hist(
-                    sims, mask, prefixes[s], digit
-                )
-            return out, None
-
-        hists, _ = jax.lax.scan(
-            step,
-            {s: jnp.zeros((n, RADIX_BINS), jnp.int32) for s in sides},
-            (pool, pool_l, jnp.arange(nblocks, dtype=jnp.int32)),
+    names = list(sides)
+    use_same_flags = [sides[s][0] for s in names]
+    for digit in range(1, NUM_DIGITS):
+        prefixes_p = [_pad_rows(states[s][1], bn) for s in names]
+        hists = _run_hist(
+            feats_p, labels_p, pool_p, pool_labels_p, scal,
+            use_same_flags, prefixes_p, digit, bn, bm, interpret,
         )
-        for s in sides:
-            states[s] = radix_update(states[s], prep_hist(s, hists[s]))
+        for s, h in zip(names, hists):
+            states[s] = radix_update(states[s], prep_hist(s, h))
 
     vals = {
         s: _clamp_negative(radix_finish(states[s], empties[s]))
@@ -556,12 +646,16 @@ def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret):
     pool_labels_p = _pad_rows(labels_i, bm)
     scal = jnp.array([n, 0, n], jnp.int32)  # [m_real, self_offset, n_real]
 
-    min_w, max_b, max_all, cnt_s, cnt_d = _run_stats(
-        feats_p, labels_qp, pool_p, pool_labels_p, scal, bn, bm, interpret
+    min_w, max_b, max_all, cnt_s, cnt_d, h0_s, h0_d = _run_stats(
+        feats_p, labels_qp, pool_p, pool_labels_p, scal, bn, bm, interpret,
+        hist_same=cfg.ap_mining_method in _RELATIVE,
+        hist_diff=cfg.an_mining_method in _RELATIVE,
     )
     min_w, max_b, max_all = min_w[:n], max_b[:n], max_all[:n]
     pos_thr, neg_thr = _thresholds(
-        features, labels_i, min_w, max_b, cnt_s[:n], cnt_d[:n], cfg, bm
+        feats_p, labels_qp, pool_p, pool_labels_p, scal,
+        min_w, max_b, cnt_s[:n], cnt_d[:n], h0_s, h0_d,
+        cfg, bn, bm, interpret, n,
     )
     out = _run_loss(
         feats_p, labels_qp, pool_p, pool_labels_p, scal,

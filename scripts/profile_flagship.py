@@ -1,0 +1,213 @@
+"""Differential profile of the flagship training step (VERDICT r2 item 4).
+
+``jax.profiler`` traces cannot be collected through the tunneled axon
+backend (the trace RPC wedges the tunnel — observed round 3), so the
+bottleneck attribution is DIFFERENTIAL: time carefully-chosen ablations
+of the flagship step (GoogLeNet bf16 + mined N-pair loss + analytic
+backward + Caffe-SGD update, batch 120 @ 224x224) and attribute the
+deltas.  Every measurement is N perturbed steps inside one jitted
+``lax.scan``, host-fetch synced, dispatch-floor subtracted — see
+bench.py's timing discipline.
+
+Variants:
+  full           the flagship solver step (dense engine)
+  fwd_only       model forward only
+  fwd_bwd        model fwd+bwd with loss = sum(emb) (no npair machinery)
+  npair_only     mined loss+VJP on precomputed (120, 1024) embeddings
+  no_lrn         full minus LRN (use_lrn=False)
+  fp32           full at fp32 activations
+  bn             full with the Inception-BN trunk (BN instead of LRN)
+
+Writes PROFILE.md + profile/flagship.json.
+
+Usage: python scripts/profile_flagship.py [--steps 10] [--batch 120]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCH = 120
+IMAGE = 224
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--image", type=int, default=IMAGE)
+    args = ap.parse_args()
+
+    image = args.image
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from npairloss_tpu import REFERENCE_CONFIG, npair_loss
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import caffe_sgd, lr_schedule
+
+    dev = jax.devices()[0]
+    print(f"[profile] backend={dev.platform} kind={dev.device_kind}",
+          file=sys.stderr, flush=True)
+
+    batch, steps = args.batch, args.steps
+    rng = np.random.default_rng(0)
+    images = jax.device_put(jnp.asarray(
+        rng.standard_normal((batch, image, image, 3)).astype(np.float32)))
+    labels = jax.device_put(jnp.asarray(
+        np.repeat(np.arange(batch // 2), 2).astype(np.int32)))
+    emb_fixed = rng.standard_normal((batch, 1024)).astype(np.float32)
+    emb_fixed /= np.linalg.norm(emb_fixed, axis=1, keepdims=True)
+    emb_fixed = jax.device_put(jnp.asarray(emb_fixed))
+
+    @jax.jit
+    def tiny(x):
+        return x.sum()
+
+    float(np.asarray(tiny(jnp.full((8, 8), 1.0))))
+    t0 = time.perf_counter()
+    float(np.asarray(tiny(jnp.full((8, 8), 2.0))))
+    floor = time.perf_counter() - t0
+    print(f"[profile] fetch floor {floor * 1e3:.1f} ms",
+          file=sys.stderr, flush=True)
+
+    rate_fn = lr_schedule("step", 0.001, 0.5, 10000)
+    tx = caffe_sgd(rate_fn, 0.9, 2e-5)
+
+    results = {}
+
+    def timed(name, make_step, x):
+        """make_step() -> (params, step_fn(params, x, s) -> (params, loss))."""
+        params, step_fn = make_step()
+
+        @jax.jit
+        def many(params, x, round_id):
+            def body(p, s):
+                p2, loss = step_fn(p, x, round_id * steps + s)
+                return p2, loss
+
+            p, losses = jax.lax.scan(
+                body, params, jnp.arange(steps, dtype=jnp.float32))
+            return jax.tree_util.tree_reduce(
+                lambda a, l: a + l.astype(jnp.float32).sum(), p,
+                jnp.float32(0.0),
+            ), losses[-1]
+
+        print(f"[profile] compiling {name}...", file=sys.stderr, flush=True)
+        acc, _ = many(params, x, jnp.float32(0))
+        float(np.asarray(acc))
+        acc, _ = many(params, x, jnp.float32(1))
+        float(np.asarray(acc))
+        t0 = time.perf_counter()
+        acc, loss = many(params, x, jnp.float32(2))
+        float(np.asarray(acc))
+        dt = max(time.perf_counter() - t0 - floor, 1e-9) / steps
+        results[name] = {
+            "ms_per_step": round(dt * 1e3, 2),
+            "emb_per_sec": round(batch / dt, 1),
+        }
+        print(f"[profile] {name}: {dt * 1e3:.2f} ms/step",
+              file=sys.stderr, flush=True)
+
+    def model_step(model_name, with_loss=True, **model_kw):
+        def make():
+            model = get_model(model_name, **model_kw)
+            variables = model.init(
+                jax.random.PRNGKey(0), np.zeros((2, image, image, 3),
+                                                np.float32), train=False)
+            params = variables["params"]
+            bstats = variables.get("batch_stats", {})
+
+            def step(p, x, s):
+                def loss_fn(pp):
+                    xin = x * (1.0 + s * 1e-6)
+                    if bstats:
+                        emb, _ = model.apply(
+                            {"params": pp, "batch_stats": bstats}, xin,
+                            train=True, mutable=["batch_stats"])
+                    else:
+                        emb = model.apply({"params": pp}, xin, train=True)
+                    if with_loss:
+                        return npair_loss(emb, labels, REFERENCE_CONFIG)
+                    return emb.astype(jnp.float32).sum()
+
+                loss, grads = jax.value_and_grad(loss_fn)(p)
+                upd, _ = tx.update(grads, tx.init(p), p)
+                p2 = jax.tree_util.tree_map(
+                    lambda a, u: (a.astype(jnp.float32) + u).astype(a.dtype),
+                    p, upd)
+                return p2, loss
+
+            return params, step
+
+        return make
+
+    # -- variants ---------------------------------------------------------
+    def fwd_only():
+        model = get_model("googlenet", dtype=jnp.bfloat16)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((2, image, image, 3), np.float32), train=False)
+
+        def step(p, x, s):
+            emb = model.apply({"params": p}, x * (1.0 + s * 1e-6),
+                              train=True)
+            return p, emb.astype(jnp.float32).sum()
+
+        return variables["params"], step
+
+    def npair_only():
+        def step(p, e, s):
+            loss, g = jax.value_and_grad(
+                lambda ee: npair_loss(ee, labels, REFERENCE_CONFIG)
+            )(e * (1.0 + s * 1e-6))
+            return jax.tree_util.tree_map(lambda a: a + g[0, 0] * 0, p), loss
+
+        return {"w": jnp.zeros(())}, step
+
+    timed("full", model_step("googlenet", dtype=jnp.bfloat16), images)
+    timed("fwd_only", fwd_only, images)
+    timed("fwd_bwd", model_step("googlenet", with_loss=False,
+                                dtype=jnp.bfloat16), images)
+    timed("npair_only", npair_only, emb_fixed)
+    timed("no_lrn", model_step("googlenet", dtype=jnp.bfloat16,
+                               use_lrn=False), images)
+    timed("fp32", model_step("googlenet", dtype=jnp.float32), images)
+    timed("bn", model_step("googlenet_bn", dtype=jnp.bfloat16), images)
+
+    # XLA's own FLOPs for the full step (for the MFU denominator).
+    payload = {
+        "device": dev.device_kind,
+        "batch": batch,
+        "steps_per_timing": steps,
+        "fetch_floor_ms": round(floor * 1e3, 1),
+        "results": results,
+    }
+    os.makedirs(os.path.join(REPO, "profile"), exist_ok=True)
+    with open(os.path.join(REPO, "profile", "flagship.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

