@@ -62,15 +62,10 @@ def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
     )
 
 
-def cmd_train(args) -> int:
-    # The MPI_COMM_WORLD replacement: must run before the first backend
-    # query (exactly as MPI_Init precedes any communicator use).
-    from npairloss_tpu.parallel import initialize_distributed
-
-    initialize_distributed(
-        args.coordinator, args.num_processes, args.process_id
-    )
-
+def _build_solver(args):
+    """Shared setup for train/test/extract: parse the solver + net
+    prototxts, build the model and (optional) mesh, restore a snapshot.
+    Returns (solver, net_cfg, input_shape) or an int error code."""
     import jax
 
     from npairloss_tpu.config import load_net, load_solver
@@ -93,11 +88,11 @@ def cmd_train(args) -> int:
         return 2
     net_cfg = load_net(net_path)
 
-    if args.max_iter is not None:
+    if getattr(args, "max_iter", None) is not None:
         import dataclasses
 
         solver_cfg = dataclasses.replace(solver_cfg, max_iter=args.max_iter)
-    if args.snapshot_prefix:
+    if getattr(args, "snapshot_prefix", None):
         import dataclasses
 
         solver_cfg = dataclasses.replace(
@@ -105,9 +100,13 @@ def cmd_train(args) -> int:
         )
 
     crop = 0
-    train_data = net_cfg.data.get("TRAIN")
-    if train_data is not None:
-        crop = train_data.transform.crop_size
+    # Shape from the TRAIN layer, else the TEST layer (a net may define
+    # only one; test/extract against a TEST-only net must not default).
+    for phase in ("TRAIN", "TEST"):
+        d = net_cfg.data.get(phase)
+        if d is not None and d.transform.crop_size:
+            crop = d.transform.crop_size
+            break
     side = crop or 224
     input_shape = (side, side, 3)
 
@@ -132,8 +131,24 @@ def cmd_train(args) -> int:
     solver = Solver(
         model, loss_cfg, solver_cfg, mesh=mesh, input_shape=input_shape
     )
-    if args.resume:
+    if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)
+    return solver, net_cfg, input_shape
+
+
+def cmd_train(args) -> int:
+    # The MPI_COMM_WORLD replacement: must run before the first backend
+    # query (exactly as MPI_Init precedes any communicator use).
+    from npairloss_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        args.coordinator, args.num_processes, args.process_id
+    )
+
+    built = _build_solver(args)
+    if isinstance(built, int):
+        return built
+    solver, net_cfg, input_shape = built
 
     train_iter, _ = _build_data(
         net_cfg, "TRAIN", input_shape, seed=0, synthetic=args.synthetic
@@ -167,6 +182,78 @@ def _model_for_net(net_cfg) -> str:
     return "googlenet"  # the reference's flagship trunk (def.prototxt:1)
 
 
+def cmd_test(args) -> int:
+    """The ``caffe test`` counterpart: restore a snapshot and run the
+    TEST phase (same loss+metrics forward as training — the reference
+    has no separate eval path, SURVEY.md §3.4) for ``test_iter`` batches."""
+    built = _build_solver(args)
+    if isinstance(built, int):
+        return built
+    solver, net_cfg, input_shape = built
+    test_iter, _ = _build_data(
+        net_cfg, "TEST", input_shape, seed=1, synthetic=args.synthetic
+    )
+    if test_iter is None:
+        log.error("net has no TEST MultibatchData layer")
+        return 2
+    iters = args.iterations or solver.cfg.test_iter
+    m = solver.evaluate(test_iter, iters)
+    print(json.dumps({k: float(v) for k, v in sorted(m.items())}))
+    return 0
+
+
+def cmd_extract(args) -> int:
+    """Embedding extraction — the metric-learning deployment product
+    (the reference's pool5/L2Normalize feature is what retrieval systems
+    consume; Caffe's `extract_features` workflow).  Runs the trunk in
+    eval mode over the TEST (or TRAIN) source and writes embeddings +
+    labels as .npy."""
+    import numpy as np
+
+    built = _build_solver(args)
+    if isinstance(built, int):
+        return built
+    solver, net_cfg, input_shape = built
+    phase = args.phase.upper()
+    batches, _ = _build_data(
+        net_cfg, phase, input_shape, seed=1, synthetic=args.synthetic
+    )
+    if batches is None:
+        log.error("net has no %s MultibatchData layer", phase)
+        return 2
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def embed(state, x):
+        variables = {"params": state["params"]}
+        if state["batch_stats"]:
+            variables["batch_stats"] = state["batch_stats"]
+        return solver.model.apply(variables, x, train=False)
+
+    embs, labs = [], []
+    for _ in range(args.batches):
+        x, lab = next(batches)
+        if solver.state is None:
+            # Init from the actual batch shape (like Solver.step does):
+            # the net's TRAIN and TEST layers may crop differently.
+            solver.init(np.asarray(x)[:2])
+        embs.append(np.asarray(embed(solver.state, jnp.asarray(x))))
+        labs.append(np.asarray(lab))
+    emb = np.concatenate(embs, axis=0)
+    lab = np.concatenate(labs, axis=0)
+    np.save(args.out + ".emb.npy", emb)
+    np.save(args.out + ".labels.npy", lab)
+    print(json.dumps({
+        "embeddings": args.out + ".emb.npy",
+        "labels": args.out + ".labels.npy",
+        "shape": list(emb.shape),
+        "mean_norm": float(np.linalg.norm(emb, axis=1).mean()),
+    }))
+    return 0
+
+
 def cmd_parse(args) -> int:
     from npairloss_tpu.config import dumps, parse_file
 
@@ -196,6 +283,14 @@ def main(argv: Optional[list] = None) -> int:
         prog="npairloss_tpu", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
+    p.add_argument(
+        "--platform", choices=["default", "cpu"], default="default",
+        help="force the jax platform BEFORE backend init via "
+        "jax.config.update (more robust than the JAX_PLATFORMS env var: "
+        "when a remote TPU plugin's tunnel is unreachable, env-var "
+        "forcing still hangs in plugin discovery, the config path "
+        "does not)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     t = sub.add_parser("train", help="train from a solver prototxt")
@@ -221,6 +316,34 @@ def main(argv: Optional[list] = None) -> int:
     t.add_argument("--process-id", type=int, help="this process's rank")
     t.set_defaults(fn=cmd_train)
 
+    def _common(sp):
+        sp.add_argument("--solver", required=True)
+        sp.add_argument("--net", help="override the solver's net path")
+        sp.add_argument("--model", help="model registry name")
+        sp.add_argument("--mesh", type=int, help="devices in the dp mesh")
+        sp.add_argument("--bf16", action="store_true")
+        sp.add_argument("--resume", help="snapshot path to restore")
+        sp.add_argument("--synthetic", action="store_true")
+
+    tt = sub.add_parser(
+        "test", help="TEST phase only from a snapshot (caffe test)"
+    )
+    _common(tt)
+    tt.add_argument(
+        "--iterations", type=int,
+        help="TEST batches to average (default: solver test_iter)",
+    )
+    tt.set_defaults(fn=cmd_test)
+
+    ex = sub.add_parser(
+        "extract", help="dump embeddings + labels to .npy (eval mode)"
+    )
+    _common(ex)
+    ex.add_argument("--phase", default="TEST", choices=["TEST", "TRAIN", "test", "train"])
+    ex.add_argument("--batches", type=int, default=16)
+    ex.add_argument("--out", default="./features")
+    ex.set_defaults(fn=cmd_extract)
+
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")
     pp.add_argument("file")
     pp.add_argument("--json", action="store_true")
@@ -230,6 +353,10 @@ def main(argv: Optional[list] = None) -> int:
     b.set_defaults(fn=cmd_bench)
 
     args = p.parse_args(argv)
+    if args.platform != "default":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     return args.fn(args)
 
 

@@ -96,29 +96,31 @@ def main():
     results = {}
 
     def timed(name, make_step, x):
-        """make_step() -> (params, step_fn(params, x, s) -> (params, loss))."""
-        params, step_fn = make_step()
+        """make_step() -> (carry, step_fn(carry, x, s) -> (carry, loss));
+        the carry holds params AND optimizer state so momentum-buffer
+        HBM traffic and schedule progression are inside the timing."""
+        carry0, step_fn = make_step()
 
         @jax.jit
-        def many(params, x, round_id):
-            def body(p, s):
-                p2, loss = step_fn(p, x, round_id * steps + s)
-                return p2, loss
+        def many(carry, x, round_id):
+            def body(c, s):
+                c2, loss = step_fn(c, x, round_id * steps + s)
+                return c2, loss
 
-            p, losses = jax.lax.scan(
-                body, params, jnp.arange(steps, dtype=jnp.float32))
+            c, losses = jax.lax.scan(
+                body, carry, jnp.arange(steps, dtype=jnp.float32))
             return jax.tree_util.tree_reduce(
-                lambda a, l: a + l.astype(jnp.float32).sum(), p,
+                lambda a, l: a + l.astype(jnp.float32).sum(), c,
                 jnp.float32(0.0),
             ), losses[-1]
 
         print(f"[profile] compiling {name}...", file=sys.stderr, flush=True)
-        acc, _ = many(params, x, jnp.float32(0))
+        acc, _ = many(carry0, x, jnp.float32(0))
         float(np.asarray(acc))
-        acc, _ = many(params, x, jnp.float32(1))
+        acc, _ = many(carry0, x, jnp.float32(1))
         float(np.asarray(acc))
         t0 = time.perf_counter()
-        acc, loss = many(params, x, jnp.float32(2))
+        acc, loss = many(carry0, x, jnp.float32(2))
         float(np.asarray(acc))
         dt = max(time.perf_counter() - t0 - floor, 1e-9) / steps
         results[name] = {
@@ -137,7 +139,9 @@ def main():
             params = variables["params"]
             bstats = variables.get("batch_stats", {})
 
-            def step(p, x, s):
+            def step(carry, x, s):
+                p, opt = carry
+
                 def loss_fn(pp):
                     xin = x * (1.0 + s * 1e-6)
                     if bstats:
@@ -151,13 +155,13 @@ def main():
                     return emb.astype(jnp.float32).sum()
 
                 loss, grads = jax.value_and_grad(loss_fn)(p)
-                upd, _ = tx.update(grads, tx.init(p), p)
+                upd, opt = tx.update(grads, opt, p)
                 p2 = jax.tree_util.tree_map(
                     lambda a, u: (a.astype(jnp.float32) + u).astype(a.dtype),
                     p, upd)
-                return p2, loss
+                return (p2, opt), loss
 
-            return params, step
+            return (params, tx.init(params)), step
 
         return make
 

@@ -60,3 +60,42 @@ def test_train_synthetic_opt_in_runs():
         "--model", "mlp", "--max_iter", "2", "--synthetic",
     ])
     assert rc == 0
+
+
+def test_cli_test_command(tmp_path, capsys):
+    """`test` = caffe test counterpart: TEST phase metrics from a
+    (fresh or restored) model, no training."""
+    rc = main([
+        "test", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--synthetic", "--iterations", "2",
+    ])
+    assert rc == 0
+    import json
+
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    m = json.loads(out)
+    assert "loss" in m and "retrieve_top1" in m
+    assert all(abs(v) < 1e9 for v in m.values())
+
+
+def test_cli_extract_command(tmp_path, capsys):
+    """`extract` dumps eval-mode embeddings + labels as .npy."""
+    out_prefix = str(tmp_path / "feat")
+    rc = main([
+        "extract", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--synthetic", "--batches", "2",
+        "--phase", "TEST", "--out", out_prefix,
+    ])
+    assert rc == 0
+    import json
+
+    import numpy as np
+
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    emb = np.load(rec["embeddings"])
+    lab = np.load(rec["labels"])
+    assert emb.shape[0] == lab.shape[0] > 0
+    # L2Normalize head: unit-norm rows (the deployment contract)
+    np.testing.assert_allclose(
+        np.linalg.norm(emb, axis=1), 1.0, rtol=1e-4
+    )
