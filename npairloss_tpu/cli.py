@@ -157,13 +157,17 @@ def cmd_train(args) -> int:
         net_cfg, "TEST", input_shape, seed=1, synthetic=args.synthetic
     )
     if train_iter is None:
-        log.error("net %s has no TRAIN MultibatchData layer", net_path)
+        log.error(
+            "net %s has no TRAIN MultibatchData layer",
+            args.net or args.solver,
+        )
         return 2
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
+    # max_iter override was already baked into solver.cfg by
+    # _build_solver; train() falls back to it — one source of truth.
     final = solver.train(
         train_iter,
-        num_iters=args.max_iter,
         test_batches=test_iter,
         log_fn=lambda s: print(s, flush=True),
     )
@@ -197,6 +201,12 @@ def cmd_test(args) -> int:
         log.error("net has no TEST MultibatchData layer")
         return 2
     iters = args.iterations or solver.cfg.test_iter
+    if iters <= 0:
+        log.error(
+            "nothing to evaluate: solver test_iter is 0 and --iterations "
+            "was not given"
+        )
+        return 2
     m = solver.evaluate(test_iter, iters)
     print(json.dumps({k: float(v) for k, v in sorted(m.items())}))
     return 0
@@ -239,8 +249,11 @@ def cmd_extract(args) -> int:
             # Init from the actual batch shape (like Solver.step does):
             # the net's TRAIN and TEST layers may crop differently.
             solver.init(np.asarray(x)[:2])
-        embs.append(np.asarray(embed(solver.state, jnp.asarray(x))))
-        labs.append(np.asarray(lab))
+        # _put_batch shards the batch over the mesh (when one was built
+        # with --mesh) exactly like train/test steps do.
+        x_d, lab_d = solver._put_batch(x, lab)
+        embs.append(np.asarray(embed(solver.state, x_d)))
+        labs.append(np.asarray(lab_d))
     emb = np.concatenate(embs, axis=0)
     lab = np.concatenate(labs, axis=0)
     np.save(args.out + ".emb.npy", emb)

@@ -1,0 +1,107 @@
+"""Generate MultibatchData ``source`` list files from an image tree.
+
+The reference's data layer consumes ``root_folder`` + ``source`` (a text
+file of ``relative/path label`` lines, usage/def.prototxt:17-24) but the
+tooling that produced those lists lived in the implied private fork.
+This is its counterpart for the standard metric-learning layouts:
+
+  class-per-directory (CUB-200-2011, Stanford Online Products extracts):
+      root/<class_name>/<image>            -> label = class index
+
+  optional train/test split by class id (the zero-shot protocol both
+  CUB and SOP use: first half of classes train, second half test).
+
+Usage:
+  python tools/make_list.py ROOT --out train.txt
+  python tools/make_list.py ROOT --out-train train.txt --out-test test.txt \
+      --split-classes 100          # first 100 class ids -> train
+  python tools/make_list.py ROOT --min-images 2   # drop singleton ids
+                                  # (the sampler needs >= 2 per identity)
+
+Deterministic: classes sorted by name, images sorted within a class.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".pgm", ".npy"}
+
+
+def scan(root: str, min_images: int):
+    """[(class_name, [relpath, ...])] sorted, singletons optionally dropped."""
+    classes = []
+    for name in sorted(os.listdir(root)):
+        cdir = os.path.join(root, name)
+        if not os.path.isdir(cdir):
+            continue
+        imgs = sorted(
+            os.path.join(name, f)
+            for f in os.listdir(cdir)
+            if os.path.splitext(f)[1].lower() in IMAGE_EXTS
+        )
+        if len(imgs) >= min_images:
+            classes.append((name, imgs))
+        elif imgs:
+            print(
+                f"[make_list] dropping {name!r}: {len(imgs)} image(s) < "
+                f"--min-images {min_images} (the identity-balanced sampler "
+                "needs img_num_per_identity per id)",
+                file=sys.stderr,
+            )
+    return classes
+
+
+def write_list(path: str, entries):
+    with open(path, "w", encoding="utf-8") as f:
+        for rel, label in entries:
+            f.write(f"{rel} {label}\n")
+    print(f"[make_list] wrote {path}: {len(entries)} lines")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("root", help="image tree root (class-per-directory)")
+    ap.add_argument("--out", help="single list file for ALL classes")
+    ap.add_argument("--out-train", help="train list (with --split-classes)")
+    ap.add_argument("--out-test", help="test list (with --split-classes)")
+    ap.add_argument(
+        "--split-classes", type=int, default=0,
+        help="first N class ids -> train, rest -> test (zero-shot split)",
+    )
+    ap.add_argument(
+        "--min-images", type=int, default=2,
+        help="drop classes with fewer images (sampler needs >= 2/id)",
+    )
+    args = ap.parse_args()
+
+    classes = scan(args.root, args.min_images)
+    if not classes:
+        print("[make_list] no classes found", file=sys.stderr)
+        return 1
+
+    if args.split_classes:
+        if not (args.out_train and args.out_test):
+            ap.error("--split-classes needs --out-train and --out-test")
+        train, test = [], []
+        for label, (_, imgs) in enumerate(classes):
+            dest = train if label < args.split_classes else test
+            dest.extend((rel, label) for rel in imgs)
+        write_list(args.out_train, train)
+        write_list(args.out_test, test)
+    else:
+        if not args.out:
+            ap.error("pass --out (or --split-classes with --out-train/--out-test)")
+        entries = [
+            (rel, label)
+            for label, (_, imgs) in enumerate(classes)
+            for rel in imgs
+        ]
+        write_list(args.out, entries)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
