@@ -200,11 +200,14 @@ def cmd_test(args) -> int:
     if test_iter is None:
         log.error("net has no TEST MultibatchData layer")
         return 2
-    iters = args.iterations or solver.cfg.test_iter
+    iters = (solver.cfg.test_iter if args.iterations is None
+             else args.iterations)
     if iters <= 0:
         log.error(
-            "nothing to evaluate: solver test_iter is 0 and --iterations "
-            "was not given"
+            "nothing to evaluate: %s",
+            f"--iterations {iters} requests no batches" if args.iterations
+            is not None else "solver test_iter is 0 and --iterations was "
+            "not given",
         )
         return 2
     m = solver.evaluate(test_iter, iters)
@@ -235,12 +238,25 @@ def cmd_extract(args) -> int:
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def embed(state, x):
+    def embed_fn(state, x):
         variables = {"params": state["params"]}
         if state["batch_stats"]:
             variables["batch_stats"] = state["batch_stats"]
         return solver.model.apply(variables, x, train=False)
+
+    if solver.mesh is not None:
+        # Split the batch over the mesh like train/test steps do (their
+        # sharding comes from in_shardings on the jitted step, not from
+        # the device_put — a bare jit would run replicated).  Embedding
+        # extraction is per-row, so this is pure data parallelism.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        embed = jax.jit(
+            embed_fn,
+            in_shardings=(None, NamedSharding(solver.mesh, P(solver.axis))),
+        )
+    else:
+        embed = jax.jit(embed_fn)
 
     embs, labs = [], []
     for _ in range(args.batches):
@@ -249,11 +265,8 @@ def cmd_extract(args) -> int:
             # Init from the actual batch shape (like Solver.step does):
             # the net's TRAIN and TEST layers may crop differently.
             solver.init(np.asarray(x)[:2])
-        # _put_batch shards the batch over the mesh (when one was built
-        # with --mesh) exactly like train/test steps do.
-        x_d, lab_d = solver._put_batch(x, lab)
-        embs.append(np.asarray(embed(solver.state, x_d)))
-        labs.append(np.asarray(lab_d))
+        embs.append(np.asarray(embed(solver.state, jnp.asarray(x))))
+        labs.append(np.asarray(lab))
     emb = np.concatenate(embs, axis=0)
     lab = np.concatenate(labs, axis=0)
     np.save(args.out + ".emb.npy", emb)

@@ -106,6 +106,30 @@ def main() -> int:
         rank = start  # stacked axis: one row per shard
         np.testing.assert_allclose(got, want[rank], rtol=3e-5, err_msg=f"rank {rank}")
 
+    # Ring engine across REAL process boundaries: the ppermute rotation
+    # (feature blocks + the traveling database-role grad,
+    # parallel/ring.py) must cross the process-spanning mesh and land on
+    # the same per-rank losses the (oracle-verified) dense path produced.
+    from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
+
+    ring_stack = jax.jit(
+        jax.shard_map(
+            lambda ff, ll: ring_npair_loss_and_metrics(
+                ff, ll, REFERENCE_CONFIG, "dp", top_ks=()
+            )[0][None],
+            mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
+        )
+    )(feats, labs)
+    ring_mine = sorted(
+        (s.index[0].start or 0, float(np.asarray(s.data)[0]))
+        for s in ring_stack.addressable_shards
+    )
+    for (start, got_ring), (_, got_dense) in zip(ring_mine, mine):
+        np.testing.assert_allclose(
+            got_ring, got_dense, rtol=3e-5,
+            err_msg=f"ring/dense divergence at rank {start}",
+        )
+
     # Full Solver step over the process-spanning mesh.
     from npairloss_tpu.models import get_model
     from npairloss_tpu.train import Solver, SolverConfig

@@ -85,6 +85,13 @@ def main() -> int:
     if args.split_classes:
         if not (args.out_train and args.out_test):
             ap.error("--split-classes needs --out-train and --out-test")
+        if not 0 < args.split_classes < len(classes):
+            ap.error(
+                f"--split-classes {args.split_classes} out of range: "
+                f"{len(classes)} classes survive --min-images "
+                f"{args.min_images}; a valid split leaves both sides "
+                "non-empty"
+            )
         train, test = [], []
         for label, (_, imgs) in enumerate(classes):
             dest = train if label < args.split_classes else test
