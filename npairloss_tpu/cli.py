@@ -244,6 +244,9 @@ def cmd_extract(args) -> int:
             variables["batch_stats"] = state["batch_stats"]
         return solver.model.apply(variables, x, train=False)
 
+    n_mesh = (len(solver.mesh.devices.flatten())
+              if solver.mesh is not None else 1)
+    embed_sharded = None
     if solver.mesh is not None:
         # Split the batch over the mesh like train/test steps do (their
         # sharding comes from in_shardings on the jitted step, not from
@@ -251,16 +254,20 @@ def cmd_extract(args) -> int:
         # extraction is per-row, so this is pure data parallelism.
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        embed = jax.jit(
+        embed_sharded = jax.jit(
             embed_fn,
             in_shardings=(None, NamedSharding(solver.mesh, P(solver.axis))),
         )
-    else:
-        embed = jax.jit(embed_fn)
+    embed_replicated = jax.jit(embed_fn)
 
     embs, labs = [], []
     for _ in range(args.batches):
         x, lab = next(batches)
+        # Non-divisible batches (e.g. TEST batch 30 on a 4-mesh) fall
+        # back to replicated execution rather than erroring.
+        embed = (embed_sharded
+                 if embed_sharded is not None and len(x) % n_mesh == 0
+                 else embed_replicated)
         if solver.state is None:
             # Init from the actual batch shape (like Solver.step does):
             # the net's TRAIN and TEST layers may crop differently.
