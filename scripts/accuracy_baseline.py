@@ -191,6 +191,20 @@ def main():
              input_shape=(96, 96, 3),
              num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
              noise=0.6)),
+        # ViT trunk (reduced proxy of BASELINE.json cfg 5's ViT-B/16
+        # stretch) with the flagship mining config — every model family
+        # in the zoo demonstrates a learning curve.
+        ("vit_small_flagship",
+         lambda: run_config(
+             "vit_small_flagship", REFERENCE_CONFIG,
+             steps=max(200, s // 2),
+             model_name="vit_b16",
+             model_kw=dict(patch=8, hidden=64, depth=2, num_heads=4,
+                           mlp_dim=128,
+                           dtype=jnp.bfloat16 if args.tpu else jnp.float32),
+             input_shape=(32, 32, 3),
+             num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
+             noise=0.6)),
         # Conv trunk: ResNet-18 (the reduced proxy of BASELINE.json
         # cfg 3's ResNet-50/SOP run) with LOCAL/HARD mining.
         ("resnet18_small",

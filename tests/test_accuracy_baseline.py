@@ -52,3 +52,24 @@ def test_blockwise_engine_short_replay_converges():
         steps=100, use_blockwise=True,
     )
     assert r["final_recall_at_1"] >= 0.9, r
+
+
+def test_vit_trunk_short_replay_converges():
+    """The ViT trunk (reduced ViT-B/16 proxy) learns through the
+    flagship mining config — the transformer family's counterpart of
+    the conv-trunk rows in ACCURACY.md."""
+    import jax.numpy as jnp
+
+    from npairloss_tpu import REFERENCE_CONFIG
+
+    mod = _load_script()
+    r = mod.run_config(
+        "vit_replay", REFERENCE_CONFIG,
+        model_name="vit_b16",
+        model_kw=dict(patch=8, hidden=64, depth=2, num_heads=4,
+                      mlp_dim=128, dtype=jnp.float32),
+        input_shape=(32, 32, 3), num_ids=16, ids_per_batch=16, lr=0.05,
+        steps=120, record_every=10,
+    )
+    assert r["final_recall_at_1"] >= 0.9, r
+    assert r["curve"][-1]["loss"] < r["curve"][0]["loss"], r["curve"]
