@@ -541,6 +541,8 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--probe-timeout", type=float, default=240.0)
+    ap.add_argument("--probe-retries", type=int, default=2)
+    ap.add_argument("--probe-retry-wait", type=float, default=180.0)
     ap.add_argument("--full-timeout", type=float, default=900.0)
     ap.add_argument("--smoke-timeout", type=float, default=300.0)
     # child modes (internal)
@@ -558,8 +560,20 @@ def main() -> int:
     os.makedirs(CACHE_DIR, exist_ok=True)
 
     # Phase 1: which backend comes up?  A hung TPU plugin init (observed:
-    # axon backend UNAVAILABLE, BENCH_r01) must not kill the bench.
-    probe = _run_child(["--child", "probe"], args.probe_timeout)
+    # axon backend UNAVAILABLE, BENCH_r01; multi-hour relay outage,
+    # round 3) must not kill the bench — but a transient outage deserves
+    # a few retries before surrendering the round's numbers to CPU.
+    probe = None
+    for attempt in range(args.probe_retries + 1):
+        if attempt:
+            _log(
+                f"default backend probe failed (attempt {attempt}); "
+                f"retrying in {args.probe_retry_wait:.0f}s"
+            )
+            time.sleep(args.probe_retry_wait)
+        probe = _run_child(["--child", "probe"], args.probe_timeout)
+        if probe is not None:
+            break
     platform = "default"
     if probe is None:
         _log("default backend failed to initialize; falling back to CPU")
