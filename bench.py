@@ -510,8 +510,13 @@ def child_smoke(platform: str) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _run_child(child_args, timeout: float):
-    """Run a child bench subprocess; return its stdout JSON dict or None."""
+def _run_child_ex(child_args, timeout: float):
+    """Run a child bench subprocess.
+
+    Returns (json_dict_or_None, reason) with reason in
+    {"ok", "timeout", "rc", "nojson"} — callers that retry should only
+    do so for "timeout" (an outage-shaped failure); rc/nojson failures
+    are deterministic and re-running just delays the fallback."""
     cmd = [sys.executable, os.path.abspath(__file__)] + child_args
     _log(f"spawn {' '.join(child_args)} (timeout {timeout:.0f}s)")
     try:
@@ -520,19 +525,24 @@ def _run_child(child_args, timeout: float):
         )
     except subprocess.TimeoutExpired:
         _log(f"child {child_args} timed out after {timeout:.0f}s")
-        return None
+        return None, "timeout"
     if proc.returncode != 0:
         _log(f"child {child_args} exited rc={proc.returncode}")
-        return None
+        return None, "rc"
     for line in reversed(proc.stdout.decode().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return json.loads(line), "ok"
             except json.JSONDecodeError:
                 continue
     _log(f"child {child_args} produced no JSON")
-    return None
+    return None, "nojson"
+
+
+def _run_child(child_args, timeout: float):
+    """Run a child bench subprocess; return its stdout JSON dict or None."""
+    return _run_child_ex(child_args, timeout)[0]
 
 
 def main() -> int:
@@ -567,12 +577,15 @@ def main() -> int:
     for attempt in range(args.probe_retries + 1):
         if attempt:
             _log(
-                f"default backend probe failed (attempt {attempt}); "
+                f"default backend probe timed out (attempt {attempt}); "
                 f"retrying in {args.probe_retry_wait:.0f}s"
             )
             time.sleep(args.probe_retry_wait)
-        probe = _run_child(["--child", "probe"], args.probe_timeout)
-        if probe is not None:
+        probe, reason = _run_child_ex(["--child", "probe"], args.probe_timeout)
+        if probe is not None or reason != "timeout":
+            # Only timeout-shaped failures look like a transient tunnel
+            # outage; rc/nojson failures are deterministic — retrying
+            # them just delays the CPU fallback.
             break
     platform = "default"
     if probe is None:
