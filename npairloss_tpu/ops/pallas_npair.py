@@ -166,21 +166,23 @@ def _selection(sims, same, diff, pt, nt, cfg: NPairLossConfig):
 # ---------------------------------------------------------------------------
 
 
-def _digit_hist_rows(sims, mask, digit: int, prefix=None):
-    """(RADIX_BINS, bn) histogram of one radix digit over a masked tile —
-    kernel-side compare-and-reduce (no scatter): one lane-reduction per
-    bin, each landing as a (1, bn) row.  ``prefix`` (optional, (bn, 1)
-    uint32) restricts to entries whose higher digits match."""
+def _accum_digit_hist(out_ref, sims, mask, digit: int, prefix=None):
+    """Accumulate the (RADIX_BINS, bn) histogram of one radix digit over
+    a masked tile into ``out_ref`` — kernel-side compare-and-reduce (no
+    scatter): one lane-reduction per bin, each written to its own
+    static output row (row-wise ref updates keep the Mosaic op surface
+    to the same relayouts the stats kernel already uses).  ``prefix``
+    (optional, (bn, 1) uint32) restricts to entries whose higher digits
+    match."""
     key = sortable_key(sims)
     m = mask
     if prefix is not None:
         m = m & prefix_matches(key, prefix, digit)
     d = jnp.where(m, digit_of(key, digit), RADIX_BINS)
-    rows = [
-        (d == b).sum(axis=1, keepdims=True).astype(jnp.int32).T
-        for b in range(RADIX_BINS)
-    ]
-    return jnp.concatenate(rows, axis=0)
+    for b in range(RADIX_BINS):
+        out_ref[b:b + 1, :] += (
+            (d == b).sum(axis=1, keepdims=True).astype(jnp.int32).T
+        )
 
 
 def _make_stats_kernel(hist_same: bool, hist_diff: bool):
@@ -233,9 +235,9 @@ def _make_stats_kernel(hist_same: bool, hist_diff: bool):
         cnt_s_ref[:] += same.sum(axis=1, keepdims=True).astype(jnp.int32).T
         cnt_d_ref[:] += diff.sum(axis=1, keepdims=True).astype(jnp.int32).T
         if h_s_ref is not None:
-            h_s_ref[:] += _digit_hist_rows(sims, same, 0)
+            _accum_digit_hist(h_s_ref, sims, same, 0)
         if h_d_ref is not None:
-            h_d_ref[:] += _digit_hist_rows(sims, diff, 0)
+            _accum_digit_hist(h_d_ref, sims, diff, 0)
 
     return kernel
 
@@ -269,7 +271,7 @@ def _make_hist_kernel(sides, digit: int):
         )
         for use_same, p_ref, o_ref in zip(sides, prefix_refs, out_refs):
             mask = same if use_same else diff
-            o_ref[:] += _digit_hist_rows(sims, mask, digit, p_ref[:].T)
+            _accum_digit_hist(o_ref, sims, mask, digit, p_ref[:].T)
 
     return kernel
 

@@ -110,8 +110,16 @@ REL_CONFIGS = [
 ]
 
 
-@pytest.mark.parametrize("cfg_idx", range(len(REL_CONFIGS)))
-@pytest.mark.parametrize("block", [4, 5])
+# Every config runs at block 5 (a non-divisor of N=18 — exercises the
+# padding path); the exact-tiling shape (block 6 divides N=18 — no
+# padded rows anywhere) is pinned once rather than per-config:
+# interpret-mode Pallas executes each grid cell in Python, so the full
+# cfg x block product costs minutes for no added coverage (the block
+# size only affects tiling, not mining semantics).
+@pytest.mark.parametrize(
+    "cfg_idx,block",
+    [(i, 5) for i in range(len(REL_CONFIGS))] + [(0, 6)],
+)
 def test_blockwise_relative_matches_dense(rng, cfg_idx, block):
     """RELATIVE_* thresholds via streamed radix selection must equal the
     dense path's host-sort semantics exactly — loss, aux and grads."""
