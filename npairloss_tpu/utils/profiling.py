@@ -28,7 +28,12 @@ annotate = jax.named_scope
 def trace(logdir: str, create_perfetto_trace: bool = False):
     """Capture a device+host profile under ``logdir`` (XProf/TensorBoard
     format; optionally a Perfetto trace too).  Wrap a handful of
-    training steps, not the whole run."""
+    training steps, not the whole run.
+
+    WARNING: do NOT use on tunneled/remote-plugin backends (e.g. a
+    relay-attached TPU): the trace RPC can wedge the tunnel for hours.
+    Use differential ablation timing there instead
+    (``scripts/profile_flagship.py``)."""
     jax.profiler.start_trace(
         logdir, create_perfetto_trace=create_perfetto_trace
     )
