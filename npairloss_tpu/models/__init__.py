@@ -22,6 +22,13 @@ _REGISTRY: Dict[str, Callable[..., Any]] = {
     # conv, no LRN) — use for training runs without pretrained weights.
     "googlenet_bn": lambda **kw: GoogLeNetEmbedding(use_bn=True, **kw),
     "inception_bn": lambda **kw: GoogLeNetEmbedding(use_bn=True, **kw),
+    # Space-to-depth stem: algebraically identical trunk with the 7x7/s2
+    # C_in=3 stem rewritten for MXU tiling (see googlenet.stem_s2d);
+    # weights interchange with the plain trunk via conv1_kernel_to_s2d.
+    "googlenet_s2d": lambda **kw: GoogLeNetEmbedding(stem_s2d=True, **kw),
+    "googlenet_bn_s2d": lambda **kw: GoogLeNetEmbedding(
+        use_bn=True, stem_s2d=True, **kw
+    ),
     "resnet50": lambda **kw: ResNetEmbedding(stage_sizes=(3, 4, 6, 3), **kw),
     "resnet18": lambda **kw: ResNetEmbedding(stage_sizes=(2, 2, 2, 2), width=64, **kw),
     "vit_b16": ViTEmbedding,

@@ -70,6 +70,24 @@ class ConvBlock(nn.Module):
         return nn.relu(x)
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """NHWC space-to-depth: (N,H,W,C) -> (N,H/b,W/b,b*b*C).
+
+    Pixel (bh+dh, bw+dw, c) lands in output channel (dh*b+dw)*C + c —
+    the layout `conv1_kernel_to_s2d` (googlenet.py) assumes.
+    """
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"space_to_depth needs H, W divisible by {block}, got {h}x{w} "
+            "(the s2d stem requires even input dims; use the plain trunk "
+            "for odd crops)"
+        )
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
 def max_pool(x, window=3, stride=2, padding="SAME"):
     return nn.max_pool(x, (window, window), strides=(stride, stride), padding=padding)
 

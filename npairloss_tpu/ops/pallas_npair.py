@@ -39,6 +39,20 @@ RADIX_BITS-bit digit of the monotone sortable float key via
 scatter-free compare-and-reduce, narrow the target rank to a single
 bit pattern without ever materializing the population.
 
+**Similarity cache**: every sweep above recomputes its sim tiles with a
+fp32-HIGHEST MXU matmul (6 bf16 passes) plus a full stream of the feats
+and pool tiles.  When the fp32 tile matrix fits HBM (``sim_cache``,
+auto-enabled below ``SIM_CACHE_AUTO_BYTES``), the stats sweep writes
+each tile out once and every later sweep — radix digits, loss, both
+backward gemms — streams the cached tiles back instead, turning the
+selection/loss sweeps from matmul-bound into purely bandwidth-bound
+(at a 32k pool: ~4.3 GB read per sweep instead of a ~1.1e12-FLOP
+fp32-HIGHEST matmul plus ~8.6 GB of operand re-streaming).  Cached and
+recompute paths are bit-identical — the cache stores exactly the fp32
+values ``_sim_tile`` produces.  Beyond the threshold the engine keeps
+the original O(N x block) recompute behavior, which is the mode the
+"too big to materialize" docstring above describes.
+
 On non-TPU backends the kernels run in Pallas interpreter mode, which is
 how the CPU test suite checks bit-parity against the dense path.
 """
@@ -77,6 +91,11 @@ from npairloss_tpu.ops.rank_select import (
 )
 
 _RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
+
+# Auto-enable the fp32 similarity cache when the padded N x M matrix is
+# at most this many bytes (6 GiB covers the 32k stretch pool at 4.3 GB
+# on a 16 GB-HBM v5e while leaving room for feats/grads/workspaces).
+SIM_CACHE_AUTO_BYTES = 6 << 30
 
 
 def blockwise_supported(cfg: NPairLossConfig) -> bool:
@@ -151,6 +170,39 @@ def _sim_tile(feats_ref, pool_ref):
     )
 
 
+def _sim_kernel(body, extra: Optional[str] = None):
+    """Build the cached/uncached kernel signatures around a sim-consuming
+    ``body(scal_ref, labels_ref, pool_labels_ref, sims, extra_ref, rest)``.
+
+    The uncached kernel streams feats+pool and recomputes the sim tile on
+    the MXU; the cached kernel streams the sim tile itself plus — when
+    ``extra`` is "feats"/"pool" — the one dense operand the body still
+    multiplies against (the backward gemms).  Returns ``make(cached)``.
+    """
+
+    def make(cached: bool):
+        if cached and extra is None:
+            def kernel(scal_ref, labels_ref, pool_labels_ref, sims_ref,
+                       *rest):
+                body(scal_ref, labels_ref, pool_labels_ref, sims_ref[:],
+                     None, rest)
+        elif cached:
+            def kernel(scal_ref, labels_ref, pool_labels_ref, sims_ref,
+                       extra_ref, *rest):
+                body(scal_ref, labels_ref, pool_labels_ref, sims_ref[:],
+                     extra_ref, rest)
+        else:
+            def kernel(scal_ref, feats_ref, labels_ref, pool_ref,
+                       pool_labels_ref, *rest):
+                extra_ref = {"feats": feats_ref, "pool": pool_ref,
+                             None: None}[extra]
+                body(scal_ref, labels_ref, pool_labels_ref,
+                     _sim_tile(feats_ref, pool_ref), extra_ref, rest)
+        return kernel
+
+    return make
+
+
 def _selection(sims, same, diff, pt, nt, cfg: NPairLossConfig):
     """Tile selection via the shared quirk-exact predicates of cu:80-119
     (ops.npair_loss.selection_predicates); cfg is static, so the
@@ -185,10 +237,13 @@ def _accum_digit_hist(out_ref, sims, mask, digit: int, prefix=None):
         )
 
 
-def _make_stats_kernel(hist_same: bool, hist_diff: bool):
+def _make_stats_kernel(hist_same: bool, hist_diff: bool,
+                       emit_sims: bool = False):
     """Mining-stats kernel; optionally also the digit-0 radix histograms
     for RELATIVE_* sides (digit 0 needs no prefix, so accumulating it in
-    this sweep saves one whole pass per relative side)."""
+    this sweep saves one whole pass per relative side), and optionally
+    the fp32 sim tiles themselves (the similarity cache later sweeps
+    stream instead of recomputing)."""
 
     def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
                *out_refs):
@@ -196,6 +251,7 @@ def _make_stats_kernel(hist_same: bool, hist_diff: bool):
             out_refs[:5], list(out_refs[5:]))
         h_s_ref = rest.pop(0) if hist_same else None
         h_d_ref = rest.pop(0) if hist_diff else None
+        sims_out_ref = rest.pop(0) if emit_sims else None
         # grid = (num_q_blocks, num_pool_blocks)
         qi, ii = pl.program_id(0), pl.program_id(1)
         bn, bm = feats_ref.shape[0], pool_ref.shape[0]
@@ -215,6 +271,8 @@ def _make_stats_kernel(hist_same: bool, hist_diff: bool):
                 h_d_ref[:] = jnp.zeros_like(h_d_ref)
 
         sims = _sim_tile(feats_ref, pool_ref)
+        if sims_out_ref is not None:
+            sims_out_ref[:] = sims
         same, diff = _tile_masks(
             scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm
         )
@@ -242,9 +300,10 @@ def _make_stats_kernel(hist_same: bool, hist_diff: bool):
     return kernel
 
 
-def _make_hist_kernel(sides, digit: int):
+def _make_hist_kernel(sides, digit: int, cached: bool = False):
     """Radix digit-histogram kernel for digits >= 1: one fused sweep
-    recomputes the sim tile on the MXU and accumulates the prefix-matched
+    produces the sim tile — MXU recompute, or a streamed read of the
+    similarity cache when ``cached`` — and accumulates the prefix-matched
     digit histogram for every active RELATIVE side (the streamed
     counterpart of the reference's host std::sort, cu:266-273).
 
@@ -253,19 +312,17 @@ def _make_hist_kernel(sides, digit: int):
     side; outputs: one (RADIX_BINS, bn) int32 histogram per side.
     """
 
-    def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
-               *rest):
+    def body(scal_ref, labels_ref, pool_labels_ref, sims, _extra, rest):
         prefix_refs = rest[:len(sides)]
         out_refs = rest[len(sides):]
         qi, ii = pl.program_id(0), pl.program_id(1)
-        bn, bm = feats_ref.shape[0], pool_ref.shape[0]
+        bn, bm = sims.shape
 
         @pl.when(ii == 0)
         def _():
             for o in out_refs:
                 o[:] = jnp.zeros_like(o)
 
-        sims = _sim_tile(feats_ref, pool_ref)
         same, diff = _tile_masks(
             scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm
         )
@@ -273,17 +330,15 @@ def _make_hist_kernel(sides, digit: int):
             mask = same if use_same else diff
             _accum_digit_hist(o_ref, sims, mask, digit, p_ref[:].T)
 
-    return kernel
+    return _sim_kernel(body)(cached)
 
 
-def _make_loss_kernel(cfg: NPairLossConfig):
-    def kernel(
-        scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
-        pos_thr_ref, neg_thr_ref, max_all_ref,
-        isum_ref, dsum_ref, inum_ref, dnum_ref,
-    ):
+def _make_loss_kernel(cfg: NPairLossConfig, cached: bool = False):
+    def body(scal_ref, labels_ref, pool_labels_ref, sims, _extra, rest):
+        (pos_thr_ref, neg_thr_ref, max_all_ref,
+         isum_ref, dsum_ref, inum_ref, dnum_ref) = rest
         qi, ii = pl.program_id(0), pl.program_id(1)
-        bn, bm = feats_ref.shape[0], pool_ref.shape[0]
+        bn, bm = sims.shape
 
         @pl.when(ii == 0)
         def _():
@@ -292,7 +347,6 @@ def _make_loss_kernel(cfg: NPairLossConfig):
             inum_ref[:] = jnp.zeros_like(inum_ref)
             dnum_ref[:] = jnp.zeros_like(dnum_ref)
 
-        sims = _sim_tile(feats_ref, pool_ref)
         same, diff = _tile_masks(
             scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm
         )
@@ -305,21 +359,23 @@ def _make_loss_kernel(cfg: NPairLossConfig):
         inum_ref[:] += sel_pos.sum(1, keepdims=True).astype(jnp.float32).T
         dnum_ref[:] += sel_neg.sum(1, keepdims=True).astype(jnp.float32).T
 
-    return kernel
+    return _sim_kernel(body)(cached)
 
 
-def _weight_tile(cfg, scal_ref, feats_ref, labels_ref, pool_ref,
-                 pool_labels_ref, pos_thr_ref, neg_thr_ref, max_all_ref,
+def _weight_tile(cfg, scal_ref, labels_ref, pool_labels_ref, sims,
+                 pos_thr_ref, neg_thr_ref, max_all_ref,
                  isum_ref, asum_ref, valid_ref, g_ref, qi, ii):
     """w = (-p1+p2+p3) * valid * g/N for one tile (cu:405-446).
+
+    ``sims`` is the tile's fp32 similarity block — recomputed on the MXU
+    or streamed from the similarity cache by the caller.
 
     valid_ref is all-ones in "reference" grad mode — the reference keeps
     diff-type entries alive for identNum==0 queries (cu:133-146), so p3
     still contributes — and the zero-loss-query mask in "true" mode,
     where autodiff of the guarded log (cu:162-169) yields exactly 0.
     """
-    bn, bm = feats_ref.shape[0], pool_ref.shape[0]
-    sims = _sim_tile(feats_ref, pool_ref)
+    bn, bm = sims.shape
     same, diff = _tile_masks(scal_ref, labels_ref, pool_labels_ref, qi, ii, bn, bm)
     pt = pos_thr_ref[:].T + jnp.float32(cfg.margin_ident)
     nt = neg_thr_ref[:].T + jnp.float32(cfg.margin_diff)
@@ -347,10 +403,10 @@ def _weight_tile(cfg, scal_ref, feats_ref, labels_ref, pool_ref,
     )
 
 
-def _make_gq_kernel(cfg: NPairLossConfig):
-    def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
-               pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
-               valid_ref, g_ref, gq_ref):
+def _make_gq_kernel(cfg: NPairLossConfig, cached: bool = False):
+    def body(scal_ref, labels_ref, pool_labels_ref, sims, pool_ref, rest):
+        (pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
+         valid_ref, g_ref, gq_ref) = rest
         # grid = (num_q_blocks, num_pool_blocks): pool axis accumulates.
         qi, ii = pl.program_id(0), pl.program_id(1)
 
@@ -359,7 +415,7 @@ def _make_gq_kernel(cfg: NPairLossConfig):
             gq_ref[:] = jnp.zeros_like(gq_ref)
 
         w = _weight_tile(
-            cfg, scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+            cfg, scal_ref, labels_ref, pool_labels_ref, sims,
             pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
             valid_ref, g_ref, qi, ii,
         )
@@ -369,13 +425,13 @@ def _make_gq_kernel(cfg: NPairLossConfig):
             precision=jax.lax.Precision.HIGHEST,
         )
 
-    return kernel
+    return _sim_kernel(body, extra="pool")(cached)
 
 
-def _make_gdb_kernel(cfg: NPairLossConfig):
-    def kernel(scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
-               pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
-               valid_ref, g_ref, gdb_ref):
+def _make_gdb_kernel(cfg: NPairLossConfig, cached: bool = False):
+    def body(scal_ref, labels_ref, pool_labels_ref, sims, feats_ref, rest):
+        (pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
+         valid_ref, g_ref, gdb_ref) = rest
         # grid = (num_pool_blocks, num_q_blocks): query axis accumulates.
         ii, qi = pl.program_id(0), pl.program_id(1)
 
@@ -384,7 +440,7 @@ def _make_gdb_kernel(cfg: NPairLossConfig):
             gdb_ref[:] = jnp.zeros_like(gdb_ref)
 
         w = _weight_tile(
-            cfg, scal_ref, feats_ref, labels_ref, pool_ref, pool_labels_ref,
+            cfg, scal_ref, labels_ref, pool_labels_ref, sims,
             pos_thr_ref, neg_thr_ref, max_all_ref, isum_ref, asum_ref,
             valid_ref, g_ref, qi, ii,
         )
@@ -394,7 +450,7 @@ def _make_gdb_kernel(cfg: NPairLossConfig):
             precision=jax.lax.Precision.HIGHEST,
         )
 
-    return kernel
+    return _sim_kernel(body, extra="feats")(cached)
 
 
 # ---------------------------------------------------------------------------
@@ -447,6 +503,29 @@ def _data_specs(bn: int, bm: int, dim: int, q_axis: int):
     ]
 
 
+def _simblock(bn: int, bm: int, q_axis: int):
+    """(bn, bm) tile of the cached N x M similarity matrix, query axis at
+    grid position ``q_axis``."""
+    if q_axis == 0:
+        return pl.BlockSpec(
+            (bn, bm), lambda q, i: (q, i), memory_space=pltpu.VMEM
+        )
+    return pl.BlockSpec(
+        (bn, bm), lambda i, q: (q, i), memory_space=pltpu.VMEM
+    )
+
+
+def _cached_data_specs(bn: int, bm: int, q_axis: int):
+    """Specs for (scalars, labels, pool_labels, sims_cache) — the cached
+    sweeps stream sim tiles instead of feats/pool operands."""
+    return [
+        _smem_spec(),
+        _qvec(bn, q_axis),
+        _pvec(bm, 1 - q_axis),
+        _simblock(bn, bm, q_axis),
+    ]
+
+
 def _hist_block(bn: int):
     """(RADIX_BINS, bn) histogram BlockSpec indexed by the grid's query
     axis (bins on sublanes, queries on lanes)."""
@@ -456,99 +535,138 @@ def _hist_block(bn: int):
 
 
 def _run_stats(feats_p, labels_p, pool_p, pool_labels_p, scal,
-               bn, bm, interpret, hist_same=False, hist_diff=False):
+               bn, bm, interpret, hist_same=False, hist_diff=False,
+               emit_sims=False):
     npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
     npi = pool_p.shape[0] // bm
-    n_p = feats_p.shape[0]
+    n_p, m_p = feats_p.shape[0], pool_p.shape[0]
     n_hists = int(hist_same) + int(hist_diff)
+    out_specs = [_qvec(bn, 0)] * 5 + [_hist_block(bn)] * n_hists
+    out_shape = (
+        [jax.ShapeDtypeStruct((1, n_p), jnp.float32)] * 3
+        + [jax.ShapeDtypeStruct((1, n_p), jnp.int32)] * 2
+        + [jax.ShapeDtypeStruct((RADIX_BINS, n_p), jnp.int32)] * n_hists
+    )
+    if emit_sims:
+        out_specs.append(_simblock(bn, bm, 0))
+        out_shape.append(jax.ShapeDtypeStruct((n_p, m_p), jnp.float32))
     out = pl.pallas_call(
-        _make_stats_kernel(hist_same, hist_diff),
+        _make_stats_kernel(hist_same, hist_diff, emit_sims),
         grid=(npq, npi),
         in_specs=_data_specs(bn, bm, dim, 0),
-        out_specs=[_qvec(bn, 0)] * 5 + [_hist_block(bn)] * n_hists,
-        out_shape=[jax.ShapeDtypeStruct((1, n_p), jnp.float32)] * 3
-        + [jax.ShapeDtypeStruct((1, n_p), jnp.int32)] * 2
-        + [jax.ShapeDtypeStruct((RADIX_BINS, n_p), jnp.int32)] * n_hists,
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p))
     flat = [o[0, :] for o in out[:5]]
-    hists = [o.T for o in out[5:]]  # -> [n_p, RADIX_BINS]
+    sims_cache = out[-1] if emit_sims else None
+    hists = [o.T for o in out[5:5 + n_hists]]  # -> [n_p, RADIX_BINS]
     h_s = hists.pop(0) if hist_same else None
     h_d = hists.pop(0) if hist_diff else None
-    return (*flat, h_s, h_d)
+    return (*flat, h_s, h_d, sims_cache)
 
 
 def _run_hist(feats_p, labels_p, pool_p, pool_labels_p, scal,
-              use_same_flags, prefixes_p, digit, bn, bm, interpret):
+              use_same_flags, prefixes_p, digit, bn, bm, interpret,
+              sims_cache=None):
     """One fused sweep -> per-side [n_p, RADIX_BINS] digit histograms."""
     npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
     npi = pool_p.shape[0] // bm
     n_p = feats_p.shape[0]
     k = len(use_same_flags)
+    cached = sims_cache is not None
+    if cached:
+        in_specs = _cached_data_specs(bn, bm, 0) + [_qvec(bn, 0)] * k
+        args = (scal, _row(labels_p), _row(pool_labels_p), sims_cache,
+                *[_row(p) for p in prefixes_p])
+    else:
+        in_specs = _data_specs(bn, bm, dim, 0) + [_qvec(bn, 0)] * k
+        args = (scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
+                *[_row(p) for p in prefixes_p])
     out = pl.pallas_call(
-        _make_hist_kernel(tuple(use_same_flags), digit),
+        _make_hist_kernel(tuple(use_same_flags), digit, cached),
         grid=(npq, npi),
-        in_specs=_data_specs(bn, bm, dim, 0) + [_qvec(bn, 0)] * k,
+        in_specs=in_specs,
         out_specs=[_hist_block(bn)] * k,
         out_shape=[
             jax.ShapeDtypeStruct((RADIX_BINS, n_p), jnp.int32)
         ] * k,
         interpret=interpret,
-    )(
-        scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
-        *[_row(p) for p in prefixes_p],
-    )
+    )(*args)
     return [o.T for o in out]
 
 
 def _run_loss(feats_p, labels_p, pool_p, pool_labels_p, scal,
-              pos_thr_p, neg_thr_p, max_all_p, cfg, bn, bm, interpret):
+              pos_thr_p, neg_thr_p, max_all_p, cfg, bn, bm, interpret,
+              sims_cache=None):
     npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
     npi = pool_p.shape[0] // bm
-    specs = _data_specs(bn, bm, dim, 0) + [_qvec(bn, 0)] * 3
+    cached = sims_cache is not None
+    if cached:
+        specs = _cached_data_specs(bn, bm, 0) + [_qvec(bn, 0)] * 3
+        args = (scal, _row(labels_p), _row(pool_labels_p), sims_cache,
+                _row(pos_thr_p), _row(neg_thr_p), _row(max_all_p))
+    else:
+        specs = _data_specs(bn, bm, dim, 0) + [_qvec(bn, 0)] * 3
+        args = (scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
+                _row(pos_thr_p), _row(neg_thr_p), _row(max_all_p))
     out = pl.pallas_call(
-        _make_loss_kernel(cfg),
+        _make_loss_kernel(cfg, cached),
         grid=(npq, npi),
         in_specs=specs,
         out_specs=[_qvec(bn, 0)] * 4,
         out_shape=[jax.ShapeDtypeStruct((1, feats_p.shape[0]), jnp.float32)] * 4,
         interpret=interpret,
-    )(
-        scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
-        _row(pos_thr_p), _row(neg_thr_p), _row(max_all_p),
-    )
+    )(*args)
     return tuple(o[0, :] for o in out)
 
 
 def _run_bwd(feats_p, labels_p, pool_p, pool_labels_p, scal,
              pos_thr_p, neg_thr_p, max_all_p, ident_sum_p, all_sum_p,
-             valid_p, g, cfg, bn, bm, interpret):
+             valid_p, g, cfg, bn, bm, interpret, sims_cache=None):
     npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
     npi = pool_p.shape[0] // bm
     g_arr = jnp.asarray(g, jnp.float32).reshape(1)
-    args = (
-        scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p),
+    cached = sims_cache is not None
+    qvecs = (
         _row(pos_thr_p), _row(neg_thr_p), _row(max_all_p),
         _row(ident_sum_p), _row(all_sum_p), _row(valid_p), g_arr,
     )
+    if cached:
+        # gq still streams pool tiles (for w @ pool); gdb streams feats
+        # (for w^T @ feats) — but neither recomputes the sim matmul.
+        gq_args = (scal, _row(labels_p), _row(pool_labels_p), sims_cache,
+                   pool_p) + qvecs
+        gq_specs = (_cached_data_specs(bn, bm, 0) + [_pblock((bm, dim), 1)]
+                    + [_qvec(bn, 0)] * 6 + [_smem_spec()])
+        gdb_args = (scal, _row(labels_p), _row(pool_labels_p), sims_cache,
+                    feats_p) + qvecs
+        gdb_specs = (_cached_data_specs(bn, bm, 1) + [_qblock((bn, dim), 1)]
+                     + [_qvec(bn, 1)] * 6 + [_smem_spec()])
+    else:
+        gq_args = (scal, feats_p, _row(labels_p), pool_p,
+                   _row(pool_labels_p)) + qvecs
+        gq_specs = (_data_specs(bn, bm, dim, 0)
+                    + [_qvec(bn, 0)] * 6 + [_smem_spec()])
+        gdb_args = gq_args
+        gdb_specs = (_data_specs(bn, bm, dim, 1)
+                     + [_qvec(bn, 1)] * 6 + [_smem_spec()])
     gq = pl.pallas_call(
-        _make_gq_kernel(cfg),
+        _make_gq_kernel(cfg, cached),
         grid=(npq, npi),
-        in_specs=_data_specs(bn, bm, dim, 0)
-        + [_qvec(bn, 0)] * 6 + [_smem_spec()],
+        in_specs=gq_specs,
         out_specs=_qblock((bn, dim), 0),
         out_shape=jax.ShapeDtypeStruct((feats_p.shape[0], dim), jnp.float32),
         interpret=interpret,
-    )(*args)
+    )(*gq_args)
     gdb = pl.pallas_call(
-        _make_gdb_kernel(cfg),
+        _make_gdb_kernel(cfg, cached),
         grid=(npi, npq),
-        in_specs=_data_specs(bn, bm, dim, 1)
-        + [_qvec(bn, 1)] * 6 + [_smem_spec()],
+        in_specs=gdb_specs,
         out_specs=_pblock((bm, dim), 0),
         out_shape=jax.ShapeDtypeStruct((pool_p.shape[0], dim), jnp.float32),
         interpret=interpret,
-    )(*args)
+    )(*gdb_args)
     return gq, gdb
 
 
@@ -559,7 +677,7 @@ def _run_bwd(feats_p, labels_p, pool_p, pool_labels_p, scal,
 
 def _thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
                 min_w, max_b, cnt_s, cnt_d, h0_s, h0_d,
-                cfg, bn, bm, interpret, n):
+                cfg, bn, bm, interpret, n, sims_cache=None):
     """(pos_thr, neg_thr) for ANY mining config: absolute methods from the
     streamed min/max stats, RELATIVE_* via exact stepwise radix selection.
 
@@ -616,6 +734,7 @@ def _thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
         hists = _run_hist(
             feats_p, labels_p, pool_p, pool_labels_p, scal,
             use_same_flags, prefixes_p, digit, bn, bm, interpret,
+            sims_cache=sims_cache,
         )
         for s, h in zip(names, hists):
             states[s] = radix_update(states[s], prep_hist(s, h))
@@ -632,13 +751,15 @@ def _thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _blockwise_core(features, labels, cfg, bn, bm, interpret):
-    out, _ = _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _blockwise_core(features, labels, cfg, bn, bm, interpret, cache):
+    out, _ = _blockwise_fwd_impl(
+        features, labels, cfg, bn, bm, interpret, cache
+    )
     return out
 
 
-def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret):
+def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret, cache):
     features = features.astype(jnp.float32)
     labels_i = _canon_labels(labels)
     n = features.shape[0]
@@ -648,21 +769,22 @@ def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret):
     pool_labels_p = _pad_rows(labels_i, bm)
     scal = jnp.array([n, 0, n], jnp.int32)  # [m_real, self_offset, n_real]
 
-    min_w, max_b, max_all, cnt_s, cnt_d, h0_s, h0_d = _run_stats(
+    min_w, max_b, max_all, cnt_s, cnt_d, h0_s, h0_d, sims_cache = _run_stats(
         feats_p, labels_qp, pool_p, pool_labels_p, scal, bn, bm, interpret,
         hist_same=cfg.ap_mining_method in _RELATIVE,
         hist_diff=cfg.an_mining_method in _RELATIVE,
+        emit_sims=cache,
     )
     min_w, max_b, max_all = min_w[:n], max_b[:n], max_all[:n]
     pos_thr, neg_thr = _thresholds(
         feats_p, labels_qp, pool_p, pool_labels_p, scal,
         min_w, max_b, cnt_s[:n], cnt_d[:n], h0_s, h0_d,
-        cfg, bn, bm, interpret, n,
+        cfg, bn, bm, interpret, n, sims_cache=sims_cache,
     )
     out = _run_loss(
         feats_p, labels_qp, pool_p, pool_labels_p, scal,
         _pad_rows(pos_thr, bn), _pad_rows(neg_thr, bn), _pad_rows(max_all, bn),
-        cfg, bn, bm, interpret,
+        cfg, bn, bm, interpret, sims_cache=sims_cache,
     )
     isum, dsum, inum, dnum = (o[:n] for o in out)
     all_sum = isum + dsum
@@ -684,15 +806,20 @@ def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret):
         "max_all": max_all,
         "ident_sum": isum,
         "all_sum": all_sum,
+        # The cached sim tiles ride the residuals so the backward sweeps
+        # read instead of recomputing; None when caching is off.
+        "sims": sims_cache,
     }
     return (loss, aux), residuals
 
 
-def _blockwise_fwd(features, labels, cfg, bn, bm, interpret):
-    return _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret)
+def _blockwise_fwd(features, labels, cfg, bn, bm, interpret, cache):
+    return _blockwise_fwd_impl(
+        features, labels, cfg, bn, bm, interpret, cache
+    )
 
 
-def _blockwise_bwd(cfg, bn, bm, interpret, res, cotangents):
+def _blockwise_bwd(cfg, bn, bm, interpret, cache, res, cotangents):
     g, _ = cotangents  # aux outputs are monitors
     features = res["features"]
     labels = res["labels"]
@@ -711,7 +838,7 @@ def _blockwise_bwd(cfg, bn, bm, interpret, res, cotangents):
         _pad_rows(res["pos_thr"], bn), _pad_rows(res["neg_thr"], bn),
         _pad_rows(res["max_all"], bn), _pad_rows(res["ident_sum"], bn),
         _pad_rows(res["all_sum"], bn), _pad_rows(valid, bn),
-        g, cfg, bn, bm, interpret,
+        g, cfg, bn, bm, interpret, sims_cache=res["sims"],
     )
     gq, gdb = gq[:n], gdb[:n]
     if cfg.grad_mode == "reference":
@@ -737,6 +864,7 @@ def blockwise_npair_loss_with_aux(
     block_size: int = 512,
     q_block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
+    sim_cache: Optional[bool] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """N-pair loss over a self-pool too large for the dense N x N matrix.
 
@@ -749,6 +877,14 @@ def blockwise_npair_loss_with_aux(
     streaming-computable monitors (pair counts, thresholds) — the full
     similarity matrices of the dense aux are exactly what this path
     exists to avoid.
+
+    ``sim_cache``: materialize the fp32 sim tiles once (in the stats
+    sweep) and stream them back in every later sweep instead of
+    recomputing the fp32-HIGHEST matmul — bit-identical, much faster,
+    but holds the N x N fp32 matrix in HBM through the step.  Default
+    ``None`` auto-enables it when that matrix is at most
+    ``SIM_CACHE_AUTO_BYTES``; pass ``False`` to force the O(N x block)
+    streaming-memory behavior.
     """
     if interpret is None:
         interpret = _default_interpret()
@@ -761,16 +897,22 @@ def blockwise_npair_loss_with_aux(
         # as both a sublane dim (matrix tiles) and a lane dim ((1, b)
         # stat vectors), so round to 128.  _pad_rows absorbs overshoot.
         bn, bm = _round_up(bn, 128), _round_up(bm, 128)
-    return _blockwise_core(features, labels, cfg, bn, bm, interpret)
+    if sim_cache is None:
+        n_p, m_p = _round_up(n, bn), _round_up(n, bm)
+        sim_cache = n_p * m_p * 4 <= SIM_CACHE_AUTO_BYTES
+    return _blockwise_core(
+        features, labels, cfg, bn, bm, interpret, bool(sim_cache)
+    )
 
 
 def blockwise_npair_loss(features, labels, cfg=NPairLossConfig(),
                          block_size: int = 512,
                          q_block_size: Optional[int] = None,
-                         interpret: Optional[bool] = None) -> jax.Array:
+                         interpret: Optional[bool] = None,
+                         sim_cache: Optional[bool] = None) -> jax.Array:
     """Scalar blockwise N-pair loss (see ``blockwise_npair_loss_with_aux``)."""
     return blockwise_npair_loss_with_aux(
-        features, labels, cfg, block_size, q_block_size, interpret
+        features, labels, cfg, block_size, q_block_size, interpret, sim_cache
     )[0]
 
 

@@ -109,6 +109,30 @@ def test_mlp_embedding_unnormalized_option():
     assert not np.allclose(np.linalg.norm(out, axis=1), 1.0)
 
 
+def test_googlenet_s2d_stem_exact_equivalence():
+    """The space-to-depth stem (models/googlenet.py stem_s2d) is an
+    algebraic rewrite of conv1, not an approximation: converting the
+    7x7/s2 kernel with conv1_kernel_to_s2d and running the s2d trunk
+    must reproduce the plain trunk's embeddings to float rounding."""
+    from npairloss_tpu.models.googlenet import conv1_kernel_to_s2d
+
+    m_std = get_model("googlenet", dtype=jnp.float32)
+    m_s2d = get_model("googlenet_s2d", dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((3, 64, 64, 3)).astype(np.float32))
+
+    v_std = m_std.init(jax.random.PRNGKey(0), x[:1], train=False)
+    params = jax.tree_util.tree_map(lambda a: a, v_std["params"])
+    k7 = np.asarray(params["conv1"]["Conv_0"]["kernel"])
+    params["conv1"]["Conv_0"]["kernel"] = jnp.asarray(conv1_kernel_to_s2d(k7))
+    # every 7x7 tap lands somewhere (only the p=7 slots are zero)
+    assert np.count_nonzero(params["conv1"]["Conv_0"]["kernel"]) >= np.count_nonzero(k7)
+
+    out_std = np.asarray(m_std.apply(v_std, x, train=False))
+    out_s2d = np.asarray(m_s2d.apply({"params": params}, x, train=False))
+    np.testing.assert_allclose(out_s2d, out_std, rtol=1e-4, atol=1e-5)
+
+
 def test_googlenet_bn_trains_from_scratch_spread():
     """Inception-BN variant: BatchNorm after every conv keeps the
     embedding batch SPREAD at random init (the BN-free v1 trunk collapses

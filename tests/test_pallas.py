@@ -149,6 +149,35 @@ def test_blockwise_relative_matches_dense(rng, cfg_idx, block):
     np.testing.assert_allclose(gb, gd, rtol=1e-5, atol=1e-7)
 
 
+def test_blockwise_sim_cache_bit_identical(rng):
+    """The similarity cache (ops.pallas_npair sim_cache) stores exactly
+    the fp32 values the recompute path produces, so cached and uncached
+    runs must agree BIT-FOR-BIT — loss, aux monitors and gradients — on
+    the flagship relative config (which exercises stats, radix-digit,
+    loss and both backward sweeps).  Auto mode enables the cache at test
+    shapes, so this test is also what keeps the recompute path covered."""
+    (f,), (l,) = make_identity_batch(rng, num_ids=6, imgs_per_id=3, dim=16)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+
+    outs = {}
+    for cache in (True, False):
+        def fn(x, cache=cache):
+            return blockwise_npair_loss_with_aux(
+                x, l, REFERENCE_CONFIG, block_size=5, sim_cache=cache
+            )
+        (loss, aux), grad = jax.value_and_grad(fn, has_aux=True)(f)
+        outs[cache] = (np.asarray(loss), aux, np.asarray(grad))
+
+    loss_on, aux_on, grad_on = outs[True]
+    loss_off, aux_off, grad_off = outs[False]
+    assert loss_on == loss_off
+    assert np.array_equal(grad_on, grad_off)
+    for k in aux_on:
+        assert np.array_equal(
+            np.asarray(aux_on[k]), np.asarray(aux_off[k])
+        ), k
+
+
 def test_blockwise_global_relative_int32_overflow_guard():
     """GLOBAL RELATIVE rank targets sum pair counts over the whole block:
     beyond 2^31 pairs int32 wraps and would silently mis-rank (caught in
