@@ -52,7 +52,10 @@ def main() -> int:
 
     from npairloss_tpu import REFERENCE_CONFIG, NPairLossConfig
     from npairloss_tpu.ops.npair_loss import MiningMethod, npair_loss
-    from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss
+    from npairloss_tpu.ops.pallas_npair import (
+        SIM_CACHE_AUTO_BYTES,
+        blockwise_npair_loss,
+    )
 
     dev = jax.devices()[0]
     print(f"[tpu-check] backend={dev.platform} kind={dev.device_kind}",
@@ -153,6 +156,8 @@ def main() -> int:
             "loss": float(np.asarray(l0)),
             "ms_per_step": round(dt * 1e3, 2),
             "embeddings_per_sec": round(ns / dt, 1),
+            # auto-resolved similarity cache (pallas_npair.sim_cache)
+            "sim_cache": ns * ns * 4 <= SIM_CACHE_AUTO_BYTES,
         }
         print(f"[tpu-check]   {dt * 1e3:.1f} ms/step, "
               f"{ns / dt:.0f} emb/s", file=sys.stderr, flush=True)
