@@ -84,18 +84,36 @@ class Solver:
         top_ks: Sequence[int] = (1, 5, 10),
         input_shape: Sequence[int] = (224, 224, 3),
         use_ring: bool = False,
+        engine: Optional[str] = None,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
         self.mesh = mesh
         self.axis = axis
-        # Ring-blockwise negative pooling (parallel.ring): streams the
-        # pair matrix instead of gathering it — for pools too large to
-        # materialize.  All mining methods supported (RELATIVE_* via
-        # exact streamed radix selection).
-        self.use_ring = use_ring
-        if use_ring and mesh is None:
-            raise ValueError("use_ring requires a mesh")
+        # Loss engine (see docs/DESIGN.md §2): "dense" materializes the
+        # pair matrix, "ring" streams it over ppermute hops on a mesh,
+        # "blockwise" streams Pallas tiles on a single device (the
+        # engine for self-pools too large for the dense matrix).  All
+        # three support every mining method (RELATIVE_* via exact
+        # streamed radix selection).  ``use_ring`` is the historical
+        # spelling of engine="ring".
+        if engine is None:
+            engine = "ring" if use_ring else "dense"
+        elif use_ring and engine != "ring":
+            raise ValueError(
+                f'use_ring=True contradicts engine={engine!r}'
+            )
+        if engine not in ("dense", "ring", "blockwise"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.engine = engine
+        self.use_ring = engine == "ring"
+        if engine == "ring" and mesh is None:
+            raise ValueError('engine="ring" requires a mesh')
+        if engine == "blockwise" and mesh is not None:
+            raise ValueError(
+                'engine="blockwise" is the single-device streaming path; '
+                'use engine="ring" to stream across a mesh'
+            )
         self.top_ks = tuple(top_ks)
         self.input_shape = tuple(input_shape)
         self.state: Optional[Dict[str, Any]] = None
@@ -162,6 +180,19 @@ class Solver:
     # -- compiled step ----------------------------------------------------
 
     def _loss_and_metrics(self, emb, labels):
+        if self.engine == "blockwise":
+            from npairloss_tpu.ops.pallas_npair import (
+                blockwise_npair_loss_with_aux,
+                blockwise_retrieval_metrics,
+            )
+
+            loss, _ = blockwise_npair_loss_with_aux(
+                emb, labels, self.loss_cfg
+            )
+            metrics = blockwise_retrieval_metrics(
+                jax.lax.stop_gradient(emb), labels, self.top_ks
+            )
+            return loss, metrics
         axis = self.axis if self.mesh is not None else None
         loss, aux = npair_loss_with_aux(emb, labels, self.loss_cfg, axis_name=axis)
         metrics = retrieval_metrics(

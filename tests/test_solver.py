@@ -79,6 +79,48 @@ def test_training_learns_single_device():
     assert float(m["loss"]) < 0.5
 
 
+def test_blockwise_engine_matches_dense_solver_step():
+    """engine="blockwise" routes the Solver's loss through the Pallas
+    streaming engine; the resulting parameter updates must match the
+    dense engine's step for step (the engines are loss/grad-parity
+    pinned, so any drift here is solver wiring, not math)."""
+    cfg = SolverConfig(
+        base_lr=0.5, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
+        display=0, test_interval=0, snapshot=0,
+    )
+    loss_cfg = NPairLossConfig(
+        margin_diff=-0.05,
+        an_mining_method=MiningMethod.HARD,
+        ap_mining_method=MiningMethod.RAND,
+    )
+    batches = synthetic_identity_batches(8, 8, 2, (16,), noise=0.6)
+    solvers = [
+        Solver(get_model("mlp", hidden=(64,), embedding_dim=32), loss_cfg,
+               cfg, input_shape=(16,), engine=eng)
+        for eng in ("dense", "blockwise")
+    ]
+    for i in range(3):
+        x, lab = next(batches)
+        m_d = solvers[0].step(x, lab)
+        m_b = solvers[1].step(x, lab)
+        np.testing.assert_allclose(
+            float(m_b["loss"]), float(m_d["loss"]), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(m_b["retrieve_top1"]), float(m_d["retrieve_top1"]),
+            rtol=1e-6,
+        )
+    deltas = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        solvers[0].state["params"], solvers[1].state["params"],
+    )
+    assert max(jax.tree_util.tree_leaves(deltas)) < 1e-4, deltas
+
+    with pytest.raises(ValueError):
+        Solver(get_model("mlp"), loss_cfg, cfg, engine="blockwise",
+               mesh=data_parallel_mesh())
+
+
 def test_train_loop_with_eval_and_window(caplog):
     solver, batches = _make_solver()
     test_cfg = SolverConfig(
