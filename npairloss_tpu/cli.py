@@ -118,9 +118,17 @@ def _build_solver(args):
 
     mesh = None
     n_dev = len(jax.devices())
+    engine = getattr(args, "engine", None)
     want = args.mesh if args.mesh is not None else (n_dev if n_dev > 1 else 1)
-    if want > 1:
-        mesh = data_parallel_mesh(jax.devices()[:want])
+    if engine == "blockwise" and args.mesh is None:
+        # The Pallas blockwise engine is the single-device streaming
+        # path; don't auto-build a mesh around it.  An EXPLICIT --mesh
+        # still reaches the Solver's blockwise+mesh contradiction error.
+        want = 1
+    if want > 1 or engine == "ring":
+        # Ring streams over a mesh axis; a 1-device mesh is valid (the
+        # bench times it), so honor --engine ring even single-device.
+        mesh = data_parallel_mesh(jax.devices()[:max(want, 1)])
 
     model_name = args.model or _model_for_net(net_cfg)
     import jax.numpy as jnp
@@ -129,7 +137,8 @@ def _build_solver(args):
     model = get_model(model_name, dtype=dtype)
 
     solver = Solver(
-        model, loss_cfg, solver_cfg, mesh=mesh, input_shape=input_shape
+        model, loss_cfg, solver_cfg, mesh=mesh, input_shape=input_shape,
+        engine=engine,
     )
     if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)
@@ -332,6 +341,11 @@ def main(argv: Optional[list] = None) -> int:
     t.add_argument("--model", help="model registry name (default: from net)")
     t.add_argument("--max_iter", type=int, help="override solver max_iter")
     t.add_argument("--mesh", type=int, help="devices in the dp mesh")
+    t.add_argument(
+        "--engine", choices=["dense", "ring", "blockwise"],
+        help="loss engine (default: dense; ring streams the pool over a "
+        "mesh, blockwise streams Pallas tiles on one device)",
+    )
     t.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
     t.add_argument("--resume", help="snapshot path to restore")
     t.add_argument("--snapshot_prefix", help="override snapshot prefix")

@@ -62,6 +62,24 @@ def test_train_synthetic_opt_in_runs():
     assert rc == 0
 
 
+def test_train_blockwise_engine_runs():
+    rc = main([
+        "train", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--max_iter", "2", "--synthetic",
+        "--engine", "blockwise",
+    ])
+    assert rc == 0
+
+
+def test_train_ring_engine_runs_single_device_mesh():
+    rc = main([
+        "train", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--max_iter", "2", "--synthetic",
+        "--engine", "ring", "--mesh", "1",
+    ])
+    assert rc == 0
+
+
 def test_cli_test_command(tmp_path, capsys):
     """`test` = caffe test counterpart: TEST phase metrics from a
     (fresh or restored) model, no training."""
