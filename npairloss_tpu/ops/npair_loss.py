@@ -51,6 +51,12 @@ from npairloss_tpu.ops.rank_select import masked_digit_hist, radix_select
 
 FLT_MAX = float(np.finfo(np.float32).max)
 
+# Auto-enable a streaming engine's fp32 similarity cache when the cached
+# slice is at most this many bytes (6 GiB covers the 32k stretch pool's
+# 4.3 GB single-chip slice on a 16 GB-HBM v5e while leaving room for
+# feats/grads/workspaces).  Shared by ops.pallas_npair and parallel.ring.
+SIM_CACHE_AUTO_BYTES = 6 << 30
+
 
 class MiningRegion(enum.IntEnum):
     """Where a threshold is computed (caffe.proto:8-11)."""

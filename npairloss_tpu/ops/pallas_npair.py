@@ -70,6 +70,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from npairloss_tpu.ops.npair_loss import (
     FLT_MAX,
+    SIM_CACHE_AUTO_BYTES,
     MiningMethod,
     MiningRegion,
     NPairLossConfig,
@@ -91,11 +92,6 @@ from npairloss_tpu.ops.rank_select import (
 )
 
 _RELATIVE = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
-
-# Auto-enable the fp32 similarity cache when the padded N x M matrix is
-# at most this many bytes (6 GiB covers the 32k stretch pool at 4.3 GB
-# on a 16 GB-HBM v5e while leaving room for feats/grads/workspaces).
-SIM_CACHE_AUTO_BYTES = 6 << 30
 
 
 def blockwise_supported(cfg: NPairLossConfig) -> bool:

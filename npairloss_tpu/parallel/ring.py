@@ -36,11 +36,19 @@ masked pair value is recovered EXACTLY by MSD radix selection over
 sortable float bit-keys: NUM_DIGITS ring passes, each histogramming one
 RADIX_BITS-bit digit of the monotone uint32 key via scatter-free
 compare-and-reduce, narrow to the target element's exact bit pattern
-(SURVEY.md §7's "distributed top-k" growth path).  Memory stays
-O(N x N_block); RELATIVE mining costs NUM_DIGITS-1 extra ring passes
-(each G rotations recomputing every N x N_block pair tile) REGARDLESS
-of whether one or both sides are relative — the digit-0 histogram rides
-the stats pass for free, and later digits share one pass across sides.
+(SURVEY.md §7's "distributed top-k" growth path).  RELATIVE mining
+costs NUM_DIGITS-1 extra passes REGARDLESS of whether one or both
+sides are relative — the digit-0 histogram rides the stats pass for
+free, and later digits share one pass across sides.
+
+Memory is O(N x N_block) with ``sim_cache=False``.  By default
+(``sim_cache=None``) the engine keeps this shard's (G, N, N) fp32
+slice of the pair matrix from the stats pass whenever it fits under
+``SIM_CACHE_AUTO_BYTES`` — the later passes then replay the cached
+tiles (the radix/loss passes with NO ppermute and no matmul recompute,
+the backward ring reusing tiles while the gradient still travels), at
+the cost of holding that slice through the step (and through the
+model backward, via the VJP residuals).
 """
 
 from __future__ import annotations
@@ -54,6 +62,7 @@ import numpy as np
 
 from npairloss_tpu.ops.npair_loss import (
     FLT_MAX,
+    SIM_CACHE_AUTO_BYTES,
     MiningMethod,
     MiningRegion,
     NPairLossConfig,
@@ -144,6 +153,22 @@ def _ring_scan(axis_name: str, body, carry, rotating):
     return carry, rotating
 
 
+def _cache_scan(cache, accum, carry, axis_name: str):
+    """Replay the cached hop tiles locally — ``accum(carry, sims,
+    block_labels, block_rank) -> carry`` over the stats pass's hop order.
+    No sim recompute, no ppermute: the pass costs one stream of the
+    cached slice."""
+    def step_fn(c, inp):
+        sims, bl, br = inp
+        return accum(c, sims, bl, br), None
+
+    carry, _ = jax.lax.scan(
+        step_fn, _pvary(carry, axis_name),
+        (cache["sims_cache"], cache["labels_cache"], cache["rank_cache"]),
+    )
+    return carry
+
+
 # ---------------------------------------------------------------------------
 # Pass 1: mining statistics + retrieval top-k
 # ---------------------------------------------------------------------------
@@ -152,11 +177,19 @@ def _ring_scan(axis_name: str, body, carry, rotating):
 def _stats_pass(
     feats, labels, my_rank, axis_name: str, top_k_max: int,
     hist0_same: bool = False, hist0_diff: bool = False,
+    emit_sims: bool = False,
 ):
     """Mining statistics in one ring pass; optionally also the digit-0
     radix histograms for RELATIVE_* sides — digit 0 needs no prefix, so
-    accumulating it here saves one whole ring pass per relative side."""
+    accumulating it here saves one whole ring pass per relative side —
+    and optionally the per-shard similarity cache: the (G, N, N) stack
+    of this shard's sim tiles in hop order, plus each hop's block labels
+    and rank.  The rotation schedule is deterministic (shard r sees
+    block (r - s) mod G at step s), so every later pass can replay the
+    cache instead of recomputing tiles — and the selection/loss passes
+    then need no ppermute at all."""
     n_local = feats.shape[0]
+    g = jax.lax.axis_size(axis_name)
     neg = jnp.float32(-FLT_MAX)
     pos = jnp.float32(FLT_MAX)
     zero_prefix = jnp.zeros((n_local,), jnp.uint32)
@@ -178,6 +211,10 @@ def _stats_pass(
         carry["hist0_same"] = jnp.zeros((n_local, RADIX_BINS), jnp.int32)
     if hist0_diff:
         carry["hist0_diff"] = jnp.zeros((n_local, RADIX_BINS), jnp.int32)
+    if emit_sims:
+        carry["sims_cache"] = jnp.zeros((g, n_local, n_local), jnp.float32)
+        carry["labels_cache"] = jnp.zeros((g,) + labels.shape, labels.dtype)
+        carry["rank_cache"] = jnp.zeros((g,), jnp.int32)
     rotating = {
         "f": feats,
         "l": labels,
@@ -188,6 +225,10 @@ def _stats_pass(
         sims = _tile(feats, rot["f"])
         same, diff = _block_masks(labels, rot["l"], my_rank, rot["rank"], n_local)
         c = dict(c)
+        if emit_sims:
+            c["sims_cache"] = c["sims_cache"].at[step].set(sims)
+            c["labels_cache"] = c["labels_cache"].at[step].set(rot["l"])
+            c["rank_cache"] = c["rank_cache"].at[step].set(rot["rank"])
         c["min_within"] = jnp.minimum(
             c["min_within"], jnp.where(same, sims, pos).min(axis=1)
         )
@@ -227,36 +268,45 @@ def _stats_pass(
 
 
 def _multi_digit_hist_pass(
-    feats, labels, my_rank, axis_name: str, sides, digit: int,
+    feats, labels, my_rank, axis_name: str, sides, digit: int, cache=None,
 ):
-    """One ring rotation accumulating masked digit histograms for EVERY
-    active RELATIVE side at once — the N x N_block sim tile (the
-    expensive part) is computed once and feeds both masks.
+    """One pass accumulating masked digit histograms for EVERY active
+    RELATIVE side at once — the N x N_block sim tile (the expensive
+    part) is computed once and feeds both masks.  With the similarity
+    cache the pass is a LOCAL scan over the cached tiles (no sim
+    recompute, no ppermute); without it, one ring rotation.
 
     ``sides``: dict side-name -> (use_same, prefix).
     Returns dict side-name -> int32 [N, RADIX_BINS].
     """
     n_local = feats.shape[0]
     carry = {s: jnp.zeros((n_local, RADIX_BINS), jnp.int32) for s in sides}
-    rotating = {"f": feats, "l": labels, "rank": my_rank}
 
-    def body(c, rot, step):
-        sims = _tile(feats, rot["f"])
+    def accum(c, sims, blk_labels, blk_rank):
         same, diff = _block_masks(
-            labels, rot["l"], my_rank, rot["rank"], n_local
+            labels, blk_labels, my_rank, blk_rank, n_local
         )
         c = dict(c)
         for s, (use_same, prefix) in sides.items():
             mask = same if use_same else diff
             c[s] = c[s] + masked_digit_hist(sims, mask, prefix, digit)
-        return c, rot
+        return c
+
+    if cache is not None:
+        return _cache_scan(cache, accum, carry, axis_name)
+
+    rotating = {"f": feats, "l": labels, "rank": my_rank}
+
+    def body(c, rot, step):
+        return accum(c, _tile(feats, rot["f"]), rot["l"], rot["rank"]), rot
 
     carry, _ = _ring_scan(axis_name, body, carry, rotating)
     return carry
 
 
 def _ring_thresholds(
-    feats, labels, my_rank, axis_name: str, cfg: NPairLossConfig, stats
+    feats, labels, my_rank, axis_name: str, cfg: NPairLossConfig, stats,
+    cache=None,
 ):
     """(pos_thr, neg_thr) for any mining config: absolute from streamed
     min/max stats, RELATIVE_* via exact stepwise radix selection.
@@ -318,6 +368,7 @@ def _ring_thresholds(
         hists = _multi_digit_hist_pass(
             feats, labels, my_rank, axis_name,
             {s: (sides[s][0], states[s][1]) for s in sides}, digit,
+            cache=cache,
         )
         for s in sides:
             states[s] = radix_update(states[s], prep_hist(s, hists[s]))
@@ -335,7 +386,8 @@ def _ring_thresholds(
 
 
 def _loss_pass(
-    feats, labels, my_rank, pos_thr, neg_thr, max_all, cfg, axis_name: str
+    feats, labels, my_rank, pos_thr, neg_thr, max_all, cfg, axis_name: str,
+    cache=None,
 ):
     n_local = feats.shape[0]
     carry = {
@@ -344,11 +396,9 @@ def _loss_pass(
         "ident_num": jnp.zeros((n_local,), jnp.float32),
         "diff_num": jnp.zeros((n_local,), jnp.float32),
     }
-    rotating = {"f": feats, "l": labels, "rank": my_rank}
 
-    def body(c, rot, step):
-        sims = _tile(feats, rot["f"])
-        same, diff = _block_masks(labels, rot["l"], my_rank, rot["rank"], n_local)
+    def accum(c, sims, blk_labels, blk_rank):
+        same, diff = _block_masks(labels, blk_labels, my_rank, blk_rank, n_local)
         sel = selection_mask(sims, same, diff, pos_thr, neg_thr, cfg)
         sel_pos = same & sel
         sel_neg = diff & sel
@@ -358,7 +408,15 @@ def _loss_pass(
         c["diff_sum"] = c["diff_sum"] + jnp.where(sel_neg, sim_exp, 0.0).sum(1)
         c["ident_num"] = c["ident_num"] + sel_pos.sum(1).astype(jnp.float32)
         c["diff_num"] = c["diff_num"] + sel_neg.sum(1).astype(jnp.float32)
-        return c, rot
+        return c
+
+    if cache is not None:
+        return _cache_scan(cache, accum, carry, axis_name)
+
+    rotating = {"f": feats, "l": labels, "rank": my_rank}
+
+    def body(c, rot, step):
+        return accum(c, _tile(feats, rot["f"]), rot["l"], rot["rank"]), rot
 
     carry, _ = _ring_scan(axis_name, body, carry, rotating)
     return carry
@@ -382,6 +440,7 @@ def _backward_pass(
     axis_name: str,
     g_loss,
     grad_mode: str,
+    cache=None,
 ):
     n_local, dim = feats.shape
     num_shards = jax.lax.axis_size(axis_name)
@@ -426,7 +485,13 @@ def _backward_pass(
     )
 
     def body(c, rot, step):
-        sims = _tile(feats, rot["f"])
+        # The block still has to rotate (its feats feed the two gemms and
+        # the traveling grad rides with it), but the sim tile can replay
+        # from the cache: hop order here matches the stats pass exactly.
+        if cache is not None:
+            sims = cache["sims_cache"][step]
+        else:
+            sims = _tile(feats, rot["f"])
         same, diff = _block_masks(labels, rot["l"], my_rank, rot["rank"], n_local)
         w = weight_tile(sims, same, diff)
         c = dict(c)
@@ -459,13 +524,15 @@ def _backward_pass(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _ring_core(features, labels, cfg, axis_name, top_ks):
-    out, _ = _ring_fwd_impl(features, labels, cfg, axis_name, top_ks)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _ring_core(features, labels, cfg, axis_name, top_ks, sim_cache):
+    out, _ = _ring_fwd_impl(
+        features, labels, cfg, axis_name, top_ks, sim_cache
+    )
     return out
 
 
-def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks):
+def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks, sim_cache):
     features = features.astype(jnp.float32)
     n_local = features.shape[0]
     my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
@@ -475,13 +542,18 @@ def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks):
         features, labels, my_rank, axis_name, top_k_max,
         hist0_same=cfg.ap_mining_method in _RELATIVE,
         hist0_diff=cfg.an_mining_method in _RELATIVE,
+        emit_sims=sim_cache,
     )
+    cache = None
+    if sim_cache:
+        cache = {k: stats[k]
+                 for k in ("sims_cache", "labels_cache", "rank_cache")}
     pos_thr, neg_thr = _ring_thresholds(
-        features, labels, my_rank, axis_name, cfg, stats
+        features, labels, my_rank, axis_name, cfg, stats, cache=cache
     )
     sums = _loss_pass(
         features, labels, my_rank, pos_thr, neg_thr, stats["max_all"],
-        cfg, axis_name,
+        cfg, axis_name, cache=cache,
     )
     ident_sum = sums["ident_sum"]
     all_sum = ident_sum + sums["diff_sum"]
@@ -521,15 +593,20 @@ def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks):
         "max_all": stats["max_all"],
         "ident_sum": ident_sum,
         "all_sum": all_sum,
+        # The cached sim tiles ride the residuals so the backward ring
+        # replays instead of recomputing; None when caching is off.
+        "cache": cache,
     }
     return (loss, metrics), residuals
 
 
-def _ring_fwd(features, labels, cfg, axis_name, top_ks):
-    return _ring_fwd_impl(features, labels, cfg, axis_name, top_ks)
+def _ring_fwd(features, labels, cfg, axis_name, top_ks, sim_cache):
+    return _ring_fwd_impl(
+        features, labels, cfg, axis_name, top_ks, sim_cache
+    )
 
 
-def _ring_bwd(cfg, axis_name, top_ks, res, cotangents):
+def _ring_bwd(cfg, axis_name, top_ks, sim_cache, res, cotangents):
     g_loss, _ = cotangents  # metrics are monitors, non-differentiable
     my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
     d_features = _backward_pass(
@@ -545,6 +622,7 @@ def _ring_bwd(cfg, axis_name, top_ks, res, cotangents):
         axis_name,
         g_loss,
         cfg.grad_mode,
+        cache=res["cache"],
     )
     labels = res["labels"]
     if jnp.issubdtype(labels.dtype, jnp.floating):
@@ -563,16 +641,32 @@ def ring_npair_loss_and_metrics(
     cfg: NPairLossConfig = NPairLossConfig(),
     axis_name: str = "dp",
     top_ks: Sequence[int] = (1, 5, 10),
+    sim_cache: Optional[bool] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Blockwise-ring N-pair loss + retrieval metrics for one shard.
 
     Call under ``shard_map`` over ``axis_name``.  Semantically identical
     to ``npair_loss_with_aux`` + ``retrieval_metrics`` for absolute
-    mining methods, but never materializes the N x (N*G) matrix:
-    memory is O(N x N_block), blocks stream over the ring.
+    mining methods, but the pool is never gathered: blocks stream over
+    the ring, and memory is O(N x N_block) — unless ``sim_cache`` is
+    active (the default when the (G, N, N) slice fits, see below).
 
     Gradient semantics follow ``cfg.grad_mode`` exactly like the dense
     path ("reference": 0.5/0.5 role merge with the 1/G allreduce scale).
+
+    ``sim_cache``: keep this shard's (G, N, N) stack of sim tiles from
+    the stats pass and replay it in the later passes — the radix-digit
+    and loss passes then run locally with no ppermute and no fp32
+    matmul recompute, and the backward ring reuses the tiles.
+    Bit-identical to recompute.  Default ``None`` auto-enables when the
+    slice is at most ``SIM_CACHE_AUTO_BYTES``; ``False`` restores pure
+    O(N x N_block) streaming memory.
     """
     _check_cfg(cfg)
-    return _ring_core(features, labels, cfg, axis_name, tuple(top_ks))
+    if sim_cache is None:
+        g = jax.lax.axis_size(axis_name)
+        n = features.shape[0]
+        sim_cache = g * n * n * 4 <= SIM_CACHE_AUTO_BYTES
+    return _ring_core(
+        features, labels, cfg, axis_name, tuple(top_ks), bool(sim_cache)
+    )

@@ -196,6 +196,52 @@ def test_ring_relative_matches_dense(rng, cfg_idx):
     )
 
 
+def test_ring_sim_cache_bit_identical(rng):
+    """The per-shard similarity cache (parallel.ring sim_cache) replays
+    exactly the tiles the recompute path produces, so cached and
+    uncached runs must agree BIT-FOR-BIT — loss, metrics and gradients —
+    on the flagship relative config across the 8-shard mesh (stats,
+    radix-digit, loss and backward passes all exercised).  Auto mode
+    enables the cache at test shapes, so this also keeps the recompute
+    path covered."""
+    mesh = _mesh()
+    g = len(mesh.devices)
+    f, l = _make_inputs(rng, g, num_ids=6, imgs=3)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+
+    outs = {}
+    for cache in (True, False):
+        def per_shard(f_, l_, cache=cache):
+            loss, m = ring_npair_loss_and_metrics(
+                f_, l_, REFERENCE_CONFIG, AXIS, (1,), sim_cache=cache
+            )
+            return jnp.asarray(loss)[None], jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x)[None], m
+            )
+
+        value = jax.jit(jax.shard_map(
+            per_shard, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS)),
+        ))
+        grad = jax.jit(jax.shard_map(
+            lambda f_, l_, cache=cache: jax.grad(
+                lambda x: ring_npair_loss_and_metrics(
+                    x, l_, REFERENCE_CONFIG, AXIS, (1,), sim_cache=cache
+                )[0]
+            )(f_),
+            mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS),
+        ))
+        loss, m = value(f, l)
+        outs[cache] = (np.asarray(loss), m, np.asarray(grad(f, l)))
+
+    loss_on, m_on, g_on = outs[True]
+    loss_off, m_off, g_off = outs[False]
+    assert np.array_equal(loss_on, loss_off)
+    assert np.array_equal(g_on, g_off)
+    for k in m_on:
+        assert np.array_equal(np.asarray(m_on[k]), np.asarray(m_off[k])), k
+
+
 def test_ring_relative_clamp_quirk(rng):
     """A negative-valued relative threshold clamps to -FLT_MAX (cu:288
     etc.); scaled-down features make every similarity negative-capable."""
