@@ -21,8 +21,16 @@ def _init_and_run(model, x, train=False):
 
 def test_registry_lists_reference_models():
     names = available_models()
-    for required in ("googlenet", "resnet50", "vit_b16", "mlp"):
+    for required in ("googlenet", "googlenet_bn", "googlenet_s2d",
+                     "resnet50", "vit_b16", "mlp"):
         assert required in names
+
+
+def test_space_to_depth_rejects_odd_dims():
+    from npairloss_tpu.models.layers import space_to_depth
+
+    with pytest.raises(ValueError, match="divisible"):
+        space_to_depth(jnp.zeros((1, 227, 227, 3)), 2)
 
 
 def test_googlenet_embedding_shape_and_norm():
