@@ -178,6 +178,26 @@ def test_blockwise_sim_cache_bit_identical(rng):
         ), k
 
 
+@pytest.mark.parametrize("bn,bm", [(4, 7), (7, 4)])
+def test_blockwise_sim_cache_asymmetric_tiles(rng, bn, bm):
+    """Cached sweeps with q_block != block exercise the _simblock index
+    maps on a non-square tile grid (incl. padding on both axes); must
+    still match the dense path on the flagship config."""
+    (f,), (l,) = make_identity_batch(rng, num_ids=6, imgs_per_id=3, dim=16)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+
+    def fn(x):
+        return blockwise_npair_loss_with_aux(
+            x, l, REFERENCE_CONFIG, block_size=bm, q_block_size=bn,
+            sim_cache=True,
+        )[0]
+
+    loss_d, _ = npair_loss_with_aux(f, l, REFERENCE_CONFIG)
+    gd = jax.grad(lambda x: npair_loss_with_aux(x, l, REFERENCE_CONFIG)[0])(f)
+    np.testing.assert_allclose(fn(f), loss_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(jax.grad(fn)(f), gd, rtol=1e-5, atol=1e-7)
+
+
 def test_blockwise_global_relative_int32_overflow_guard():
     """GLOBAL RELATIVE rank targets sum pair counts over the whole block:
     beyond 2^31 pairs int32 wraps and would silently mis-rank (caught in
