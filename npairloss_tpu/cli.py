@@ -368,6 +368,10 @@ def main(argv: Optional[list] = None) -> int:
         sp.add_argument("--net", help="override the solver's net path")
         sp.add_argument("--model", help="model registry name")
         sp.add_argument("--mesh", type=int, help="devices in the dp mesh")
+        sp.add_argument(
+            "--engine", choices=["dense", "ring", "blockwise"],
+            help="loss engine (see train --engine)",
+        )
         sp.add_argument("--bf16", action="store_true")
         sp.add_argument("--resume", help="snapshot path to restore")
         sp.add_argument("--synthetic", action="store_true")

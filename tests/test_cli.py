@@ -80,6 +80,19 @@ def test_train_ring_engine_runs_single_device_mesh():
     assert rc == 0
 
 
+def test_cli_test_command_blockwise_engine(capsys):
+    rc = main([
+        "test", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--synthetic", "--iterations", "1",
+        "--engine", "blockwise",
+    ])
+    assert rc == 0
+    import json
+
+    m = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "retrieve_top1" in m
+
+
 def test_cli_test_command(tmp_path, capsys):
     """`test` = caffe test counterpart: TEST phase metrics from a
     (fresh or restored) model, no training."""
