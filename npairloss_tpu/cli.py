@@ -136,9 +136,11 @@ def _build_solver(args):
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     model = get_model(model_name, dtype=dtype)
 
+    sim_cache = getattr(args, "sim_cache", None)
     solver = Solver(
         model, loss_cfg, solver_cfg, mesh=mesh, input_shape=input_shape,
         engine=engine,
+        sim_cache={"auto": None, "on": True, "off": False}[sim_cache or "auto"],
     )
     if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)
@@ -346,6 +348,11 @@ def main(argv: Optional[list] = None) -> int:
         help="loss engine (default: dense; ring streams the pool over a "
         "mesh, blockwise streams Pallas tiles on one device)",
     )
+    t.add_argument(
+        "--sim-cache", dest="sim_cache", choices=["auto", "on", "off"],
+        default="auto",
+        help="streaming engines' fp32 similarity cache (auto = by size)",
+    )
     t.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
     t.add_argument("--resume", help="snapshot path to restore")
     t.add_argument("--snapshot_prefix", help="override snapshot prefix")
@@ -371,6 +378,10 @@ def main(argv: Optional[list] = None) -> int:
         sp.add_argument(
             "--engine", choices=["dense", "ring", "blockwise"],
             help="loss engine (see train --engine)",
+        )
+        sp.add_argument(
+            "--sim-cache", dest="sim_cache", choices=["auto", "on", "off"],
+            default="auto", help="see train --sim-cache",
         )
         sp.add_argument("--bf16", action="store_true")
         sp.add_argument("--resume", help="snapshot path to restore")

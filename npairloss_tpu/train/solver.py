@@ -85,6 +85,7 @@ class Solver:
         input_shape: Sequence[int] = (224, 224, 3),
         use_ring: bool = False,
         engine: Optional[str] = None,
+        sim_cache: Optional[bool] = None,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
@@ -106,6 +107,10 @@ class Solver:
         if engine not in ("dense", "ring", "blockwise"):
             raise ValueError(f"unknown engine {engine!r}")
         self.engine = engine
+        # Streaming engines' fp32 similarity cache (None = auto by size;
+        # False forces strict streaming memory) — see ops.pallas_npair /
+        # parallel.ring ``sim_cache``.
+        self.sim_cache = sim_cache
         self.use_ring = engine == "ring"
         if engine == "ring" and mesh is None:
             raise ValueError('engine="ring" requires a mesh')
@@ -187,7 +192,7 @@ class Solver:
             )
 
             loss, _ = blockwise_npair_loss_with_aux(
-                emb, labels, self.loss_cfg
+                emb, labels, self.loss_cfg, sim_cache=self.sim_cache
             )
             metrics = blockwise_retrieval_metrics(
                 jax.lax.stop_gradient(emb), labels, self.top_ks
@@ -211,7 +216,8 @@ class Solver:
                 )
 
                 loss, metrics = ring_npair_loss_and_metrics(
-                    e, l, self.loss_cfg, self.axis, self.top_ks
+                    e, l, self.loss_cfg, self.axis, self.top_ks,
+                    sim_cache=self.sim_cache,
                 )
                 metrics = {
                     k: v for k, v in metrics.items()
