@@ -30,6 +30,9 @@ _REGISTRY: Dict[str, Callable[..., Any]] = {
         use_bn=True, stem_s2d=True, **kw
     ),
     "resnet50": lambda **kw: ResNetEmbedding(stage_sizes=(3, 4, 6, 3), **kw),
+    "resnet50_s2d": lambda **kw: ResNetEmbedding(
+        stage_sizes=(3, 4, 6, 3), stem_s2d=True, **kw
+    ),
     "resnet18": lambda **kw: ResNetEmbedding(stage_sizes=(2, 2, 2, 2), width=64, **kw),
     "vit_b16": ViTEmbedding,
     "mlp": MLPEmbedding,

@@ -14,8 +14,6 @@ from typing import Any, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-import numpy as np
-
 from npairloss_tpu.models.layers import (
     ConvBlock,
     global_avg_pool,
@@ -130,30 +128,3 @@ class GoogLeNetEmbedding(nn.Module):
             x = l2_normalize(x)
         return x
 
-
-def conv1_kernel_to_s2d(kernel):
-    """Convert a (7,7,3,64) conv1 kernel to its (4,4,12,64) s2d equivalent.
-
-    With Flax SAME padding the 7x7/s2 stem computes
-    ``o[i] = sum_p W[p] x[2i + p - 2]`` (pad_lo=2).  Writing
-    ``p - 2 = 2u + d`` (d in {0,1}) turns it into a 4x4/s1 conv over the
-    space_to_depth(2) grid with offsets u in {-1..2} — i.e. pad (1,2) —
-    where s2d channel ``(dh*2+dw)*C + c`` holds pixel parity (dh, dw).
-    With kernel index u_k = u+1, source tap p = 2*u_k + d; the one slot
-    with p = 7 (u_k=3, d=1) is zero.  The map is injective, so the
-    conversion is lossless.
-    """
-    kernel = np.asarray(kernel)
-    kh, kw, cin, cout = kernel.shape
-    if (kh, kw) != (7, 7):
-        raise ValueError(f"expected a 7x7 stem kernel, got {kernel.shape}")
-    out = np.zeros((4, 4, 4 * cin, cout), dtype=kernel.dtype)
-    for u in range(4):
-        for v in range(4):
-            for dh in range(2):
-                for dw in range(2):
-                    p, q = 2 * u + dh, 2 * v + dw
-                    if 0 <= p < 7 and 0 <= q < 7:
-                        d = (dh * 2 + dw) * cin
-                        out[u, v, d : d + cin, :] = kernel[p, q, :, :]
-    return out

@@ -7,6 +7,7 @@ from typing import Any, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def local_response_norm(
@@ -74,7 +75,7 @@ def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
     """NHWC space-to-depth: (N,H,W,C) -> (N,H/b,W/b,b*b*C).
 
     Pixel (bh+dh, bw+dw, c) lands in output channel (dh*b+dw)*C + c —
-    the layout `conv1_kernel_to_s2d` (googlenet.py) assumes.
+    the layout `conv1_kernel_to_s2d` (below) assumes.
     """
     n, h, w, c = x.shape
     if h % block or w % block:
@@ -86,6 +87,35 @@ def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
     x = x.reshape(n, h // block, block, w // block, block, c)
     x = x.transpose(0, 1, 3, 2, 4, 5)
     return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def conv1_kernel_to_s2d(kernel):
+    """Convert a (7,7,C,F) stem kernel to its (4,4,4C,F) s2d equivalent.
+
+    With Flax SAME padding a 7x7/s2 stem computes
+    ``o[i] = sum_p W[p] x[2i + p - 2]`` (pad_lo=2).  Writing
+    ``p - 2 = 2u + d`` (d in {0,1}) turns it into a 4x4/s1 conv over the
+    space_to_depth(2) grid with offsets u in {-1..2} — i.e. pad (1,2) —
+    where s2d channel ``(dh*2+dw)*C + c`` holds pixel parity (dh, dw).
+    With kernel index u_k = u+1, source tap p = 2*u_k + d; the one slot
+    with p = 7 (u_k=3, d=1) is zero.  The map is injective, so the
+    conversion is lossless.  Shared by the GoogLeNet and ResNet
+    ``stem_s2d`` variants.
+    """
+    kernel = np.asarray(kernel)
+    kh, kw, cin, cout = kernel.shape
+    if (kh, kw) != (7, 7):
+        raise ValueError(f"expected a 7x7 stem kernel, got {kernel.shape}")
+    out = np.zeros((4, 4, 4 * cin, cout), dtype=kernel.dtype)
+    for u in range(4):
+        for v in range(4):
+            for dh in range(2):
+                for dw in range(2):
+                    p, q = 2 * u + dh, 2 * v + dw
+                    if 0 <= p < 7 and 0 <= q < 7:
+                        d = (dh * 2 + dw) * cin
+                        out[u, v, d : d + cin, :] = kernel[p, q, :, :]
+    return out
 
 
 def max_pool(x, window=3, stride=2, padding="SAME"):

@@ -12,6 +12,7 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from npairloss_tpu.models.layers import space_to_depth
 from npairloss_tpu.ops.normalize import l2_normalize
 
 
@@ -51,14 +52,27 @@ class ResNetEmbedding(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     normalize: bool = True
+    # Space-to-depth stem: exact rewrite of the 7x7/s2 C_in=3 conv as
+    # s2d(2) + 4x4/s1 over 12 channels for MXU tiling — same math as
+    # googlenet.stem_s2d (weights convert via conv1_kernel_to_s2d).
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(
-            self.width, (7, 7), strides=(2, 2), padding="SAME", use_bias=False,
-            dtype=self.dtype, kernel_init=nn.initializers.he_normal(), name="conv_stem",
-        )(x)
+        if self.stem_s2d:
+            x = space_to_depth(x, 2)
+            x = nn.Conv(
+                self.width, (4, 4), strides=(1, 1),
+                padding=((1, 2), (1, 2)), use_bias=False, dtype=self.dtype,
+                kernel_init=nn.initializers.he_normal(), name="conv_stem",
+            )(x)
+        else:
+            x = nn.Conv(
+                self.width, (7, 7), strides=(2, 2), padding="SAME",
+                use_bias=False, dtype=self.dtype,
+                kernel_init=nn.initializers.he_normal(), name="conv_stem",
+            )(x)
         x = nn.relu(
             nn.BatchNorm(
                 use_running_average=not train, momentum=0.9, dtype=self.dtype,

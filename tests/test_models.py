@@ -122,7 +122,7 @@ def test_googlenet_s2d_stem_exact_equivalence():
     algebraic rewrite of conv1, not an approximation: converting the
     7x7/s2 kernel with conv1_kernel_to_s2d and running the s2d trunk
     must reproduce the plain trunk's embeddings to float rounding."""
-    from npairloss_tpu.models.googlenet import conv1_kernel_to_s2d
+    from npairloss_tpu.models.layers import conv1_kernel_to_s2d
 
     m_std = get_model("googlenet", dtype=jnp.float32)
     m_s2d = get_model("googlenet_s2d", dtype=jnp.float32)
@@ -138,6 +138,33 @@ def test_googlenet_s2d_stem_exact_equivalence():
 
     out_std = np.asarray(m_std.apply(v_std, x, train=False))
     out_s2d = np.asarray(m_s2d.apply({"params": params}, x, train=False))
+    np.testing.assert_allclose(out_s2d, out_std, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_s2d_stem_exact_equivalence():
+    """The ResNet stem_s2d variant (registry: resnet50_s2d) is the same
+    algebraic rewrite as the GoogLeNet one: converting the 7x7/s2 stem
+    kernel with conv1_kernel_to_s2d must reproduce the plain trunk's
+    embeddings to float rounding.  (Equivalence runs on resnet18 — same
+    shared stem code — for CPU speed.)"""
+    m50 = get_model("resnet50_s2d", dtype=jnp.float32)
+    assert m50.stem_s2d and m50.stage_sizes == (3, 4, 6, 3)
+    from npairloss_tpu.models.layers import conv1_kernel_to_s2d
+
+    m_std = get_model("resnet18", dtype=jnp.float32)
+    m_s2d = get_model("resnet18", dtype=jnp.float32, stem_s2d=True)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)).astype(np.float32))
+
+    v_std = m_std.init(jax.random.PRNGKey(0), x, train=False)
+    params = jax.tree_util.tree_map(lambda a: a, v_std["params"])
+    k7 = np.asarray(params["conv_stem"]["kernel"])
+    params["conv_stem"]["kernel"] = jnp.asarray(conv1_kernel_to_s2d(k7))
+    variables = {"params": params,
+                 "batch_stats": v_std.get("batch_stats", {})}
+
+    out_std = np.asarray(m_std.apply(v_std, x, train=False))
+    out_s2d = np.asarray(m_s2d.apply(variables, x, train=False))
     np.testing.assert_allclose(out_s2d, out_std, rtol=1e-4, atol=1e-5)
 
 
