@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-3 hardware revalidation queue (written during the 2026-07-30
+# axon-tunnel outage; the sim-cache + s2d work landed with CPU-parity
+# coverage only).  Waits for the tunnel, then:
+#   1. scripts/tpu_pallas_check.py  -> PALLAS_CHECK.json + STRETCH.json
+#      (via scripts/split_pallas_check.py)
+#   2. scripts/profile_flagship.py  -> profile/flagship.{json,md}
+#      (incl. the s2d ablation row)
+#   3. bench.py                     -> engine extras with the sim-cache
+# Run detached:  setsid nohup scripts/tpu_revalidate.sh &
+# Log: /tmp/tpu_queue.log
+cd "$(dirname "$0")/.."
+exec > /tmp/tpu_queue.log 2>&1
+
+echo "=== $(date) waiting for tunnel ==="
+for i in $(seq 1 600); do
+  if timeout 100 python -c "import jax; print(jax.devices())" >/dev/null 2>&1; then
+    echo "tunnel up after probe $i ($(date))"
+    break
+  fi
+  echo "probe $i failed ($(date)); sleeping 300s"
+  sleep 300
+  if [ "$i" = 600 ]; then echo "GAVE UP"; exit 1; fi
+done
+
+echo "=== $(date) 1/3 tpu_pallas_check (parity + 32k stretch, sim-cache) ==="
+timeout 2400 python scripts/tpu_pallas_check.py --pool 4096 --stretch 32768 \
+  > /tmp/tpu_check_out.json
+rc=$?
+echo "tpu_pallas_check rc=$rc"
+tail -c 2000 /tmp/tpu_check_out.json
+if [ "$rc" = 0 ]; then python scripts/split_pallas_check.py; fi
+
+echo "=== $(date) 2/3 profile_flagship (incl. s2d variant) ==="
+timeout 3600 python scripts/profile_flagship.py --steps 10
+echo "profile rc=$?"
+
+echo "=== $(date) 3/3 bench.py full ==="
+timeout 3000 python bench.py > /tmp/bench_out.json
+echo "bench rc=$?"
+tail -c 1000 /tmp/bench_out.json
+
+echo "=== $(date) QUEUE DONE ==="
