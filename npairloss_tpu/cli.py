@@ -25,7 +25,7 @@ log = logging.getLogger("npairloss_tpu.cli")
 
 
 def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
-                synthetic: bool = False):
+                synthetic: bool = False, native: str = "auto"):
     """Batches for a phase: the real MultibatchData pipeline from the
     net's source list file, or synthetic identity-balanced clusters when
     ``--synthetic`` was passed explicitly.
@@ -49,7 +49,11 @@ def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
             )
         from npairloss_tpu.data import multibatch_loader
 
-        return multibatch_loader(d, net_cfg.transformer, seed=seed), d
+        return (
+            multibatch_loader(d, net_cfg.transformer, seed=seed,
+                              native=native),
+            d,
+        )
     from npairloss_tpu.data import synthetic_identity_batches
 
     ids = d.identity_num_per_batch or max(2, (d.batch_size or 8) // 2)
@@ -162,10 +166,12 @@ def cmd_train(args) -> int:
     solver, net_cfg, input_shape = built
 
     train_iter, _ = _build_data(
-        net_cfg, "TRAIN", input_shape, seed=0, synthetic=args.synthetic
+        net_cfg, "TRAIN", input_shape, seed=0, synthetic=args.synthetic,
+        native=args.native,
     )
     test_iter, _ = _build_data(
-        net_cfg, "TEST", input_shape, seed=1, synthetic=args.synthetic
+        net_cfg, "TEST", input_shape, seed=1, synthetic=args.synthetic,
+        native=args.native,
     )
     if train_iter is None:
         log.error(
@@ -206,7 +212,8 @@ def cmd_test(args) -> int:
         return built
     solver, net_cfg, input_shape = built
     test_iter, _ = _build_data(
-        net_cfg, "TEST", input_shape, seed=1, synthetic=args.synthetic
+        net_cfg, "TEST", input_shape, seed=1, synthetic=args.synthetic,
+        native=args.native,
     )
     if test_iter is None:
         log.error("net has no TEST MultibatchData layer")
@@ -240,7 +247,8 @@ def cmd_extract(args) -> int:
     solver, net_cfg, input_shape = built
     phase = args.phase.upper()
     batches, _ = _build_data(
-        net_cfg, phase, input_shape, seed=1, synthetic=args.synthetic
+        net_cfg, phase, input_shape, seed=1, synthetic=args.synthetic,
+        native=args.native,
     )
     if batches is None:
         log.error("net has no %s MultibatchData layer", phase)
@@ -362,6 +370,12 @@ def main(argv: Optional[list] = None) -> int:
         "net's data source (required opt-in; a missing source is an error)",
     )
     t.add_argument(
+        "--native", choices=["auto", "never", "require"], default="auto",
+        help="C++ data runtime routing: auto (by source suffixes), never "
+        "(Python/PIL pipeline), require (error if the native runtime "
+        "cannot serve this source)",
+    )
+    t.add_argument(
         "--coordinator",
         help="multi-process coordinator HOST:PORT (the mpirun counterpart); "
         "omit on TPU pods for autodetect",
@@ -386,6 +400,10 @@ def main(argv: Optional[list] = None) -> int:
         sp.add_argument("--bf16", action="store_true")
         sp.add_argument("--resume", help="snapshot path to restore")
         sp.add_argument("--synthetic", action="store_true")
+        sp.add_argument(
+            "--native", choices=["auto", "never", "require"],
+            default="auto", help="see train --native",
+        )
 
     tt = sub.add_parser(
         "test", help="TEST phase only from a snapshot (caffe test)"

@@ -55,7 +55,43 @@ FLT_MAX = float(np.finfo(np.float32).max)
 # slice is at most this many bytes (6 GiB covers the 32k stretch pool's
 # 4.3 GB single-chip slice on a 16 GB-HBM v5e while leaving room for
 # feats/grads/workspaces).  Shared by ops.pallas_npair and parallel.ring.
+# ``resolve_sim_cache_auto`` additionally caps the budget at 3/8 of the
+# device's reported HBM, so small-memory devices don't auto-OOM.
 SIM_CACHE_AUTO_BYTES = 6 << 30
+
+_SIM_CACHE_LOGGED = set()
+
+
+def resolve_sim_cache_auto(cache_bytes: int, engine: str) -> bool:
+    """Decide whether a streaming engine's fp32 sim cache auto-enables.
+
+    The cache rides the VJP residuals through the whole model backward,
+    so the budget is sized against the device's reported memory (3/8 of
+    ``bytes_limit``, capped at ``SIM_CACHE_AUTO_BYTES``) rather than a
+    blind constant, and every auto-enable is logged ONCE per
+    (engine, size) so an OOM regression is attributable to the cache
+    (ADVICE r3).  Explicit ``sim_cache=True/False`` never reaches here.
+    """
+    budget = SIM_CACHE_AUTO_BYTES
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            budget = min(budget, int(limit * 3) // 8)
+    except Exception:
+        pass  # backends without memory stats keep the constant budget
+    enable = cache_bytes <= budget
+    key = (engine, cache_bytes, enable)
+    if enable and key not in _SIM_CACHE_LOGGED:
+        _SIM_CACHE_LOGGED.add(key)
+        import logging
+
+        logging.getLogger("npairloss_tpu").info(
+            "%s: auto-enabling fp32 similarity cache (%.0f MiB <= budget "
+            "%.0f MiB); pass sim_cache=False if HBM-tight",
+            engine, cache_bytes / 2**20, budget / 2**20,
+        )
+    return enable
 
 
 class MiningRegion(enum.IntEnum):

@@ -71,6 +71,7 @@ from jax.experimental.pallas import tpu as pltpu
 from npairloss_tpu.ops.npair_loss import (
     FLT_MAX,
     SIM_CACHE_AUTO_BYTES,
+    resolve_sim_cache_auto,
     MiningMethod,
     MiningRegion,
     NPairLossConfig,
@@ -895,7 +896,7 @@ def blockwise_npair_loss_with_aux(
         bn, bm = _round_up(bn, 128), _round_up(bm, 128)
     if sim_cache is None:
         n_p, m_p = _round_up(n, bn), _round_up(n, bm)
-        sim_cache = n_p * m_p * 4 <= SIM_CACHE_AUTO_BYTES
+        sim_cache = resolve_sim_cache_auto(n_p * m_p * 4, "blockwise")
     return _blockwise_core(
         features, labels, cfg, bn, bm, interpret, bool(sim_cache)
     )

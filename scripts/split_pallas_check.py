@@ -1,28 +1,51 @@
-"""Split tpu_pallas_check output into PALLAS_CHECK.json + STRETCH.json."""
+"""Split tpu_pallas_check output into PALLAS_CHECK.json + STRETCH.json.
+
+Refuses to stamp hardware artifacts from a CPU/interpret run: the engine
+string and device field are derived from (and asserted against) the
+record itself (ADVICE r3).
+"""
 import json, sys, datetime
 
+ROUND = 4
 src = "/tmp/tpu_check_out.json"
 rec = json.loads(open(src).read().strip().splitlines()[-1])
 date = datetime.date.today().isoformat()
 
+# Hardware gate: only a Mosaic-compiled run on a real TPU device may be
+# recorded as a hardware measurement.
+if not rec.get("mosaic_compiled"):
+    sys.exit(f"refusing to stamp artifacts: mosaic_compiled={rec.get('mosaic_compiled')!r}")
+device = rec.get("device", "")
+if "tpu" not in device.lower():
+    sys.exit(f"refusing to stamp artifacts: device={device!r} is not a TPU")
+
+sim_cached = bool(
+    rec.get("stretch", {}).get("flagship", {}).get("sim_cache"))
+engine = "pallas_blockwise (Mosaic-compiled"
+if sim_cached:
+    engine += ", fp32 sim-cache; _nocache rows stream uncached"
+engine += ")"
+
 pallas = {
-    "round": 3, "date": date, "device": rec["device"], "pool": rec["pool"],
+    "round": ROUND, "date": date, "device": device, "pool": rec["pool"],
     "parity": rec["parity"], "ok": rec["ok"],
     "mosaic_compiled": rec["mosaic_compiled"],
     "command": "python scripts/tpu_pallas_check.py --pool 4096 --stretch 32768",
 }
 stretch = {
-    "round": 3, "date": date, "device": rec["device"], "pool": 32768,
+    "round": ROUND, "date": date, "device": device, "pool": 32768,
     "dim": 512, "block": 512,
-    "engine": "pallas_blockwise (Mosaic-compiled, fp32 sim-cache)",
+    "engine": engine,
+    "sim_cache": sim_cached,
     "note": ("fwd+bwd per step; the similarity cache materializes the 4.3 GB "
              "fp32 sim matrix once in the stats sweep and streams it back in "
              "the radix/loss/backward sweeps (see docs/DESIGN.md). Timed as 3 "
              "perturbed steps inside one jitted lax.scan, host-fetch synced, "
              "dispatch floor subtracted (bench.py timing discipline)."),
     "stretch": rec["stretch"],
-    **({"peak_bytes_in_use": rec["peak_bytes_in_use"]}
-       if "peak_bytes_in_use" in rec else {}),
+    **{k: rec[k] for k in (
+        "peak_bytes_in_use", "peak_bytes_in_use_cached",
+        "peak_bytes_in_use_nocache") if k in rec},
     "command": "python scripts/tpu_pallas_check.py --pool 4096 --stretch 32768",
 }
 open("/root/repo/PALLAS_CHECK.json", "w").write(json.dumps(pallas) + "\n")

@@ -14,8 +14,10 @@ exec > /tmp/tpu_queue.log 2>&1
 
 echo "=== $(date) waiting for tunnel ==="
 for i in $(seq 1 600); do
-  if timeout 100 python -c "import jax; print(jax.devices())" >/dev/null 2>&1; then
-    echo "tunnel up after probe $i ($(date))"
+  # Platform check: the gate must reject a silent CPU fallback — only a
+  # real TPU device counts as "tunnel up" (ADVICE r3).
+  if timeout 100 python -c 'import jax,sys; sys.exit(jax.devices()[0].platform != "tpu")' >/dev/null 2>&1; then
+    echo "tunnel up (platform=tpu) after probe $i ($(date))"
     break
   fi
   echo "probe $i failed ($(date)); sleeping 300s"

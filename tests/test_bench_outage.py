@@ -1,0 +1,88 @@
+"""bench.py outage behavior: a dead tunnel must yield a parseable,
+degraded JSON record (VERDICT r3 weak #1), and the sim-cache auto-gate
+must be budgeted and attributable (ADVICE r3)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_degraded_record_uses_last_good(bench):
+    rec = bench._degraded_record("tunnel outage (test)", {"value": 1.0})
+    # Driver contract: metric/value/unit/vs_baseline always present.
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["degraded"] is True
+    assert rec["platform_status"] == "tunnel outage (test)"
+    assert rec["cpu_smoke"] == {"value": 1.0}
+    # The committed cache exists in-repo, so the headline value is the
+    # last-good hardware payload, flagged stale.
+    assert rec["stale"] is True
+    assert rec["value"] == rec["last_good"]["payload"]["value"] > 0
+    json.dumps(rec)  # must be serializable as the single output line
+
+
+def test_degraded_record_without_cache(bench, monkeypatch):
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", "/nonexistent/x.json")
+    rec = bench._degraded_record("outage", None)
+    assert rec["value"] == 0.0 and rec["stale"] is False
+    assert rec["cpu_smoke"] == {"error": "cpu smoke bench also failed"}
+
+
+def test_last_good_cache_is_committed_and_fresh_enough(bench):
+    with open(bench.LAST_GOOD_PATH) as f:
+        lg = json.load(f)
+    assert lg["payload"]["platform"] == "tpu"
+    assert lg["payload"]["value"] > 0
+    assert "provenance" in lg
+
+
+def test_probe_budget_fails_fast(bench):
+    """Total worst-case probe time before the CPU fallback must stay
+    well inside a driver window (round 3 burned 37 min)."""
+    import re
+
+    src = open(os.path.join(REPO, "bench.py")).read()
+    t = float(re.search(r'"--probe-timeout".*?default=([\d.]+)', src).group(1))
+    r = int(re.search(r'"--probe-retries".*?default=(\d+)', src).group(1))
+    w = float(
+        re.search(r'"--probe-retry-wait".*?default=([\d.]+)', src).group(1)
+    )
+    worst = t * (r + 1) + w * r
+    assert worst <= 330, f"probe budget {worst}s exceeds the 5.5-min cap"
+
+
+def test_sim_cache_auto_is_budgeted_and_logged(caplog):
+    import logging
+
+    from npairloss_tpu.ops.npair_loss import (
+        SIM_CACHE_AUTO_BYTES,
+        _SIM_CACHE_LOGGED,
+        resolve_sim_cache_auto,
+    )
+
+    _SIM_CACHE_LOGGED.clear()
+    with caplog.at_level(logging.INFO, logger="npairloss_tpu"):
+        assert resolve_sim_cache_auto(1 << 20, "testengine") is True
+    assert any("auto-enabling" in r.message for r in caplog.records)
+    # Beyond any budget: never auto-enables.
+    assert resolve_sim_cache_auto(SIM_CACHE_AUTO_BYTES + 1, "t2") is False
+    # Logged once per (engine, size): a second identical call is silent.
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="npairloss_tpu"):
+        resolve_sim_cache_auto(1 << 20, "testengine")
+    assert not caplog.records
