@@ -402,13 +402,13 @@ def _engine_extras(jax, jnp, np, floor):
 
     mesh = data_parallel_mesh(jax.devices()[:1])
 
-    def ring_loss(cfg):
+    def ring_loss(cfg, sim_cache=None):
         # top_ks=() keeps the comparison fair: dense/blockwise are timed
         # as loss+grad only, so the ring must not pay for streamed
         # retrieval-metric top-k maintenance the others skip.
         fn = jax.shard_map(
             lambda f_, l_: ring_npair_loss_and_metrics(
-                f_, l_, cfg, "dp", top_ks=()
+                f_, l_, cfg, "dp", top_ks=(), sim_cache=sim_cache
             )[0][None],
             mesh=mesh,
             in_specs=(P("dp"), P("dp")),
@@ -436,6 +436,15 @@ def _engine_extras(jax, jnp, np, floor):
         lambda f_, l_: blockwise_npair_loss(f_, l_, REFERENCE_CONFIG),
     )
     delta("dense_blockwise_flagship_delta", l_dense_rel, l_block_rel)
+    # The rows above run with sim_cache auto (ON at this pool: 67 MB);
+    # the _nocache rows force the O(N x block) recompute path so the
+    # cache's effect is a recorded delta, not a hypothesis (VERDICT r3).
+    l_block_rel_nc = bench_one(
+        "blockwise_flagship_nocache",
+        lambda f_, l_: blockwise_npair_loss(
+            f_, l_, REFERENCE_CONFIG, sim_cache=False),
+    )
+    delta("blockwise_cache_nocache_delta", l_block_rel, l_block_rel_nc)
     # Ring engine on a 1-device mesh: same pool, same math — isolates the
     # ring machinery's overhead (multi-pass tile recompute + ppermute)
     # against dense at an identical problem size (VERDICT r2 item 7).
@@ -443,20 +452,34 @@ def _engine_extras(jax, jnp, np, floor):
     delta("dense_ring_abs_delta", l_dense, l_ring)
     l_ring_rel = bench_one("ring_flagship", ring_loss(REFERENCE_CONFIG))
     delta("dense_ring_flagship_delta", l_dense_rel, l_ring_rel)
+    l_ring_rel_nc = bench_one(
+        "ring_flagship_nocache",
+        ring_loss(REFERENCE_CONFIG, sim_cache=False),
+    )
+    delta("ring_cache_nocache_delta", l_ring_rel, l_ring_rel_nc)
     return extras
 
 
 def _batch_scaling_extras(jax, jnp, np, dev, floor):
     """Flagship solver throughput at batch 120/240/480 — does a bigger
-    per-chip batch lift emb/s/chip (VERDICT r2 item 4)?"""
+    per-chip batch lift emb/s/chip (VERDICT r2 item 4)?  Plus the
+    space-to-depth stem variant at batch 120: parity-preserving rewrite
+    of the K=147/C_in=3 conv1 (models/layers.conv1_kernel_to_s2d), the
+    claimed ~28%-of-FLOPs MXU-underutilization fix (VERDICT r3 item 4) —
+    recording it here makes the s2d MFU a driver artifact."""
     from npairloss_tpu import REFERENCE_CONFIG
     from npairloss_tpu.models import get_model
     from npairloss_tpu.train import Solver, SolverConfig
 
     rows = {}
-    for batch in (120, 240, 480):
+    for batch, model_name, key in (
+        (120, "googlenet", "120"),
+        (240, "googlenet", "240"),
+        (480, "googlenet", "480"),
+        (120, "googlenet_s2d", "120_s2d"),
+    ):
         solver = Solver(
-            get_model("googlenet", dtype=jnp.bfloat16),
+            get_model(model_name, dtype=jnp.bfloat16),
             REFERENCE_CONFIG,
             SolverConfig(
                 base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
@@ -471,7 +494,7 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor):
         lab = jax.device_put(jnp.asarray(
             np.repeat(np.arange(batch // 2), 2).astype(np.int32)
         ))
-        _log(f"batch scaling: compiling batch {batch}...")
+        _log(f"batch scaling: compiling {key} ({model_name})...")
         steps = 10
         dt = _measure(
             lambda a, b: solver.step(a, b), [x, lab], 1, steps,
@@ -485,13 +508,13 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor):
             if step_flops and peak:
                 mfu = round((step_flops * steps / dt) / peak, 4)
         except Exception as e:
-            _log(f"batch {batch} mfu estimate failed: {e}")
-        rows[str(batch)] = {
+            _log(f"batch {key} mfu estimate failed: {e}")
+        rows[key] = {
             "emb_per_sec": round(batch * steps / dt, 1),
             "ms_per_step": round(dt / steps * 1e3, 2),
             **({"mfu": mfu} if mfu is not None else {}),
         }
-        _log(f"batch scaling: {batch}: {rows[str(batch)]}")
+        _log(f"batch scaling: {key}: {rows[key]}")
     return rows
 
 

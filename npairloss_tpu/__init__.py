@@ -19,6 +19,10 @@ from npairloss_tpu.ops.npair_loss import (
     npair_loss,
     npair_loss_with_aux,
 )
+from npairloss_tpu.ops.eval_retrieval import (
+    evaluate_embeddings,
+    gallery_recall_at_k,
+)
 from npairloss_tpu.ops.metrics import retrieval_metrics
 from npairloss_tpu.ops.normalize import l2_normalize
 from npairloss_tpu.ops.pallas_npair import (
@@ -40,6 +44,8 @@ __all__ = [
     "blockwise_npair_loss_with_aux",
     "blockwise_retrieval_metrics",
     "retrieval_metrics",
+    "gallery_recall_at_k",
+    "evaluate_embeddings",
     "l2_normalize",
     "__version__",
 ]

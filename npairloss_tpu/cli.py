@@ -306,6 +306,42 @@ def cmd_extract(args) -> int:
     return 0
 
 
+def cmd_eval(args) -> int:
+    """Full-gallery retrieval evaluation over extracted embeddings — the
+    protocol papers report for the reference's datasets (every test
+    image queries the whole test set), computed on-device in streamed
+    query blocks.  Consumes the ``extract`` subcommand's .npy pair."""
+    import numpy as np
+
+    from npairloss_tpu.ops.eval_retrieval import evaluate_embeddings
+
+    prefix = args.prefix
+    emb_path = args.emb or prefix + ".emb.npy"
+    lab_path = args.labels or prefix + ".labels.npy"
+    for p in (emb_path, lab_path):
+        if not os.path.exists(p):
+            log.error("missing %s (run the extract subcommand first)", p)
+            return 2
+    emb = np.load(emb_path)
+    lab = np.load(lab_path)
+    if emb.shape[0] != lab.shape[0]:
+        log.error(
+            "embeddings/labels row mismatch: %s vs %s",
+            emb.shape, lab.shape,
+        )
+        return 2
+    m = evaluate_embeddings(
+        emb, lab, ks=tuple(args.ks), query_block=args.query_block
+    )
+    print(json.dumps({
+        "gallery_size": int(emb.shape[0]),
+        "dim": int(emb.shape[1]),
+        "classes": int(np.unique(lab).shape[0]),
+        **{k: round(v, 4) for k, v in m.items()},
+    }))
+    return 0
+
+
 def cmd_parse(args) -> int:
     from npairloss_tpu.config import dumps, parse_file
 
@@ -423,6 +459,28 @@ def main(argv: Optional[list] = None) -> int:
     ex.add_argument("--batches", type=int, default=16)
     ex.add_argument("--out", default="./features")
     ex.set_defaults(fn=cmd_extract)
+
+    ev = sub.add_parser(
+        "eval",
+        help="full-gallery Recall@K over extracted embeddings (.npy)",
+    )
+    ev.add_argument(
+        "--prefix", default="./features",
+        help="extract output prefix (reads PREFIX.emb.npy + "
+        "PREFIX.labels.npy)",
+    )
+    ev.add_argument("--emb", help="explicit embeddings .npy path")
+    ev.add_argument("--labels", help="explicit labels .npy path")
+    ev.add_argument(
+        "--ks", type=int, nargs="+", default=[1, 2, 4, 8, 16, 32],
+        help="Recall@K cutoffs (CUB reports 1 2 4 8; SOP 1 10 100 1000)",
+    )
+    ev.add_argument(
+        "--query-block", type=int, default=1024,
+        help="queries per streamed block (the N x N matrix is never "
+        "materialized)",
+    )
+    ev.set_defaults(fn=cmd_eval)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")
     pp.add_argument("file")

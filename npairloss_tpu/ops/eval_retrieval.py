@@ -1,0 +1,116 @@
+"""Offline full-gallery retrieval evaluation (the deployment protocol).
+
+The reference's in-training ``retrieve_top*`` metrics are within-batch
+(npair_multi_class_loss.cu:173-206) — fine as a training monitor, but
+the numbers metric-learning papers report for the reference's target
+datasets (CUB-200-2011 / Stanford Online Products; Sohn, NIPS 2016) are
+full-gallery: every test image queries the ENTIRE test set.  This module
+is that protocol, computed on-device from extracted embeddings (the
+``python -m npairloss_tpu extract`` output):
+
+    Recall@K = fraction of queries whose K nearest gallery neighbors
+    (cosine similarity, self excluded) contain a same-class item.
+
+Scales past HBM-square limits the same way the loss engines do: queries
+stream in fixed-size blocks through one jitted ``lax.map``, each block
+doing an (B x N) fp32-HIGHEST matmul on the MXU + ``lax.top_k`` — the
+N x N similarity matrix is never materialized.
+
+Note the deliberate semantic difference from ``ops.metrics.recall_at_k``:
+that function reproduces the reference's in-training quirks (exp'd sims,
+strictly-greater-than-threshold, ties dropped) for parity; this one is
+the standard membership-in-top-K protocol used for reporting.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_FILL = float(-np.finfo(np.float32).max)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("ks", "query_block", "normalize")
+)
+def gallery_recall_at_k(
+    embeddings: jax.Array,
+    labels: jax.Array,
+    ks: Sequence[int] = (1, 2, 4, 8, 16, 32),
+    query_block: int = 1024,
+    normalize: bool = True,
+) -> Dict[str, jax.Array]:
+    """Full-gallery Recall@K over one embedding set (queries == gallery).
+
+    ``embeddings``: (N, D) float array (any float dtype; cosine similarity
+    is computed in fp32 on the MXU).  ``labels``: (N,) int or float class
+    ids.  ``normalize=False`` skips the L2 normalization when the
+    embeddings are already unit-norm (the extract output is).
+
+    Returns {"recall_at_{k}": scalar} for each k (ks exceeding N-1 are
+    clamped to N-1: with the self excluded a query only has N-1
+    neighbors).
+    """
+    n, _ = embeddings.shape
+    emb = embeddings.astype(jnp.float32)
+    if normalize:
+        emb = emb / jnp.maximum(
+            jnp.linalg.norm(emb, axis=1, keepdims=True), 1e-12
+        )
+    ks = tuple(int(min(k, n - 1)) for k in ks)
+    max_k = max(ks)
+    b = int(min(query_block, n))
+    n_blocks = -(-n // b)
+
+    def one_block(start):
+        q = jax.lax.dynamic_slice_in_dim(emb, start, b, axis=0)
+        sims = jnp.dot(
+            q, emb.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        rows = start + jnp.arange(b, dtype=jnp.int32)
+        cols = jnp.arange(n, dtype=jnp.int32)[None, :]
+        not_self = cols != rows[:, None]
+        masked = jnp.where(not_self, sims, jnp.float32(_NEG_FILL))
+        _, top_idx = jax.lax.top_k(masked, max_k)
+        top_same = labels[top_idx] == labels[rows][:, None]
+        # hits[:, j] == some same-label item within the top (j+1)
+        hits = jnp.cumsum(top_same.astype(jnp.int32), axis=1) > 0
+        return rows, hits
+
+    # dynamic_slice clamps the final block's start so every slice is
+    # full-size; overlapping rows are deduplicated by weighting each
+    # global row once.
+    starts = jnp.minimum(
+        jnp.arange(n_blocks, dtype=jnp.int32) * b, max(n - b, 0)
+    )
+    rows, hits = jax.lax.map(one_block, starts)
+    rows = rows.reshape(-1)
+    hits = hits.reshape(-1, max_k)
+    # Scatter per-row hits into a dense (n, max_k) table: only the last
+    # block can overlap an earlier one, and a duplicated row carries
+    # identical hits, so overwrite semantics deduplicate exactly.
+    table = jnp.zeros((n, max_k), dtype=bool).at[rows].set(hits)
+    out = {}
+    for k in ks:
+        out[f"recall_at_{k}"] = table[:, k - 1].astype(jnp.float32).mean()
+    return out
+
+
+def evaluate_embeddings(
+    embeddings: np.ndarray,
+    labels: np.ndarray,
+    ks: Sequence[int] = (1, 2, 4, 8, 16, 32),
+    query_block: int = 1024,
+) -> Dict[str, float]:
+    """Host-side convenience wrapper: numpy in, python floats out."""
+    out = gallery_recall_at_k(
+        jnp.asarray(embeddings), jnp.asarray(labels),
+        ks=tuple(ks), query_block=query_block,
+    )
+    return {k: float(v) for k, v in out.items()}

@@ -37,9 +37,15 @@ echo "=== $(date) 2/3 profile_flagship (incl. s2d variant) ==="
 timeout 3600 python scripts/profile_flagship.py --steps 10
 echo "profile rc=$?"
 
-echo "=== $(date) 3/3 bench.py full ==="
+echo "=== $(date) 3/4 bench.py full ==="
 timeout 3000 python bench.py > /tmp/bench_out.json
 echo "bench rc=$?"
 tail -c 1000 /tmp/bench_out.json
+
+echo "=== $(date) 4/4 TPU accuracy smoke (e2e real-JPEG on the chip) ==="
+timeout 2400 env E2E_JAX_PLATFORM=default python scripts/e2e_real_jpeg.py \
+  --steps 200 --workdir /tmp/e2e_jpeg_tpu \
+  --artifact accuracy/e2e_real_jpeg_tpu.json
+echo "e2e tpu rc=$?"
 
 echo "=== $(date) QUEUE DONE ==="

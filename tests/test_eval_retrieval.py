@@ -1,0 +1,118 @@
+"""Full-gallery Recall@K (ops.eval_retrieval) vs a NumPy brute force.
+
+The offline protocol is membership-in-top-K over cosine similarity with
+the self excluded (what papers report for the reference's datasets) —
+distinct by design from the in-training reference-quirk metric
+(ops.metrics.recall_at_k); both semantics are pinned here.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from npairloss_tpu.ops.eval_retrieval import (
+    evaluate_embeddings,
+    gallery_recall_at_k,
+)
+
+
+def brute_force(emb, labels, ks):
+    emb = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    sims = emb @ emb.T
+    np.fill_diagonal(sims, -np.inf)
+    n = emb.shape[0]
+    out = {}
+    order = np.argsort(-sims, axis=1, kind="stable")
+    for k in ks:
+        kk = min(k, n - 1)
+        hit = 0
+        for q in range(n):
+            top = order[q, :kk]
+            hit += bool(np.any(labels[top] == labels[q]))
+        out[f"recall_at_{kk}"] = hit / n
+    return out
+
+
+def make_clusters(rng, ids, per_id, dim, noise):
+    centers = rng.standard_normal((ids, dim))
+    labels = np.repeat(np.arange(ids), per_id)
+    emb = centers[labels] + noise * rng.standard_normal(
+        (ids * per_id, dim)
+    )
+    return emb.astype(np.float32), labels.astype(np.int32)
+
+
+@pytest.mark.parametrize("noise", [0.1, 1.0, 3.0])
+def test_matches_brute_force(noise):
+    rng = np.random.default_rng(0)
+    emb, labels = make_clusters(rng, ids=13, per_id=4, dim=16, noise=noise)
+    ks = (1, 2, 4, 8)
+    got = evaluate_embeddings(emb, labels, ks=ks, query_block=16)
+    want = brute_force(emb, labels, ks)
+    for k in ks:
+        assert got[f"recall_at_{k}"] == pytest.approx(
+            want[f"recall_at_{k}"], abs=1e-6
+        ), k
+
+
+def test_block_edges_and_overlap():
+    """N not divisible by the block, block > N, and block == N must all
+    agree (the clamped final block overlaps; dedup must be exact)."""
+    rng = np.random.default_rng(1)
+    emb, labels = make_clusters(rng, ids=9, per_id=3, dim=8, noise=0.8)
+    ks = (1, 4)
+    ref = evaluate_embeddings(emb, labels, ks=ks, query_block=27)
+    for qb in (4, 5, 26, 27, 64):
+        got = evaluate_embeddings(emb, labels, ks=ks, query_block=qb)
+        assert got == pytest.approx(ref), qb
+
+
+def test_k_clamped_to_gallery():
+    rng = np.random.default_rng(2)
+    emb, labels = make_clusters(rng, ids=3, per_id=2, dim=4, noise=0.5)
+    out = evaluate_embeddings(emb, labels, ks=(100,))
+    # k=100 > N-1=5 clamps to 5: every query has a same-id partner among
+    # ALL other items, so recall is exactly 1.
+    assert out == {"recall_at_5": 1.0}
+
+
+def test_separable_clusters_reach_one_at_k1():
+    rng = np.random.default_rng(3)
+    emb, labels = make_clusters(rng, ids=8, per_id=4, dim=32, noise=0.05)
+    out = evaluate_embeddings(emb, labels, ks=(1,))
+    assert out["recall_at_1"] == 1.0
+
+
+def test_prenormalized_path_matches():
+    rng = np.random.default_rng(4)
+    emb, labels = make_clusters(rng, ids=6, per_id=3, dim=8, noise=0.7)
+    unit = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    a = gallery_recall_at_k(unit, labels, ks=(1, 2), normalize=False)
+    b = gallery_recall_at_k(emb, labels, ks=(1, 2), normalize=True)
+    for k in ("recall_at_1", "recall_at_2"):
+        assert float(a[k]) == pytest.approx(float(b[k]), abs=1e-6)
+
+
+def test_cli_eval_roundtrip(tmp_path):
+    rng = np.random.default_rng(5)
+    emb, labels = make_clusters(rng, ids=5, per_id=3, dim=8, noise=0.3)
+    np.save(tmp_path / "f.emb.npy", emb)
+    np.save(tmp_path / "f.labels.npy", labels)
+    proc = subprocess.run(
+        [sys.executable, "-m", "npairloss_tpu", "--platform", "cpu",
+         "eval", "--prefix", str(tmp_path / "f"), "--ks", "1", "4"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["gallery_size"] == 15 and rec["classes"] == 5
+    want = brute_force(emb, labels, (1, 4))
+    assert rec["recall_at_1"] == pytest.approx(
+        want["recall_at_1"], abs=1e-4
+    )
+    assert rec["recall_at_4"] == pytest.approx(
+        want["recall_at_4"], abs=1e-4
+    )
