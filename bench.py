@@ -472,14 +472,18 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor):
     from npairloss_tpu.train import Solver, SolverConfig
 
     rows = {}
-    for batch, model_name, key in (
-        (120, "googlenet", "120"),
-        (240, "googlenet", "240"),
-        (480, "googlenet", "480"),
-        (120, "googlenet_s2d", "120_s2d"),
+    for batch, model_name, key, model_kw in (
+        (120, "googlenet", "120", {}),
+        (240, "googlenet", "240", {}),
+        (480, "googlenet", "480", {}),
+        (120, "googlenet_s2d", "120_s2d", {}),
+        # Remat row: does relieving activation HBM pressure recover the
+        # batch-480 MFU decay?  (~25% extra trunk FLOPs for O(block)
+        # activation memory; numerically identical.)
+        (480, "googlenet", "480_remat", {"remat": True}),
     ):
         solver = Solver(
-            get_model(model_name, dtype=jnp.bfloat16),
+            get_model(model_name, dtype=jnp.bfloat16, **model_kw),
             REFERENCE_CONFIG,
             SolverConfig(
                 base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,

@@ -73,6 +73,12 @@ class GoogLeNetEmbedding(nn.Module):
     # at random init (see ACCURACY.md).  Parameter-parity with the
     # reference's prototxt trunk keeps use_bn=False the default.
     use_bn: bool = False
+    # Rematerialize each inception block in the backward pass: trades
+    # ~25% more trunk FLOPs for O(stage) activation memory, lifting the
+    # batch ceiling / relieving HBM pressure at large per-chip batches
+    # (the measured MFU decay from batch 120 -> 480, PROFILE.md).
+    # Numerically identical to remat=False.
+    remat: bool = False
     # Space-to-depth stem: the 7x7/s2 conv over 3 input channels maps
     # poorly onto the 128-lane MXU (contraction depth 7*7*3 = 147 with
     # C_in=3 on the lane axis).  stem_s2d=True rewrites it as the exact
@@ -110,7 +116,15 @@ class GoogLeNetEmbedding(nn.Module):
         if use_lrn:
             x = local_response_norm(x)
         x = max_pool(x, 3, 2)
-        incep = lambda key: Inception(
+        # nn.remat checkpoints the block boundary: only each block's
+        # input survives to the backward, its internals recompute.
+        # ``train`` (argnum 2; 0 is the module) must be static — it
+        # selects the BN branch at trace time.
+        incep_cls = (
+            nn.remat(Inception, static_argnums=(2,))
+            if self.remat else Inception
+        )
+        incep = lambda key: incep_cls(
             _INCEPTION_PLAN[key], self.dtype, self.use_bn,
             name=f"inception_{key}",
         )

@@ -198,3 +198,31 @@ def test_googlenet_bn_trains_from_scratch_spread():
     # eval mode (running stats) produces finite normalized embeddings
     emb_eval = np.asarray(m.apply(variables, x, train=False))
     assert np.isfinite(emb_eval).all()
+
+
+def test_googlenet_remat_is_numerically_identical():
+    """remat=True checkpoints each inception block (recompute in the
+    backward) — outputs AND gradients must match remat=False exactly;
+    only the memory/FLOPs tradeoff changes."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)).astype(np.float32))
+
+    m_plain = get_model("googlenet", dtype=jnp.float32)
+    m_remat = get_model("googlenet", dtype=jnp.float32, remat=True)
+    variables = m_plain.init(jax.random.PRNGKey(0), x, train=False)
+
+    def loss(model, params):
+        return model.apply({"params": params}, x, train=False).sum()
+
+    out_p = np.asarray(m_plain.apply(variables, x, train=False))
+    out_r = np.asarray(m_remat.apply(variables, x, train=False))
+    np.testing.assert_array_equal(out_r, out_p)
+
+    g_p = jax.grad(lambda p: loss(m_plain, p))(variables["params"])
+    g_r = jax.grad(lambda p: loss(m_remat, p))(variables["params"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-6, atol=1e-7
+        ),
+        g_p, g_r,
+    )
