@@ -203,6 +203,11 @@ def main():
     # identical trunk, MXU-friendlier conv1 tiling — the delta vs "full"
     # is pure framework-side headroom within prototxt parity.
     timed("s2d", model_step("googlenet_s2d", dtype=jnp.bfloat16), images)
+    # Block remat (models/googlenet.py remat): recompute-in-backward —
+    # the delta vs "full" prices the recompute FLOPs at this batch; the
+    # batch-480 HBM-pressure effect is bench.py's 480_remat row.
+    timed("remat", model_step("googlenet", dtype=jnp.bfloat16, remat=True),
+          images)
 
     payload = {
         "device": dev.device_kind,
