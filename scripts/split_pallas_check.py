@@ -2,52 +2,83 @@
 
 Refuses to stamp hardware artifacts from a CPU/interpret run: the engine
 string and device field are derived from (and asserted against) the
-record itself (ADVICE r3).
+record itself (ADVICE r3).  Runs unattended from the revalidation queue,
+so the refusal paths are unit-tested (tests/test_bench_outage.py).
 """
-import json, sys, datetime
+import argparse
+import datetime
+import json
+import os
+import sys
 
 ROUND = 4
-src = "/tmp/tpu_check_out.json"
-rec = json.loads(open(src).read().strip().splitlines()[-1])
-date = datetime.date.today().isoformat()
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# Hardware gate: only a Mosaic-compiled run on a real TPU device may be
-# recorded as a hardware measurement.
-if not rec.get("mosaic_compiled"):
-    sys.exit(f"refusing to stamp artifacts: mosaic_compiled={rec.get('mosaic_compiled')!r}")
-device = rec.get("device", "")
-if "tpu" not in device.lower():
-    sys.exit(f"refusing to stamp artifacts: device={device!r} is not a TPU")
 
-sim_cached = bool(
-    rec.get("stretch", {}).get("flagship", {}).get("sim_cache"))
-engine = "pallas_blockwise (Mosaic-compiled"
-if sim_cached:
-    engine += ", fp32 sim-cache; _nocache rows stream uncached"
-engine += ")"
+def split(rec, out_dir, date=None):
+    """Build (pallas, stretch) artifact dicts; SystemExit on a record
+    that must not be stamped as a hardware measurement."""
+    date = date or datetime.date.today().isoformat()
+    if not rec.get("mosaic_compiled"):
+        raise SystemExit(
+            "refusing to stamp artifacts: "
+            f"mosaic_compiled={rec.get('mosaic_compiled')!r}"
+        )
+    device = rec.get("device", "")
+    if "tpu" not in device.lower():
+        raise SystemExit(
+            f"refusing to stamp artifacts: device={device!r} is not a TPU"
+        )
 
-pallas = {
-    "round": ROUND, "date": date, "device": device, "pool": rec["pool"],
-    "parity": rec["parity"], "ok": rec["ok"],
-    "mosaic_compiled": rec["mosaic_compiled"],
-    "command": "python scripts/tpu_pallas_check.py --pool 4096 --stretch 32768",
-}
-stretch = {
-    "round": ROUND, "date": date, "device": device, "pool": 32768,
-    "dim": 512, "block": 512,
-    "engine": engine,
-    "sim_cache": sim_cached,
-    "note": ("fwd+bwd per step; the similarity cache materializes the 4.3 GB "
-             "fp32 sim matrix once in the stats sweep and streams it back in "
-             "the radix/loss/backward sweeps (see docs/DESIGN.md). Timed as 3 "
-             "perturbed steps inside one jitted lax.scan, host-fetch synced, "
-             "dispatch floor subtracted (bench.py timing discipline)."),
-    "stretch": rec["stretch"],
-    **{k: rec[k] for k in (
-        "peak_bytes_in_use", "peak_bytes_in_use_cached",
-        "peak_bytes_in_use_nocache") if k in rec},
-    "command": "python scripts/tpu_pallas_check.py --pool 4096 --stretch 32768",
-}
-open("/root/repo/PALLAS_CHECK.json", "w").write(json.dumps(pallas) + "\n")
-open("/root/repo/STRETCH.json", "w").write(json.dumps(stretch) + "\n")
-print("split ok:", rec["ok"], rec.get("stretch"))
+    sim_cached = bool(
+        rec.get("stretch", {}).get("flagship", {}).get("sim_cache"))
+    engine = "pallas_blockwise (Mosaic-compiled"
+    if sim_cached:
+        engine += ", fp32 sim-cache; _nocache rows stream uncached"
+    engine += ")"
+
+    cmd = "python scripts/tpu_pallas_check.py --pool 4096 --stretch 32768"
+    pallas = {
+        "round": ROUND, "date": date, "device": device, "pool": rec["pool"],
+        "parity": rec["parity"], "ok": rec["ok"],
+        "mosaic_compiled": rec["mosaic_compiled"],
+        "command": cmd,
+    }
+    stretch = {
+        "round": ROUND, "date": date, "device": device, "pool": 32768,
+        "dim": 512, "block": 512,
+        "engine": engine,
+        "sim_cache": sim_cached,
+        "note": ("fwd+bwd per step; the similarity cache materializes the "
+                 "4.3 GB fp32 sim matrix once in the stats sweep and streams "
+                 "it back in the radix/loss/backward sweeps (see "
+                 "docs/DESIGN.md). Timed as 3 perturbed steps inside one "
+                 "jitted lax.scan, host-fetch synced, dispatch floor "
+                 "subtracted (bench.py timing discipline)."),
+        "stretch": rec["stretch"],
+        **{k: rec[k] for k in (
+            "peak_bytes_in_use", "peak_bytes_in_use_cached",
+            "peak_bytes_in_use_nocache") if k in rec},
+        "command": cmd,
+    }
+    return pallas, stretch
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", default="/tmp/tpu_check_out.json")
+    ap.add_argument("--out-dir", default=REPO)
+    args = ap.parse_args()
+
+    rec = json.loads(open(args.src).read().strip().splitlines()[-1])
+    pallas, stretch = split(rec, args.out_dir)
+    with open(os.path.join(args.out_dir, "PALLAS_CHECK.json"), "w") as f:
+        f.write(json.dumps(pallas) + "\n")
+    with open(os.path.join(args.out_dir, "STRETCH.json"), "w") as f:
+        f.write(json.dumps(stretch) + "\n")
+    print("split ok:", rec["ok"], rec.get("stretch"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,3 +86,51 @@ def test_sim_cache_auto_is_budgeted_and_logged(caplog):
     with caplog.at_level(logging.INFO, logger="npairloss_tpu"):
         resolve_sim_cache_auto(1 << 20, "testengine")
     assert not caplog.records
+
+
+def _load_split():
+    spec = importlib.util.spec_from_file_location(
+        "split_mod", os.path.join(REPO, "scripts", "split_pallas_check.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_check_record(**over):
+    rec = {
+        "device": "TPU v5 lite", "pool": 4096,
+        "parity": {"flagship": {"ok": True}}, "ok": True,
+        "mosaic_compiled": True,
+        "stretch": {
+            "flagship": {"ms_per_step": 300.0, "sim_cache": True},
+            "flagship_nocache": {"ms_per_step": 1000.0, "sim_cache": False},
+        },
+        "peak_bytes_in_use_nocache": 1 << 30,
+        "peak_bytes_in_use_cached": 6 << 30,
+        "peak_bytes_in_use": 6 << 30,
+    }
+    rec.update(over)
+    return rec
+
+
+def test_split_refuses_non_hardware_records():
+    """The queue runs unattended; a CPU/interpret run must never be
+    stamped as a hardware artifact (ADVICE r3)."""
+    split = _load_split().split
+    with pytest.raises(SystemExit, match="mosaic_compiled"):
+        split(_fake_check_record(mosaic_compiled=False), "/tmp")
+    with pytest.raises(SystemExit, match="not a TPU"):
+        split(_fake_check_record(device="cpu"), "/tmp")
+
+
+def test_split_derives_engine_and_carries_peaks():
+    split = _load_split().split
+    pallas, stretch = split(_fake_check_record(), "/tmp", date="2026-07-30")
+    assert pallas["ok"] is True and pallas["pool"] == 4096
+    assert stretch["sim_cache"] is True
+    assert "fp32 sim-cache" in stretch["engine"]
+    assert stretch["peak_bytes_in_use_nocache"] == 1 << 30
+    assert stretch["peak_bytes_in_use_cached"] == 6 << 30
+    assert "flagship_nocache" in stretch["stretch"]
+    json.dumps(pallas), json.dumps(stretch)
