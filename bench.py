@@ -200,7 +200,8 @@ def _measure(step, args_list, warmup: int, steps: int, fetch, floor=0.0):
     return max(time.perf_counter() - t0 - floor, 1e-9)
 
 
-def child_full(platform: str, steps: int, warmup: int) -> int:
+def child_full(platform: str, steps: int, warmup: int,
+               soft_budget: float = 900.0) -> int:
     jax, dev = _child_setup(platform)
     import jax.numpy as jnp
     import numpy as np
@@ -255,14 +256,19 @@ def child_full(platform: str, steps: int, warmup: int) -> int:
     except Exception as e:
         _log(f"mfu estimate failed: {e}")
 
+    # Extras must never cost the headline: the parent kills this child at
+    # --full-timeout, so every extra row checks a soft deadline and
+    # records itself as skipped instead of overrunning (the row count
+    # grew round 4: sim-cache on/off + s2d + remat).
+    deadline = _T0 + 0.75 * soft_budget
     extras = {}
     try:
-        extras = _engine_extras(jax, jnp, np, floor)
+        extras = _engine_extras(jax, jnp, np, floor, deadline)
     except Exception as e:
         _log(f"engine extras failed: {e}")
     try:
         extras["batch_scaling"] = _batch_scaling_extras(
-            jax, jnp, np, dev, floor
+            jax, jnp, np, dev, floor, deadline
         )
     except Exception as e:
         _log(f"batch scaling extras failed: {e}")
@@ -287,7 +293,7 @@ def child_full(platform: str, steps: int, warmup: int) -> int:
     return 0
 
 
-def _engine_extras(jax, jnp, np, floor):
+def _engine_extras(jax, jnp, np, floor, deadline=None):
     """Loss-engine comparison at a large self-pool: dense XLA graph vs the
     Pallas blockwise kernels (compiled by Mosaic when on TPU — this is the
     on-hardware validation of ops/pallas_npair.py) vs the ring engine on a
@@ -345,6 +351,10 @@ def _engine_extras(jax, jnp, np, floor):
             )
             return acc, losses[0]
 
+        if deadline is not None and time.time() > deadline:
+            _log(f"extras: skipping {name} (soft time budget reached)")
+            extras[name] = {"skipped": "soft time budget reached"}
+            return None
         _log(f"extras: compiling {name}...")
         try:
             return _bench_one_timed(name, many)
@@ -460,7 +470,7 @@ def _engine_extras(jax, jnp, np, floor):
     return extras
 
 
-def _batch_scaling_extras(jax, jnp, np, dev, floor):
+def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None):
     """Flagship solver throughput at batch 120/240/480 — does a bigger
     per-chip batch lift emb/s/chip (VERDICT r2 item 4)?  Plus the
     space-to-depth stem variant at batch 120: parity-preserving rewrite
@@ -482,6 +492,10 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor):
         # activation memory; numerically identical.)
         (480, "googlenet", "480_remat", {"remat": True}),
     ):
+        if deadline is not None and time.time() > deadline:
+            _log(f"batch scaling: skipping {key} (soft time budget reached)")
+            rows[key] = {"skipped": "soft time budget reached"}
+            continue
         solver = Solver(
             get_model(model_name, dtype=jnp.bfloat16, **model_kw),
             REFERENCE_CONFIG,
@@ -678,12 +692,14 @@ def main() -> int:
     # child modes (internal)
     ap.add_argument("--child", choices=["probe", "full", "smoke"])
     ap.add_argument("--platform", default="default")
+    ap.add_argument("--soft-budget", type=float, default=900.0)
     args = ap.parse_args()
 
     if args.child == "probe":
         return child_probe(args.platform)
     if args.child == "full":
-        return child_full(args.platform, args.steps, args.warmup)
+        return child_full(args.platform, args.steps, args.warmup,
+                          args.soft_budget)
     if args.child == "smoke":
         return child_smoke(args.platform)
 
@@ -744,7 +760,8 @@ def main() -> int:
     if not args.smoke:
         attempts.append((
             ["--child", "full", "--platform", platform,
-             "--steps", str(args.steps), "--warmup", str(args.warmup)],
+             "--steps", str(args.steps), "--warmup", str(args.warmup),
+             "--soft-budget", str(args.full_timeout)],
             args.full_timeout,
         ))
     attempts.append((
