@@ -482,11 +482,17 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None):
     from npairloss_tpu.train import Solver, SolverConfig
 
     rows = {}
+    # Ordered by importance: the soft deadline may skip later rows.
+    # The parity-preserving MXU rewrites (s2d stem, fused inception
+    # 1x1s, both = "mxu") and the remat row answer PROFILE.md's open
+    # attribution questions with driver-captured numbers.
     for batch, model_name, key, model_kw in (
         (120, "googlenet", "120", {}),
+        (120, "googlenet_mxu", "120_mxu", {}),
         (240, "googlenet", "240", {}),
         (480, "googlenet", "480", {}),
         (120, "googlenet_s2d", "120_s2d", {}),
+        (120, "googlenet_fused", "120_fused", {}),
         # Remat row: does relieving activation HBM pressure recover the
         # batch-480 MFU decay?  (~25% extra trunk FLOPs for O(block)
         # activation memory; numerically identical.)

@@ -10,7 +10,10 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict
 
-from npairloss_tpu.models.googlenet import GoogLeNetEmbedding
+from npairloss_tpu.models.googlenet import (
+    GoogLeNetEmbedding,
+    fuse_inception_1x1_params,
+)
 from npairloss_tpu.models.mlp import MLPEmbedding
 from npairloss_tpu.models.resnet import ResNetEmbedding
 from npairloss_tpu.models.vit import ViTEmbedding
@@ -28,6 +31,14 @@ _REGISTRY: Dict[str, Callable[..., Any]] = {
     "googlenet_s2d": lambda **kw: GoogLeNetEmbedding(stem_s2d=True, **kw),
     "googlenet_bn_s2d": lambda **kw: GoogLeNetEmbedding(
         use_bn=True, stem_s2d=True, **kw
+    ),
+    # Fused inception 1x1s (exact algebra, MXU lane occupancy — see
+    # googlenet.Inception.fuse_1x1); weights interchange with the plain
+    # trunk via fuse_inception_1x1_params.  "_mxu" stacks both
+    # parity-preserving rewrites (s2d stem + fused 1x1s).
+    "googlenet_fused": lambda **kw: GoogLeNetEmbedding(fuse_1x1=True, **kw),
+    "googlenet_mxu": lambda **kw: GoogLeNetEmbedding(
+        stem_s2d=True, fuse_1x1=True, **kw
     ),
     "resnet50": lambda **kw: ResNetEmbedding(stage_sizes=(3, 4, 6, 3), **kw),
     "resnet50_s2d": lambda **kw: ResNetEmbedding(

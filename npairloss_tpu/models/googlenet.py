@@ -41,6 +41,14 @@ class Inception(nn.Module):
     plan: Tuple[int, int, int, int, int, int]
     dtype: Any = jnp.float32
     use_bn: bool = False
+    # Merge the three 1x1 convs that read the block input (b1x1,
+    # b3x3_reduce, b5x5_reduce) into ONE conv with p1+p3r+p5r output
+    # channels, then slice.  Same dot products, same per-channel
+    # ReLU/BN — exact algebra — but the MXU sees one gemm with a full
+    # lane tile instead of three thin ones (e.g. 3a: 64/96/16 -> 176;
+    # a 16-channel conv occupies 1/8 of the 128-lane systolic axis).
+    # Checkpoints interchange via ``fuse_inception_1x1_params``.
+    fuse_1x1: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -48,10 +56,16 @@ class Inception(nn.Module):
         conv = lambda f, k, name: ConvBlock(
             f, k, dtype=self.dtype, use_bn=self.use_bn, name=name
         )
-        b1 = conv(p1, (1, 1), "b1x1")(x, train)
-        b3 = conv(p3r, (1, 1), "b3x3_reduce")(x, train)
+        if self.fuse_1x1:
+            fused = conv(p1 + p3r + p5r, (1, 1), "fused_1x1")(x, train)
+            b1 = fused[..., :p1]
+            b3 = fused[..., p1:p1 + p3r]
+            b5 = fused[..., p1 + p3r:]
+        else:
+            b1 = conv(p1, (1, 1), "b1x1")(x, train)
+            b3 = conv(p3r, (1, 1), "b3x3_reduce")(x, train)
+            b5 = conv(p5r, (1, 1), "b5x5_reduce")(x, train)
         b3 = conv(p3, (3, 3), "b3x3")(b3, train)
-        b5 = conv(p5r, (1, 1), "b5x5_reduce")(x, train)
         b5 = conv(p5, (5, 5), "b5x5")(b5, train)
         bp = max_pool(x, 3, 1, "SAME")
         bp = conv(pp, (1, 1), "pool_proj")(bp, train)
@@ -79,6 +93,10 @@ class GoogLeNetEmbedding(nn.Module):
     # (the measured MFU decay from batch 120 -> 480, PROFILE.md).
     # Numerically identical to remat=False.
     remat: bool = False
+    # Fused inception 1x1s (see Inception.fuse_1x1): exact algebra,
+    # better MXU lane occupancy on the thin reduce branches; weights
+    # interchange via fuse_inception_1x1_params.
+    fuse_1x1: bool = False
     # Space-to-depth stem: the 7x7/s2 conv over 3 input channels maps
     # poorly onto the 128-lane MXU (contraction depth 7*7*3 = 147 with
     # C_in=3 on the lane axis).  stem_s2d=True rewrites it as the exact
@@ -126,7 +144,7 @@ class GoogLeNetEmbedding(nn.Module):
         )
         incep = lambda key: incep_cls(
             _INCEPTION_PLAN[key], self.dtype, self.use_bn,
-            name=f"inception_{key}",
+            fuse_1x1=self.fuse_1x1, name=f"inception_{key}",
         )
         x = incep("3a")(x, train)
         x = incep("3b")(x, train)
@@ -142,3 +160,38 @@ class GoogLeNetEmbedding(nn.Module):
             x = l2_normalize(x)
         return x
 
+
+
+def fuse_inception_1x1_params(params, batch_stats=None):
+    """Convert plain-trunk variables to the ``fuse_1x1=True`` layout.
+
+    Exact: the fused conv's kernel/bias (and BN scale/bias/mean/var —
+    all per-output-channel) are the channel-wise concatenation of
+    b1x1 ++ b3x3_reduce ++ b5x5_reduce, in the slice order
+    ``Inception.__call__`` uses.  Returns (params, batch_stats) with
+    the three branch entries replaced by one ``fused_1x1`` entry;
+    ``batch_stats`` may be None (bias/LRN trunk).
+    """
+    import jax
+
+    def convert_tree(tree):
+        if tree is None:
+            return None
+        out = jax.tree_util.tree_map(lambda x: x, tree)  # deep-ish copy
+        for block, sub in list(out.items()):
+            if not block.startswith("inception_") or "b1x1" not in sub:
+                continue
+            parts = [sub.pop("b1x1"), sub.pop("b3x3_reduce"),
+                     sub.pop("b5x5_reduce")]
+            fused = {}
+            for mod in parts[0]:  # "Conv_0" and, for BN trunks, "BatchNorm_0"
+                fused[mod] = {
+                    leaf: jnp.concatenate(
+                        [p[mod][leaf] for p in parts], axis=-1
+                    )
+                    for leaf in parts[0][mod]
+                }
+            sub["fused_1x1"] = fused
+        return out
+
+    return convert_tree(params), convert_tree(batch_stats)

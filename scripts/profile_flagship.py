@@ -203,6 +203,14 @@ def main():
     # identical trunk, MXU-friendlier conv1 tiling — the delta vs "full"
     # is pure framework-side headroom within prototxt parity.
     timed("s2d", model_step("googlenet_s2d", dtype=jnp.bfloat16), images)
+    # Fused inception 1x1s (models/googlenet.py fuse_1x1): the three
+    # input-reading 1x1 convs per block become one full-lane gemm —
+    # exact algebra; the delta vs "full" prices the thin-branch MXU
+    # underutilization PROFILE.md attributes headroom to.
+    timed("fused", model_step("googlenet_fused", dtype=jnp.bfloat16),
+          images)
+    # Both parity-preserving MXU rewrites stacked (s2d stem + fused).
+    timed("mxu", model_step("googlenet_mxu", dtype=jnp.bfloat16), images)
     # Block remat (models/googlenet.py remat): recompute-in-backward —
     # the delta vs "full" prices the recompute FLOPs at this batch; the
     # batch-480 HBM-pressure effect is bench.py's 480_remat row.

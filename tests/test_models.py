@@ -226,3 +226,62 @@ def test_googlenet_remat_is_numerically_identical():
         ),
         g_p, g_r,
     )
+
+
+def test_googlenet_fused_1x1_exact_equivalence():
+    """fuse_1x1 merges the three input-reading 1x1 convs into one wider
+    conv + slices (MXU lane occupancy); with weights converted by
+    fuse_inception_1x1_params the outputs must match the plain trunk."""
+    from npairloss_tpu.models import fuse_inception_1x1_params
+
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)).astype(np.float32))
+
+    m_plain = get_model("googlenet", dtype=jnp.float32)
+    m_fused = get_model("googlenet_fused", dtype=jnp.float32)
+    variables = m_plain.init(jax.random.PRNGKey(0), x, train=False)
+    fp, _ = fuse_inception_1x1_params(variables["params"])
+    out_plain = np.asarray(m_plain.apply(variables, x, train=False))
+    out_fused = np.asarray(m_fused.apply({"params": fp}, x, train=False))
+    np.testing.assert_allclose(out_fused, out_plain, rtol=1e-5, atol=1e-6)
+
+    # Param count is identical — fusion is a layout change, not a model
+    # change.
+    count = lambda t: sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(t))
+    assert count(fp) == count(variables["params"])
+
+
+def test_googlenet_bn_fused_1x1_exact_equivalence():
+    """Same check for the BN trunk: BN scale/bias/mean/var are
+    per-channel, so channel-concat conversion is exact (batch_stats
+    tree converts too)."""
+    from npairloss_tpu.models import fuse_inception_1x1_params
+
+    rng = np.random.default_rng(14)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)).astype(np.float32))
+
+    m_plain = get_model("googlenet_bn", dtype=jnp.float32)
+    m_fused = get_model("googlenet_bn", dtype=jnp.float32, fuse_1x1=True)
+    variables = m_plain.init(jax.random.PRNGKey(1), x, train=False)
+    fp, fbs = fuse_inception_1x1_params(
+        variables["params"], variables["batch_stats"]
+    )
+    out_plain = np.asarray(m_plain.apply(variables, x, train=False))
+    out_fused = np.asarray(
+        m_fused.apply({"params": fp, "batch_stats": fbs}, x, train=False)
+    )
+    np.testing.assert_allclose(out_fused, out_plain, rtol=1e-5, atol=1e-6)
+
+
+def test_googlenet_mxu_variant_runs():
+    """googlenet_mxu stacks both parity-preserving rewrites (s2d stem +
+    fused 1x1s) — shape/norm contract must hold."""
+    m = get_model("googlenet_mxu", dtype=jnp.float32)
+    assert m.stem_s2d and m.fuse_1x1
+    rng = np.random.default_rng(15)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)).astype(np.float32))
+    out = _init_and_run(m, x)
+    assert out.shape == (2, 1024)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=1), 1.0, rtol=1e-5)
