@@ -298,6 +298,26 @@ def main() -> int:
         f" wanted {first_display} (cadence must continue, not restart)"
     )
 
+    # Deployment loop: extract embeddings from the final snapshot via the
+    # CLI, then full-gallery Recall@K over them (the reporting protocol
+    # papers use — every test image queries the whole extracted set).
+    final_snap = os.path.join(ws, f"snap_iter_{args.steps}.ckpt")
+    gallery = None
+    if os.path.isdir(final_snap):
+        out3 = run_cli(
+            ["extract", "--solver", os.path.join(ws, "solver.prototxt"),
+             "--model", "mlp", "--native", "require", "--phase", "TEST",
+             "--batches", str(IDS * TEST_PER_ID // 16),
+             "--resume", final_snap, "--out", os.path.join(ws, "feats")],
+            os.path.join(ws, "extract.log"),
+        )
+        out4 = run_cli(
+            ["eval", "--prefix", os.path.join(ws, "feats"),
+             "--ks", "1", "2", "4"],
+            os.path.join(ws, "eval.log"),
+        )
+        gallery = json.loads(out4.strip().splitlines()[-1])
+
     first_r1 = test_curve[0].get("retrieve_top1", 0.0)
     final_r1 = test_curve[-1].get("retrieve_top1", 0.0)
     resumed_r1 = r_test[-1].get("retrieve_top1", 0.0) if r_test else None
@@ -308,6 +328,7 @@ def main() -> int:
         and final_r1 > first_r1
         and final_loss < first_loss
         and (resumed_r1 is None or resumed_r1 >= args.r1_bar)
+        and (gallery is None or gallery.get("recall_at_1", 0.0) >= args.r1_bar)
     )
 
     artifact = {
@@ -335,6 +356,11 @@ def main() -> int:
             "resumed_from": resumed_from,
             "first_resumed_display_iter": r_train[0]["iter"],
             "resumed_test_curve": r_test,
+        },
+        "deployment": {
+            "extract": "CLI extract --native require from the final "
+                       "snapshot (TEST split)",
+            "full_gallery_eval": gallery,
         },
         "summary": {
             "first_avg_loss": first_loss, "final_avg_loss": final_loss,
