@@ -48,6 +48,17 @@ def main() -> int:
     ap.add_argument("--dim", type=int, default=512)
     ap.add_argument("--shards", type=int, default=8)
     ap.add_argument(
+        "--blockwise-pool", type=int, default=0,
+        help="also check the Pallas blockwise engine (interpret mode on "
+        "CPU) against the single-rank dense oracle at this pool — "
+        "interpret is slow, so this uses a smaller pool than the ring "
+        "check (8192 is ~4x the hardware parity pool)",
+    )
+    ap.add_argument(
+        "--skip-ring", action="store_true",
+        help="only run the blockwise section (merge into existing out)",
+    )
+    ap.add_argument(
         "--out", default=os.path.join(REPO, "STRETCH_PARITY.json")
     )
     args = ap.parse_args()
@@ -120,45 +131,104 @@ def main() -> int:
         log(f"{name} per-rank loss mean {loss.mean():.6f}")
         return loss, grad
 
-    ring_losses, gr = run("ring (8-shard ppermute streaming)", ring_shard)
-    dense_losses, gd = run("dense oracle (per-rank pair matrices)",
-                           dense_shard)
+    def parity(name_a, name_b, la, ga, lb, gb):
+        """(delta summary, ok) at the test_ring elementwise bar."""
+        loss_delta = float(np.max(np.abs(la - lb)))
+        grad_max_delta = float(np.max(np.abs(gb - ga)))
+        grad_scale = float(np.max(np.abs(gb)))
+        grad_ok = bool(np.allclose(ga, gb, rtol=3e-5, atol=1e-6))
+        sec_ok = (
+            loss_delta <= 1e-4 * max(1.0, abs(float(np.mean(lb))))
+            and grad_ok
+            and bool(np.isfinite(ga).all())
+        )
+        return {
+            f"loss_{name_a}": float(np.mean(la)),
+            f"loss_{name_b}": float(np.mean(lb)),
+            "loss_delta": loss_delta,
+            "grad_max_delta": grad_max_delta,
+            "grad_scale": grad_scale,
+            "ok": bool(sec_ok),
+        }, sec_ok
 
-    ring_loss = float(ring_losses.mean())
-    dense_loss = float(dense_losses.mean())
-    loss_delta = float(np.max(np.abs(ring_losses - dense_losses)))
-    grad_max_delta = float(np.max(np.abs(gd - gr)))
-    grad_scale = float(np.max(np.abs(gd)))
-    # Same elementwise bar as tests/test_ring.py::test_ring_matches_dense_grad.
-    grad_ok = bool(np.allclose(gr, gd, rtol=3e-5, atol=1e-6))
-    ok = (
-        loss_delta <= 1e-4 * max(1.0, abs(dense_loss))
-        and grad_ok
-        and bool(np.isfinite(gr).all())
-    )
     record = {
-        "what": ("dense-oracle parity for the ring engine at the FULL "
-                 "stretch pool on the 8-shard virtual CPU mesh — "
+        "what": ("dense-oracle parity for the streaming engines at "
+                 "stretch-scale pools on the virtual CPU mesh — "
                  "correctness at the scale STRETCH.json only times "
                  "(radix RELATIVE selection over ~1e9 pairs included)"),
-        "pool": n, "dim": d, "shards": g,
         "config": "flagship (usage/def.prototxt:137-146)",
         "backend": "cpu (virtual mesh)",
-        "loss_dense": dense_loss,
-        "loss_ring": ring_loss,
-        "loss_delta": loss_delta,
-        "grad_max_delta": grad_max_delta,
-        "grad_scale": grad_scale,
-        "elapsed_s": round(time.time() - T0, 1),
-        "ok": bool(ok),
-        "command": f"python scripts/stretch_parity_virtual.py --pool {n}",
+        "command": f"python scripts/stretch_parity_virtual.py --pool {n}"
+                   + (f" --blockwise-pool {args.blockwise_pool}"
+                      if args.blockwise_pool else ""),
     }
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as fo:
+                prev = json.load(fo)
+            for key in ("ring", "blockwise"):
+                if key in prev:
+                    record[key] = prev[key]
+        except Exception:
+            pass
+
+    ok = True
+    if not args.skip_ring:
+        ring_losses, gr = run(
+            "ring (8-shard ppermute streaming)", ring_shard)
+        dense_losses, gd = run(
+            "dense oracle (per-rank pair matrices)", dense_shard)
+        sec, sec_ok = parity(
+            "ring", "dense", ring_losses, gr, dense_losses, gd)
+        ok = ok and sec_ok
+        record["ring"] = {
+            "pool": n, "dim": d, "shards": g, **sec,
+            "note": "per-rank semantics on the 8-shard mesh, both sides",
+        }
+        log(f"ring section {'OK' if sec_ok else 'FAIL'}: "
+            f"loss d={sec['loss_delta']:.2e}, "
+            f"grad max d={sec['grad_max_delta']:.2e}")
+
+    if args.blockwise_pool:
+        from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss
+
+        nb = args.blockwise_pool
+        fb = rng.standard_normal((nb, d)).astype(np.float32)
+        fb /= np.linalg.norm(fb, axis=1, keepdims=True)
+        feats_b = jnp.asarray(fb)
+        labels_b = jnp.asarray(
+            np.repeat(np.arange(nb // 2), 2).astype(np.int32))
+        log(f"blockwise section: pool {nb} (interpret mode on CPU)...")
+        t0 = time.time()
+        lb_, gb_ = jax.jit(jax.value_and_grad(
+            lambda x: blockwise_npair_loss(x, labels_b, cfg)))(feats_b)
+        lb_, gb_ = np.asarray(lb_), np.asarray(gb_)
+        log(f"blockwise loss {float(lb_):.6f} "
+            f"({time.time() - t0:.0f}s); dense oracle...")
+        ld_, gd_ = jax.jit(jax.value_and_grad(
+            lambda x: npair_loss(x, labels_b, cfg)))(feats_b)
+        ld_, gd_ = np.asarray(ld_), np.asarray(gd_)
+        sec, sec_ok = parity(
+            "blockwise", "dense",
+            np.asarray([lb_]), gb_, np.asarray([ld_]), gd_)
+        ok = ok and sec_ok
+        record["blockwise"] = {
+            "pool": nb, "dim": d, "block": 512,
+            "interpret": True, **sec,
+            "note": ("single-rank semantics (the blockwise engine is the "
+                     "single-chip path); Pallas interpret mode — the "
+                     "Mosaic-compiled twin is PALLAS_CHECK.json"),
+        }
+        log(f"blockwise section {'OK' if sec_ok else 'FAIL'}: "
+            f"loss d={sec['loss_delta']:.2e}, "
+            f"grad max d={sec['grad_max_delta']:.2e}")
+
+    record["ok"] = bool(ok)
+    record["elapsed_s"] = round(time.time() - T0, 1)
     with open(args.out, "w") as fo:
         json.dump(record, fo, indent=1)
         fo.write("\n")
-    log(f"{'OK' if ok else 'FAIL'}: loss d={loss_delta:.2e}, "
-        f"grad max d={grad_max_delta:.2e} (scale {grad_scale:.2e}) "
-        f"-> {args.out}")
+    log(f"{'OK' if ok else 'FAIL'} -> {args.out}")
     return 0 if ok else 1
 
 
