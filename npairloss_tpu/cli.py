@@ -151,6 +151,8 @@ def _build_solver(args):
     )
     if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)
+    elif getattr(args, "weights", None):
+        _load_weights_into(solver, args.weights)
     return solver, net_cfg, input_shape
 
 
@@ -309,6 +311,95 @@ def cmd_extract(args) -> int:
     return 0
 
 
+def _load_weights_into(solver, path: str):
+    """Load a msgpack params file into a solver, auto-converting to the
+    model's MXU-variant layout when needed (s2d stem / fused 1x1s)."""
+    import flax.serialization
+
+    with open(path, "rb") as f:
+        params = flax.serialization.msgpack_restore(f.read())
+    model = solver.model
+    if getattr(model, "stem_s2d", False):
+        from npairloss_tpu.models.layers import conv1_kernel_to_s2d
+        import numpy as np
+
+        k7 = np.asarray(params["conv1"]["Conv_0"]["kernel"])
+        if k7.shape[0] == 7:  # plain-layout file -> s2d layout
+            params["conv1"]["Conv_0"]["kernel"] = conv1_kernel_to_s2d(k7)
+    if getattr(model, "fuse_1x1", False) and any(
+        "b1x1" in v for v in params.values() if isinstance(v, dict)
+    ):
+        from npairloss_tpu.models import fuse_inception_1x1_params
+
+        params, _ = fuse_inception_1x1_params(params)
+    solver.load_params(params)
+    log.info("loaded pretrained params from %s", path)
+
+
+def cmd_import_caffemodel(args) -> int:
+    """Migrate a reference user's trained .caffemodel trunk: binary
+    NetParameter blobs -> GoogLeNetEmbedding params -> msgpack file
+    (consumed by ``train --weights``)."""
+    import flax.serialization
+    import jax
+    import numpy as np
+
+    from npairloss_tpu.config.caffemodel import parse_caffemodel
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.models.caffe_import import (
+        caffe_layer_map,
+        googlenet_params_from_caffemodel,
+    )
+
+    with open(args.weights, "rb") as f:
+        blobs = parse_caffemodel(f.read())
+    log.info("caffemodel: %d layers with blobs", len(blobs))
+    import jax.numpy as jnp
+
+    model = get_model(args.model, dtype=jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 224, 224, 3), jnp.float32),
+            train=False,
+        )
+    )
+    template = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, np.float32), variables["params"]
+    )
+    params = googlenet_params_from_caffemodel(blobs, template)
+    with open(args.out, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(params))
+    print(json.dumps({
+        "out": args.out,
+        "caffemodel_layers": len(blobs),
+        "mapped_convs": len(caffe_layer_map()),
+    }))
+    return 0
+
+
+def cmd_export_caffemodel(args) -> int:
+    """The reverse migration: a trunk trained here -> .caffemodel bytes
+    a Caffe deployment stack can consume."""
+    import flax.serialization
+
+    from npairloss_tpu.config.caffemodel import write_caffemodel
+    from npairloss_tpu.models.caffe_import import (
+        caffemodel_layers_from_googlenet_params,
+    )
+
+    with open(args.weights, "rb") as f:
+        params = flax.serialization.msgpack_restore(f.read())
+    layers = caffemodel_layers_from_googlenet_params(params)
+    blob = write_caffemodel(layers)
+    with open(args.out, "wb") as f:
+        f.write(blob)
+    print(json.dumps({
+        "out": args.out, "layers": len(layers), "bytes": len(blob),
+    }))
+    return 0
+
+
 def cmd_eval(args) -> int:
     """Full-gallery retrieval evaluation over extracted embeddings — the
     protocol papers report for the reference's datasets (every test
@@ -408,6 +499,12 @@ def main(argv: Optional[list] = None) -> int:
         "— lifts the per-chip batch ceiling; numerically identical",
     )
     t.add_argument("--resume", help="snapshot path to restore")
+    t.add_argument(
+        "--weights",
+        help="pretrained params (.msgpack from import-caffemodel) to "
+        "finetune from — fresh optimizer state, iteration 0 (use "
+        "--resume for mid-training snapshots instead)",
+    )
     t.add_argument("--snapshot_prefix", help="override snapshot prefix")
     t.add_argument(
         "--synthetic", action="store_true",
@@ -490,6 +587,31 @@ def main(argv: Optional[list] = None) -> int:
         "materialized)",
     )
     ev.set_defaults(fn=cmd_eval)
+
+    im = sub.add_parser(
+        "import-caffemodel",
+        help="migrate a trained .caffemodel trunk to a --weights file",
+    )
+    im.add_argument("--weights", required=True, help=".caffemodel path")
+    im.add_argument(
+        "--model", default="googlenet",
+        help="target model (plain googlenet; train --weights converts "
+        "to s2d/fused layouts automatically)",
+    )
+    im.add_argument("--out", default="./pretrained.msgpack")
+    im.set_defaults(fn=cmd_import_caffemodel)
+
+    exp = sub.add_parser(
+        "export-caffemodel",
+        help="write a trunk trained here back out as .caffemodel",
+    )
+    exp.add_argument(
+        "--weights", required=True,
+        help="params .msgpack (from import-caffemodel or a converted "
+        "snapshot)",
+    )
+    exp.add_argument("--out", default="./model.caffemodel")
+    exp.set_defaults(fn=cmd_export_caffemodel)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")
     pp.add_argument("file")

@@ -1,0 +1,128 @@
+"""Caffe <-> Flax weight migration for the GoogLeNet trunk.
+
+The reference is a layer inside a Caffe fork; its users' trained assets
+are ``.caffemodel`` files over the standard bvlc_googlenet layer names
+(the reference net template spells out ``conv1/7x7_s2`` and elides the
+canonical middle, usage/def.prototxt:85-111).  This module maps those
+blobs onto ``models.googlenet.GoogLeNetEmbedding`` parameters — and
+back, so a trunk finetuned here can be deployed into an existing Caffe
+retrieval stack.
+
+Layout notes:
+  * Caffe conv kernels are OIHW; Flax wants HWIO — ``transpose(2,3,1,0)``.
+  * Both run cross-correlation (no kernel flip): the weights carry over
+    directly.
+  * Boundary caveat: Caffe pads conv1 symmetrically (pad: 3) while this
+    trunk uses SAME (pad (2,3) at 224/s2) — identical output shapes,
+    border-pixel differences only.  Retrieval embeddings are robust to
+    this; exact-parity work would pin explicit padding.
+  * Only the embedding trunk (through pool5/7x7_s1) migrates: the
+    reference's aux-classifier heads (loss1/*, loss2/*, loss3/fc...)
+    have no counterpart in the metric-learning deployment and are
+    ignored on import.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+# Our param-tree block name -> caffe layer name.
+_STEM = {
+    "conv1": "conv1/7x7_s2",
+    "conv2_reduce": "conv2/3x3_reduce",
+    "conv2": "conv2/3x3",
+}
+_BRANCH = {
+    "b1x1": "1x1",
+    "b3x3_reduce": "3x3_reduce",
+    "b3x3": "3x3",
+    "b5x5_reduce": "5x5_reduce",
+    "b5x5": "5x5",
+    "pool_proj": "pool_proj",
+}
+_STAGES = ("3a", "3b", "4a", "4b", "4c", "4d", "4e", "5a", "5b")
+
+
+def caffe_layer_map() -> Dict[str, str]:
+    """{(our block path "inception_3a/b1x1" | "conv1") : caffe name}."""
+    out = dict(_STEM)
+    for stage in _STAGES:
+        for ours, theirs in _BRANCH.items():
+            out[f"inception_{stage}/{ours}"] = f"inception_{stage}/{theirs}"
+    return out
+
+
+def googlenet_params_from_caffemodel(
+    blobs: Dict[str, List[np.ndarray]], params,
+):
+    """New params for ``GoogLeNetEmbedding`` from caffemodel blobs.
+
+    ``params`` is the target param tree (from ``model.init``) — used for
+    shape validation and to carry any entries the caffemodel lacks.
+    Raises KeyError/ValueError on missing layers or shape mismatches
+    (silent partial loads corrupt finetunes).  Import the PLAIN trunk
+    and apply `conv1_kernel_to_s2d` / `fuse_inception_1x1_params`
+    afterwards for the MXU variants.
+    """
+    import jax
+
+    new = jax.tree_util.tree_map(lambda x: x, params)
+    for path, caffe_name in caffe_layer_map().items():
+        if caffe_name not in blobs:
+            raise KeyError(
+                f"caffemodel is missing layer {caffe_name!r} "
+                f"(wanted for {path})"
+            )
+        parts = path.split("/")
+        node = new
+        for p in parts:
+            node = node[p]
+        conv = node["Conv_0"]
+        want = tuple(conv["kernel"].shape)  # HWIO
+        k = np.asarray(blobs[caffe_name][0], dtype=np.float32)
+        if k.ndim != 4:
+            raise ValueError(
+                f"{caffe_name}: kernel blob has shape {k.shape}, wanted 4-D"
+            )
+        k = k.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        if tuple(k.shape) != want:
+            raise ValueError(
+                f"{caffe_name}: kernel {k.shape} vs model {want}"
+            )
+        conv["kernel"] = k
+        if "bias" in conv:
+            if len(blobs[caffe_name]) < 2:
+                raise ValueError(f"{caffe_name}: missing bias blob")
+            b = np.asarray(
+                blobs[caffe_name][1], dtype=np.float32
+            ).reshape(-1)
+            if b.shape != tuple(conv["bias"].shape):
+                raise ValueError(
+                    f"{caffe_name}: bias {b.shape} vs model "
+                    f"{conv['bias'].shape}"
+                )
+            conv["bias"] = b
+    return new
+
+
+def caffemodel_layers_from_googlenet_params(
+    params,
+) -> Dict[str, List[np.ndarray]]:
+    """The reverse mapping: {caffe layer name: [kernel OIHW, bias]}.
+
+    Feed to ``config.caffemodel.write_caffemodel`` to hand a trunk
+    trained here back to a Caffe deployment."""
+    out: Dict[str, List[np.ndarray]] = {}
+    for path, caffe_name in caffe_layer_map().items():
+        node = params
+        for p in path.split("/"):
+            node = node[p]
+        conv = node["Conv_0"]
+        k = np.asarray(conv["kernel"]).transpose(3, 2, 0, 1)  # HWIO -> OIHW
+        blobs = [k.astype(np.float32)]
+        if "bias" in conv:
+            blobs.append(np.asarray(conv["bias"], dtype=np.float32))
+        out[caffe_name] = blobs
+    return out

@@ -457,6 +457,32 @@ class Solver:
         log.info("snapshot -> %s", path)
         return path
 
+    def load_params(self, params):
+        """Start from externally-loaded parameters (the pretrained-weights
+        finetune workflow — e.g. a migrated .caffemodel trunk).
+
+        Structure/shape must match the model's own init tree (enforced by
+        the tree_map below — a silent partial load corrupts finetunes);
+        values are cast to the model's dtypes.  The optimizer state
+        re-initializes (fresh momentum) and batch_stats keep their init.
+        """
+        if self.state is None:
+            self.init()
+        cur = self.state["params"]
+        new = jax.tree_util.tree_map(
+            lambda c, n: jnp.asarray(np.asarray(n), dtype=c.dtype),
+            cur,
+            params,
+        )
+        state = dict(self.state)
+        state["params"] = new
+        state["opt"] = self.tx.init(new)
+        if self.mesh is not None:
+            replicated = NamedSharding(self.mesh, P())
+            state = jax.device_put(state, replicated)
+        self.state = state
+        return self.state
+
     def restore_snapshot(self, path: str):
         if self.state is None:
             self.init()

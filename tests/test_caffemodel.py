@@ -1,0 +1,216 @@
+"""Caffemodel weight migration: wire format, GoogLeNet mapping, CLI.
+
+The reference's users hold trained .caffemodel files (binary-protobuf
+NetParameter over bvlc_googlenet layer names, usage/def.prototxt:85-111);
+config.caffemodel + models.caffe_import are the migration path in and
+out of this framework.  No real caffemodel is fetchable here, so the
+tests pin BOTH directions against each other (export -> bytes ->
+import == identity) plus hand-built wire encodings for the legacy
+V1/old-shape forms.
+"""
+
+import json
+import struct
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from npairloss_tpu.config.caffemodel import (
+    parse_caffemodel,
+    write_caffemodel,
+)
+from npairloss_tpu.models import get_model
+from npairloss_tpu.models.caffe_import import (
+    caffe_layer_map,
+    caffemodel_layers_from_googlenet_params,
+    googlenet_params_from_caffemodel,
+)
+
+
+def test_wire_roundtrip():
+    rng = np.random.default_rng(0)
+    layers = {
+        "conv1/7x7_s2": [
+            rng.standard_normal((64, 3, 7, 7)).astype(np.float32),
+            rng.standard_normal((64,)).astype(np.float32),
+        ],
+        "odd/λ-name": [rng.standard_normal((2, 3)).astype(np.float32)],
+    }
+    back = parse_caffemodel(write_caffemodel(layers))
+    assert sorted(back) == sorted(layers)
+    for name in layers:
+        assert len(back[name]) == len(layers[name])
+        for a, b in zip(layers[name], back[name]):
+            np.testing.assert_array_equal(a, b)
+
+
+def _varint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _len_field(num, payload):
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def test_parses_legacy_v1_layers_and_old_shape():
+    """Old caffemodels use `layers` (field 2, V1LayerParameter: name=4,
+    blobs=6) and 4-D num/channels/height/width blob shapes."""
+    data = np.arange(24, dtype=np.float32)
+    blob = (
+        _varint((1 << 3) | 0) + _varint(2)    # num = 2
+        + _varint((2 << 3) | 0) + _varint(3)  # channels = 3
+        + _varint((3 << 3) | 0) + _varint(2)  # height = 2
+        + _varint((4 << 3) | 0) + _varint(2)  # width = 2
+        + _len_field(5, data.tobytes())       # packed float data
+    )
+    v1_layer = _len_field(4, b"legacy") + _len_field(6, blob)
+    net = _len_field(1, b"net") + _len_field(2, v1_layer)
+    out = parse_caffemodel(net)
+    assert list(out) == ["legacy"]
+    assert out["legacy"][0].shape == (2, 3, 2, 2)
+    np.testing.assert_array_equal(
+        out["legacy"][0].reshape(-1), data
+    )
+
+
+def test_skips_unknown_fields_and_bloblless_layers():
+    layer = (
+        _len_field(1, b"data")                        # name, no blobs
+        + _len_field(2, b"MultibatchData")            # type
+        + _varint((33 << 3) | 0) + _varint(7)         # unknown varint
+        + _len_field(44, b"\x01\x02\x03")             # unknown LEN
+    )
+    net = _len_field(100, layer)
+    assert parse_caffemodel(net) == {}
+
+
+@pytest.fixture(scope="module")
+def plain_params():
+    m = get_model("googlenet", dtype=jnp.float32)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    return m.init(jax.random.PRNGKey(0), x, train=False)["params"]
+
+
+def test_googlenet_mapping_covers_trunk(plain_params):
+    mapping = caffe_layer_map()
+    # 3 stem convs + 9 stages x 6 branch convs
+    assert len(mapping) == 3 + 9 * 6
+    for path in mapping:
+        node = plain_params
+        for p in path.split("/"):
+            assert p in node, (path, sorted(node))
+            node = node[p]
+        assert "Conv_0" in node
+
+
+def test_googlenet_caffemodel_roundtrip(plain_params):
+    """export -> caffemodel bytes -> import reproduces every conv
+    kernel/bias exactly (pins the OIHW<->HWIO transposes against each
+    other — a single wrong axis breaks equality)."""
+    layers = caffemodel_layers_from_googlenet_params(plain_params)
+    blob = write_caffemodel(layers)
+    back_blobs = parse_caffemodel(blob)
+    template = jax.tree_util.tree_map(
+        lambda a: np.zeros_like(np.asarray(a)), plain_params
+    )
+    back = googlenet_params_from_caffemodel(back_blobs, template)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        plain_params, back,
+    )
+
+
+def test_import_rejects_missing_and_mismatched(plain_params):
+    layers = caffemodel_layers_from_googlenet_params(plain_params)
+    template = jax.tree_util.tree_map(
+        lambda a: np.zeros_like(np.asarray(a)), plain_params
+    )
+    missing = dict(parse_caffemodel(write_caffemodel(layers)))
+    missing.pop("inception_4c/5x5")
+    with pytest.raises(KeyError, match="inception_4c/5x5"):
+        googlenet_params_from_caffemodel(missing, template)
+
+    bad = dict(parse_caffemodel(write_caffemodel(layers)))
+    bad["conv2/3x3"] = [bad["conv2/3x3"][0][:, :, :1, :1],
+                        bad["conv2/3x3"][1]]
+    with pytest.raises(ValueError, match="conv2/3x3"):
+        googlenet_params_from_caffemodel(bad, template)
+
+
+def test_solver_load_params_resets_opt_and_casts():
+    from npairloss_tpu import NPairLossConfig
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    solver = Solver(
+        get_model("mlp", hidden=(8,), embedding_dim=4),
+        NPairLossConfig(),
+        SolverConfig(base_lr=0.1, lr_policy="fixed", display=0, snapshot=0),
+        input_shape=(6,),
+    )
+    solver.init()
+    rng = np.random.default_rng(3)
+    new = jax.tree_util.tree_map(
+        lambda a: rng.standard_normal(a.shape).astype(np.float64),
+        solver.state["params"],
+    )
+    solver.load_params(new)
+    got = solver.state["params"]
+    jax.tree_util.tree_map(
+        lambda g, n: np.testing.assert_allclose(
+            np.asarray(g), n.astype(np.float32), rtol=1e-6
+        ),
+        got, new,
+    )
+    # structure mismatch is a loud error, not a partial load
+    with pytest.raises(Exception):
+        solver.load_params({"wrong": np.zeros(3)})
+
+
+def test_cli_import_export_roundtrip(tmp_path, plain_params):
+    """The migration workflow end-to-end through the CLI: caffemodel ->
+    import-caffemodel -> msgpack -> export-caffemodel -> identical
+    blobs."""
+    src = tmp_path / "ref.caffemodel"
+    src.write_bytes(write_caffemodel(
+        caffemodel_layers_from_googlenet_params(plain_params)
+    ))
+
+    def cli(*args):
+        proc = subprocess.run(
+            [sys.executable, "-m", "npairloss_tpu", "--platform", "cpu",
+             *args],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    imported = tmp_path / "pre.msgpack"
+    rec = cli("import-caffemodel", "--weights", str(src),
+              "--out", str(imported))
+    assert rec["mapped_convs"] == 57 and imported.exists()
+
+    exported = tmp_path / "back.caffemodel"
+    rec2 = cli("export-caffemodel", "--weights", str(imported),
+               "--out", str(exported))
+    assert rec2["layers"] == 57
+
+    a = parse_caffemodel(src.read_bytes())
+    b = parse_caffemodel(exported.read_bytes())
+    assert sorted(a) == sorted(b)
+    for name in a:
+        for x, y in zip(a[name], b[name]):
+            np.testing.assert_array_equal(x, y)
