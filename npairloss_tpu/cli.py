@@ -312,12 +312,21 @@ def cmd_extract(args) -> int:
 
 
 def _load_weights_into(solver, path: str):
-    """Load a msgpack params file into a solver, auto-converting to the
-    model's MXU-variant layout when needed (s2d stem / fused 1x1s)."""
+    """Load a msgpack weights file into a solver, auto-converting to the
+    model's MXU-variant layout when needed (s2d stem / fused 1x1s).
+
+    Accepts the wrapped {"params", "batch_stats"} form written by
+    import-caffemodel, or a bare params tree."""
     import flax.serialization
 
     with open(path, "rb") as f:
-        params = flax.serialization.msgpack_restore(f.read())
+        tree = flax.serialization.msgpack_restore(f.read())
+    batch_stats = None
+    if isinstance(tree, dict) and set(tree) <= {"params", "batch_stats"}:
+        params = tree["params"]
+        batch_stats = tree.get("batch_stats") or None
+    else:
+        params = tree
     model = solver.model
     if getattr(model, "stem_s2d", False):
         from npairloss_tpu.models.layers import conv1_kernel_to_s2d
@@ -331,8 +340,8 @@ def _load_weights_into(solver, path: str):
     ):
         from npairloss_tpu.models import fuse_inception_1x1_params
 
-        params, _ = fuse_inception_1x1_params(params)
-    solver.load_params(params)
+        params, batch_stats = fuse_inception_1x1_params(params, batch_stats)
+    solver.load_params(params, batch_stats)
     log.info("loaded pretrained params from %s", path)
 
 
@@ -349,6 +358,7 @@ def cmd_import_caffemodel(args) -> int:
     from npairloss_tpu.models.caffe_import import (
         caffe_layer_map,
         googlenet_params_from_caffemodel,
+        resnet50_params_from_caffemodel,
     )
 
     with open(args.weights, "rb") as f:
@@ -364,16 +374,29 @@ def cmd_import_caffemodel(args) -> int:
             train=False,
         )
     )
-    template = jax.tree_util.tree_map(
-        lambda s: np.zeros(s.shape, np.float32), variables["params"]
+    zeros = lambda tree: jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, np.float32), tree
     )
-    params = googlenet_params_from_caffemodel(blobs, template)
+    if "resnet" in args.model.lower():
+        params, batch_stats = resnet50_params_from_caffemodel(
+            blobs, zeros(variables["params"]),
+            zeros(variables["batch_stats"]),
+        )
+        mapped = len(jax.tree_util.tree_leaves(params))
+    else:
+        params = googlenet_params_from_caffemodel(
+            blobs, zeros(variables["params"])
+        )
+        batch_stats = {}
+        mapped = len(caffe_layer_map())
     with open(args.out, "wb") as f:
-        f.write(flax.serialization.msgpack_serialize(params))
+        f.write(flax.serialization.msgpack_serialize(
+            {"params": params, "batch_stats": batch_stats}
+        ))
     print(json.dumps({
         "out": args.out,
         "caffemodel_layers": len(blobs),
-        "mapped_convs": len(caffe_layer_map()),
+        "mapped_convs": mapped,
     }))
     return 0
 
@@ -386,11 +409,21 @@ def cmd_export_caffemodel(args) -> int:
     from npairloss_tpu.config.caffemodel import write_caffemodel
     from npairloss_tpu.models.caffe_import import (
         caffemodel_layers_from_googlenet_params,
+        caffemodel_layers_from_resnet50_params,
     )
 
     with open(args.weights, "rb") as f:
-        params = flax.serialization.msgpack_restore(f.read())
-    layers = caffemodel_layers_from_googlenet_params(params)
+        tree = flax.serialization.msgpack_restore(f.read())
+    batch_stats = {}
+    if isinstance(tree, dict) and set(tree) <= {"params", "batch_stats"}:
+        params = tree["params"]
+        batch_stats = tree.get("batch_stats") or {}
+    else:
+        params = tree
+    if "resnet" in args.model.lower():
+        layers = caffemodel_layers_from_resnet50_params(params, batch_stats)
+    else:
+        layers = caffemodel_layers_from_googlenet_params(params)
     blob = write_caffemodel(layers)
     with open(args.out, "wb") as f:
         f.write(blob)
@@ -609,6 +642,10 @@ def main(argv: Optional[list] = None) -> int:
         "--weights", required=True,
         help="params .msgpack (from import-caffemodel or a converted "
         "snapshot)",
+    )
+    exp.add_argument(
+        "--model", default="googlenet",
+        help="trunk family the weights belong to (googlenet | resnet50)",
     )
     exp.add_argument("--out", default="./model.caffemodel")
     exp.set_defaults(fn=cmd_export_caffemodel)

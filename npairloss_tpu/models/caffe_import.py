@@ -126,3 +126,138 @@ def caffemodel_layers_from_googlenet_params(
             blobs.append(np.asarray(conv["bias"], dtype=np.float32))
         out[caffe_name] = blobs
     return out
+
+
+# -- ResNet-50 (BASELINE.json config 3's trunk) -----------------------------
+#
+# Caffe ResNet-50 (the canonical release the reference era used) names
+# convs ``res{stage}{letter}_branch{1,2a,2b,2c}`` with separate
+# ``bn*`` (mean, var, scale_factor) and ``scale*`` (gamma, beta) layers;
+# our trunk is models/resnet.py (conv_stem/bn_stem +
+# stage{s}_block{b}/{conv1..3,conv_proj,bn1..3,bn_proj}).
+#
+# Stride caveat: Caffe ResNet-50 is v1 (stride 2 on the 1x1 branch2a);
+# this trunk is v1.5-style (stride on the 3x3).  Kernel SHAPES are
+# identical, so the weights migrate cleanly as a finetune init — the
+# same shape-compatible transfer torchvision's v1.5 popularized.
+
+_RESNET_BRANCH = {
+    "conv1": "branch2a", "bn1": "branch2a",
+    "conv2": "branch2b", "bn2": "branch2b",
+    "conv3": "branch2c", "bn3": "branch2c",
+    "conv_proj": "branch1", "bn_proj": "branch1",
+}
+
+
+def _resnet_block_names(stage_sizes=(3, 4, 6, 3)):
+    """[(ours_block, caffe_block)] e.g. ("stage1_block1", "2a")."""
+    out = []
+    for s, n in enumerate(stage_sizes):
+        for b in range(n):
+            out.append((f"stage{s + 1}_block{b + 1}",
+                        f"{s + 2}{chr(ord('a') + b)}"))
+    return out
+
+
+def _caffe_bn(blobs, bn_name, scale_name, want_c):
+    """(scale, bias, mean, var) from a Caffe BatchNorm + Scale pair.
+
+    Caffe's BatchNorm stores running sums times a scale_factor blob;
+    gamma/beta live in the separate Scale layer."""
+    if bn_name not in blobs:
+        raise KeyError(f"caffemodel is missing layer {bn_name!r}")
+    if scale_name not in blobs:
+        raise KeyError(f"caffemodel is missing layer {scale_name!r}")
+    bn = [np.asarray(b, np.float32).reshape(-1) for b in blobs[bn_name]]
+    sc = [np.asarray(b, np.float32).reshape(-1) for b in blobs[scale_name]]
+    if len(bn) < 2 or len(sc) < 2:
+        raise ValueError(f"{bn_name}/{scale_name}: unexpected blob count")
+    factor = float(bn[2][0]) if len(bn) > 2 and bn[2].size else 1.0
+    factor = factor if factor != 0.0 else 1.0
+    mean, var = bn[0] / factor, bn[1] / factor
+    gamma, beta = sc[0], sc[1]
+    for name, arr in (("mean", mean), ("var", var),
+                      ("gamma", gamma), ("beta", beta)):
+        if arr.shape != (want_c,):
+            raise ValueError(
+                f"{bn_name}: {name} has shape {arr.shape}, wanted ({want_c},)"
+            )
+    return gamma, beta, mean, var
+
+
+def resnet50_params_from_caffemodel(blobs, params, batch_stats):
+    """(params, batch_stats) for ``ResNetEmbedding(stage_sizes=(3,4,6,3))``
+    from canonical Caffe ResNet-50 blobs.  Loud on missing layers and
+    shape mismatches, like the GoogLeNet path."""
+    import jax
+
+    new_p = jax.tree_util.tree_map(lambda x: x, params)
+    new_s = jax.tree_util.tree_map(lambda x: x, batch_stats)
+
+    def set_conv(node, caffe_name):
+        k = np.asarray(blobs[caffe_name][0], np.float32)
+        if k.ndim != 4:
+            raise ValueError(f"{caffe_name}: kernel {k.shape} not 4-D")
+        k = k.transpose(2, 3, 1, 0)
+        want = tuple(np.shape(node["kernel"]))
+        if tuple(k.shape) != want:
+            raise ValueError(f"{caffe_name}: kernel {k.shape} vs {want}")
+        node["kernel"] = k
+
+    def set_bn(p_node, s_node, bn_name, scale_name):
+        c = int(np.shape(p_node["scale"])[0])
+        gamma, beta, mean, var = _caffe_bn(blobs, bn_name, scale_name, c)
+        p_node["scale"], p_node["bias"] = gamma, beta
+        s_node["mean"], s_node["var"] = mean, var
+
+    if "conv1" not in blobs:
+        raise KeyError("caffemodel is missing layer 'conv1'")
+    set_conv(new_p["conv_stem"], "conv1")
+    set_bn(new_p["bn_stem"], new_s["bn_stem"], "bn_conv1", "scale_conv1")
+
+    for ours_block, cb in _resnet_block_names():
+        p_blk, s_blk = new_p[ours_block], new_s[ours_block]
+        for ours, branch in _RESNET_BRANCH.items():
+            if ours not in p_blk:
+                continue  # non-proj blocks have no conv_proj/bn_proj
+            if ours.startswith("conv"):
+                name = f"res{cb}_{branch}"
+                if name not in blobs:
+                    raise KeyError(f"caffemodel is missing layer {name!r}")
+                set_conv(p_blk[ours], name)
+            else:
+                set_bn(p_blk[ours], s_blk[ours],
+                       f"bn{cb}_{branch}", f"scale{cb}_{branch}")
+    return new_p, new_s
+
+
+def caffemodel_layers_from_resnet50_params(params, batch_stats):
+    """Reverse mapping: canonical Caffe ResNet-50 layer blobs
+    (BatchNorm scale_factor written as 1)."""
+    out: Dict[str, List[np.ndarray]] = {}
+
+    def put(conv_node, bn_node, stats_node, conv_name, bn_name, scale_name):
+        k = np.asarray(conv_node["kernel"], np.float32).transpose(3, 2, 0, 1)
+        out[conv_name] = [k]
+        out[bn_name] = [
+            np.asarray(stats_node["mean"], np.float32),
+            np.asarray(stats_node["var"], np.float32),
+            np.ones((1,), np.float32),
+        ]
+        out[scale_name] = [
+            np.asarray(bn_node["scale"], np.float32),
+            np.asarray(bn_node["bias"], np.float32),
+        ]
+
+    put(params["conv_stem"], params["bn_stem"], batch_stats["bn_stem"],
+        "conv1", "bn_conv1", "scale_conv1")
+    for ours_block, cb in _resnet_block_names():
+        p_blk, s_blk = params[ours_block], batch_stats[ours_block]
+        for ours, branch in _RESNET_BRANCH.items():
+            if ours not in p_blk or not ours.startswith("conv"):
+                continue
+            bn = ours.replace("conv", "bn")
+            put(p_blk[ours], p_blk[bn], s_blk[bn],
+                f"res{cb}_{branch}",
+                f"bn{cb}_{branch}", f"scale{cb}_{branch}")
+    return out

@@ -457,14 +457,15 @@ class Solver:
         log.info("snapshot -> %s", path)
         return path
 
-    def load_params(self, params):
+    def load_params(self, params, batch_stats=None):
         """Start from externally-loaded parameters (the pretrained-weights
         finetune workflow — e.g. a migrated .caffemodel trunk).
 
         Structure/shape must match the model's own init tree (enforced by
         the tree_map below — a silent partial load corrupts finetunes);
         values are cast to the model's dtypes.  The optimizer state
-        re-initializes (fresh momentum) and batch_stats keep their init.
+        re-initializes (fresh momentum); ``batch_stats`` (BN trunks:
+        migrated running mean/var) replace the init stats when given.
         """
         if self.state is None:
             self.init()
@@ -477,6 +478,12 @@ class Solver:
         state = dict(self.state)
         state["params"] = new
         state["opt"] = self.tx.init(new)
+        if batch_stats is not None:
+            state["batch_stats"] = jax.tree_util.tree_map(
+                lambda c, n: jnp.asarray(np.asarray(n), dtype=c.dtype),
+                self.state["batch_stats"],
+                batch_stats,
+            )
         if self.mesh is not None:
             replicated = NamedSharding(self.mesh, P())
             state = jax.device_put(state, replicated)

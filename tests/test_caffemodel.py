@@ -214,3 +214,71 @@ def test_cli_import_export_roundtrip(tmp_path, plain_params):
     for name in a:
         for x, y in zip(a[name], b[name]):
             np.testing.assert_array_equal(x, y)
+
+
+@pytest.fixture(scope="module")
+def resnet_variables():
+    # resnet18 shares the block/naming code with resnet50 but inits in
+    # seconds on CPU; the mapping is parameterized by stage_sizes.
+    m = get_model("resnet18", dtype=jnp.float32)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    return m.init(jax.random.PRNGKey(2), x, train=False)
+
+
+def test_resnet_caffemodel_roundtrip(resnet_variables):
+    """Caffe ResNet encodes BN as BatchNorm (mean,var,scale_factor) +
+    Scale (gamma,beta) layer pairs; export -> bytes -> import must
+    reproduce params AND batch_stats exactly.  resnet18's stage_sizes
+    exercise the same mapping code as resnet50."""
+    from npairloss_tpu.models.caffe_import import (
+        caffemodel_layers_from_resnet50_params,
+        resnet50_params_from_caffemodel,
+    )
+
+    params = resnet_variables["params"]
+    stats = resnet_variables["batch_stats"]
+    import npairloss_tpu.models.caffe_import as ci
+
+    orig = ci._resnet_block_names
+
+    def block_names(stage_sizes=(2, 2, 2, 2)):
+        return orig(stage_sizes)
+
+    ci._resnet_block_names = block_names
+    try:
+        layers = caffemodel_layers_from_resnet50_params(params, stats)
+        blobs = parse_caffemodel(write_caffemodel(layers))
+        zeros = lambda t: jax.tree_util.tree_map(
+            lambda a: np.zeros_like(np.asarray(a)), t)
+        back_p, back_s = resnet50_params_from_caffemodel(
+            blobs, zeros(params), zeros(stats))
+    finally:
+        ci._resnet_block_names = orig
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), params, back_p)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), stats, back_s)
+
+
+def test_resnet_import_applies_caffe_bn_scale_factor(resnet_variables):
+    """Caffe BatchNorm blobs are running SUMS times a scale_factor —
+    the import must divide it out."""
+    from npairloss_tpu.models.caffe_import import _caffe_bn
+
+    gamma = np.arange(4, dtype=np.float32) + 1
+    beta = np.arange(4, dtype=np.float32)
+    mean = np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)
+    var = np.asarray([0.5, 0.5, 0.5, 0.5], np.float32)
+    factor = 5.0
+    blobs = {
+        "bn_x": [mean * factor, var * factor,
+                 np.asarray([factor], np.float32)],
+        "scale_x": [gamma, beta],
+    }
+    g, b, m, v = _caffe_bn(blobs, "bn_x", "scale_x", 4)
+    np.testing.assert_allclose(m, mean)
+    np.testing.assert_allclose(v, var)
+    np.testing.assert_array_equal(g, gamma)
+    np.testing.assert_array_equal(b, beta)
