@@ -130,3 +130,43 @@ def test_cli_extract_command(tmp_path, capsys):
     np.testing.assert_allclose(
         np.linalg.norm(emb, axis=1), 1.0, rtol=1e-4
     )
+
+
+def test_train_weights_finetune_start(tmp_path):
+    """--weights starts training from an externally-supplied params
+    file (the caffemodel-migration finetune workflow).  The load is
+    structure-enforced by Solver.load_params — a tree mismatch fails
+    loudly, so rc 0 here means the marked params were accepted and
+    loaded."""
+    import flax.serialization
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from npairloss_tpu import NPairLossConfig
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    solver = Solver(
+        get_model("mlp"),
+        NPairLossConfig(),
+        SolverConfig(base_lr=0.0, lr_policy="fixed", display=0, snapshot=0),
+        input_shape=(8, 8, 3),
+    )
+    solver.init()
+    rng = np.random.default_rng(9)
+    marked = jax.tree_util.tree_map(
+        lambda a: rng.standard_normal(a.shape).astype(np.float32),
+        solver.state["params"],
+    )
+    wfile = tmp_path / "pre.msgpack"
+    wfile.write_bytes(flax.serialization.msgpack_serialize(
+        {"params": marked, "batch_stats": {}}
+    ))
+
+    rc = main([
+        "train", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--max_iter", "1", "--synthetic",
+        "--weights", str(wfile),
+    ])
+    assert rc == 0
