@@ -406,16 +406,29 @@ def cmd_export_caffemodel(args) -> int:
     a Caffe deployment stack can consume."""
     import flax.serialization
 
+    if not args.weights and not args.snapshot:
+        log.error("pass --weights (msgpack) or --snapshot (.ckpt dir)")
+        return 2
+
     from npairloss_tpu.config.caffemodel import write_caffemodel
     from npairloss_tpu.models.caffe_import import (
         caffemodel_layers_from_googlenet_params,
         caffemodel_layers_from_resnet50_params,
     )
 
-    with open(args.weights, "rb") as f:
-        tree = flax.serialization.msgpack_restore(f.read())
+    if args.snapshot:
+        # Straight from a training snapshot: restore the raw Orbax tree
+        # (params / batch_stats / opt) without needing a Solver.
+        import orbax.checkpoint as ocp
+
+        tree = ocp.StandardCheckpointer().restore(
+            os.path.abspath(args.snapshot)
+        )
+    else:
+        with open(args.weights, "rb") as f:
+            tree = flax.serialization.msgpack_restore(f.read())
     batch_stats = {}
-    if isinstance(tree, dict) and set(tree) <= {"params", "batch_stats"}:
+    if isinstance(tree, dict) and "params" in tree:
         params = tree["params"]
         batch_stats = tree.get("batch_stats") or {}
     else:
@@ -639,9 +652,13 @@ def main(argv: Optional[list] = None) -> int:
         help="write a trunk trained here back out as .caffemodel",
     )
     exp.add_argument(
-        "--weights", required=True,
-        help="params .msgpack (from import-caffemodel or a converted "
-        "snapshot)",
+        "--weights",
+        help="params .msgpack (from import-caffemodel)",
+    )
+    exp.add_argument(
+        "--snapshot",
+        help="export straight from a training snapshot (.ckpt dir) "
+        "instead of --weights",
     )
     exp.add_argument(
         "--model", default="googlenet",

@@ -282,3 +282,38 @@ def test_resnet_import_applies_caffe_bn_scale_factor(resnet_variables):
     np.testing.assert_allclose(v, var)
     np.testing.assert_array_equal(g, gamma)
     np.testing.assert_array_equal(b, beta)
+
+
+def test_cli_export_from_snapshot(tmp_path, plain_params):
+    """train -> snapshot -> export-caffemodel --snapshot: the deploy
+    path for a trunk trained HERE, no msgpack intermediary."""
+    from npairloss_tpu import NPairLossConfig
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    solver = Solver(
+        get_model("googlenet", dtype=jnp.float32),
+        NPairLossConfig(),
+        SolverConfig(
+            base_lr=0.0, lr_policy="fixed", display=0, snapshot=0,
+            snapshot_prefix=str(tmp_path / "snap_"),
+        ),
+        input_shape=(64, 64, 3),
+    )
+    solver.init()
+    solver.load_params(plain_params)
+    snap = solver.save_snapshot(1)
+    solver._ckpt().wait_until_finished()
+
+    out = tmp_path / "deploy.caffemodel"
+    proc = subprocess.run(
+        [sys.executable, "-m", "npairloss_tpu", "--platform", "cpu",
+         "export-caffemodel", "--snapshot", snap, "--out", str(out)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    blobs = parse_caffemodel(out.read_bytes())
+    assert len(blobs) == 57
+    np.testing.assert_array_equal(
+        blobs["conv1/7x7_s2"][0].transpose(2, 3, 1, 0),
+        np.asarray(plain_params["conv1"]["Conv_0"]["kernel"]),
+    )
