@@ -680,7 +680,7 @@ def _degraded_record(platform_status: str, fresh_rec):
     return out
 
 
-def main() -> int:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true", help="tiny 5-step bench only")
     ap.add_argument("--steps", type=int, default=20)
@@ -699,7 +699,7 @@ def main() -> int:
     ap.add_argument("--child", choices=["probe", "full", "smoke"])
     ap.add_argument("--platform", default="default")
     ap.add_argument("--soft-budget", type=float, default=900.0)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     if args.child == "probe":
         return child_probe(args.platform)

@@ -502,7 +502,9 @@ def cmd_bench(args) -> int:
     )
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
-    bench.main()
+    # Forward only the subcommand's own args — bench.main would
+    # otherwise re-parse the full argv (incl. the word "bench") and die.
+    bench.main(list(args.bench_args or []))
     return 0
 
 
@@ -673,9 +675,21 @@ def main(argv: Optional[list] = None) -> int:
     pp.set_defaults(fn=cmd_parse)
 
     b = sub.add_parser("bench", help="run the benchmark")
-    b.set_defaults(fn=cmd_bench)
+    b.set_defaults(fn=cmd_bench, bench_args=[])
+
+    # Everything after the literal "bench" goes to bench.py verbatim
+    # (argparse REMAINDER in a subparser cannot capture leading
+    # optionals like --smoke).
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    bench_args = []
+    if "bench" in argv:
+        idx = argv.index("bench")
+        bench_args = argv[idx + 1:]
+        argv = argv[:idx + 1]
 
     args = p.parse_args(argv)
+    if getattr(args, "fn", None) is cmd_bench:
+        args.bench_args = bench_args
     if args.platform != "default":
         import jax
 

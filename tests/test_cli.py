@@ -170,3 +170,23 @@ def test_train_weights_finetune_start(tmp_path):
         "--weights", str(wfile),
     ])
     assert rc == 0
+
+
+def test_bench_subcommand_forwards_args(monkeypatch):
+    """`npairloss_tpu bench --smoke` must forward --smoke to bench.py
+    instead of dying on argv re-parsing (argparse REMAINDER cannot
+    capture leading optionals in a subparser)."""
+    import npairloss_tpu.cli as cli
+
+    seen = {}
+
+    def fake_bench(args):
+        seen["bench_args"] = args.bench_args
+        return 0
+
+    # main() builds its parser per call and resolves cmd_bench from
+    # module globals, so the patch takes effect.
+    monkeypatch.setattr(cli, "cmd_bench", fake_bench)
+    rc = cli.main(["bench", "--smoke", "--steps", "3"])
+    assert rc == 0
+    assert seen["bench_args"] == ["--smoke", "--steps", "3"]
