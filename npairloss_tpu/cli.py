@@ -473,12 +473,19 @@ def cmd_eval(args) -> int:
     m = evaluate_embeddings(
         emb, lab, ks=tuple(args.ks), query_block=args.query_block
     )
-    print(json.dumps({
+    rec = {
         "gallery_size": int(emb.shape[0]),
         "dim": int(emb.shape[1]),
         "classes": int(np.unique(lab).shape[0]),
         **{k: round(v, 4) for k, v in m.items()},
-    }))
+    }
+    if args.nmi:
+        from npairloss_tpu.ops.eval_retrieval import clustering_nmi
+
+        rec["nmi"] = round(
+            clustering_nmi(emb, lab, iters=args.kmeans_iters), 4
+        )
+    print(json.dumps(rec))
     return 0
 
 
@@ -634,6 +641,12 @@ def main(argv: Optional[list] = None) -> int:
         help="queries per streamed block (the N x N matrix is never "
         "materialized)",
     )
+    ev.add_argument(
+        "--nmi", action="store_true",
+        help="also report clustering NMI (on-device k-means with "
+        "k = #classes — the CUB/SOP paper protocol's second number)",
+    )
+    ev.add_argument("--kmeans-iters", type=int, default=20)
     ev.set_defaults(fn=cmd_eval)
 
     im = sub.add_parser(

@@ -114,3 +114,99 @@ def evaluate_embeddings(
         ks=tuple(ks), query_block=query_block,
     )
     return {k: float(v) for k, v in out.items()}
+
+
+# -- clustering quality (the other half of the paper protocol) --------------
+#
+# CUB/SOP papers report NMI alongside Recall@K: k-means over the test
+# embeddings (k = number of classes), then normalized mutual information
+# between cluster assignments and ground-truth labels.
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def kmeans_assign(
+    embeddings: jax.Array,
+    k: int,
+    iters: int = 20,
+    seed: int = 0,
+) -> jax.Array:
+    """Lloyd's k-means on-device; returns the (N,) cluster assignment.
+
+    Centroids init from k distinct data points (seeded permutation);
+    empty clusters keep their previous centroid.  Euclidean on
+    L2-normalized embeddings == cosine, matching the retrieval metric.
+    """
+    n, d = embeddings.shape
+    x = embeddings.astype(jnp.float32)
+    perm = jax.random.permutation(jax.random.PRNGKey(seed), n)
+    centroids = x[perm[:k]]
+
+    def step(centroids, _):
+        # (N, k) squared distances via the expansion trick — no N x k x d
+        # intermediate.
+        sq = (
+            jnp.sum(x * x, 1, keepdims=True)
+            - 2.0 * x @ centroids.T
+            + jnp.sum(centroids * centroids, 1)[None, :]
+        )
+        assign = jnp.argmin(sq, axis=1)
+        one_hot = jax.nn.one_hot(assign, k, dtype=jnp.float32)
+        counts = one_hot.sum(0)
+        sums = one_hot.T @ x
+        new = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0),
+            centroids,
+        )
+        return new, None
+
+    centroids, _ = jax.lax.scan(step, centroids, None, length=iters)
+    sq = (
+        jnp.sum(x * x, 1, keepdims=True)
+        - 2.0 * x @ centroids.T
+        + jnp.sum(centroids * centroids, 1)[None, :]
+    )
+    return jnp.argmin(sq, axis=1)
+
+
+def nmi(labels_a: np.ndarray, labels_b: np.ndarray) -> float:
+    """Normalized mutual information, arithmetic normalization
+    2*I/(H_a + H_b) (sklearn's default ``average_method='arithmetic'``).
+
+    Host-side numpy: the contingency table is tiny (clusters x classes)
+    next to the embedding compute.
+    """
+    a = np.unique(np.asarray(labels_a), return_inverse=True)[1]
+    b = np.unique(np.asarray(labels_b), return_inverse=True)[1]
+    n = a.shape[0]
+    ka, kb = a.max() + 1, b.max() + 1
+    cont = np.zeros((ka, kb), np.float64)
+    np.add.at(cont, (a, b), 1.0)
+    pij = cont / n
+    pa = pij.sum(1)
+    pb = pij.sum(0)
+    nz = pij > 0
+    mi = float(np.sum(
+        pij[nz] * np.log(pij[nz] / np.outer(pa, pb)[nz])
+    ))
+    ent = lambda p: float(-np.sum(p[p > 0] * np.log(p[p > 0])))
+    denom = ent(pa) + ent(pb)
+    if denom == 0.0:
+        return 1.0  # both partitions trivial (single cluster == single class)
+    return max(0.0, min(1.0, 2.0 * mi / denom))
+
+
+def clustering_nmi(
+    embeddings: np.ndarray,
+    labels: np.ndarray,
+    k: int = 0,
+    iters: int = 20,
+    seed: int = 0,
+) -> float:
+    """NMI(k-means(embeddings), labels); k defaults to #classes."""
+    emb = np.asarray(embeddings, np.float32)
+    emb = emb / np.maximum(
+        np.linalg.norm(emb, axis=1, keepdims=True), 1e-12
+    )
+    k = int(k) or int(np.unique(labels).shape[0])
+    assign = np.asarray(kmeans_assign(jnp.asarray(emb), k, iters, seed))
+    return nmi(assign, labels)

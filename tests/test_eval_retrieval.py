@@ -116,3 +116,54 @@ def test_cli_eval_roundtrip(tmp_path):
     assert rec["recall_at_4"] == pytest.approx(
         want["recall_at_4"], abs=1e-4
     )
+
+
+def test_nmi_known_values():
+    from npairloss_tpu.ops.eval_retrieval import nmi
+
+    a = np.asarray([0, 0, 1, 1, 2, 2])
+    # identical partitions (relabeled) -> 1
+    assert nmi(a, a + 7) == pytest.approx(1.0)
+    # independent partitions -> 0 for this balanced crossing
+    b = np.asarray([0, 1, 0, 1, 0, 1])
+    assert nmi(a, b) == pytest.approx(0.0, abs=1e-12)
+    # hand-computed asymmetric case: clusters {0,0,1}, classes {0,1,1}
+    # I = sum p log(p/(pa pb)); H_a = H_b = entropy([1/3, 2/3])
+    pa = np.asarray([2 / 3, 1 / 3])
+    h = float(-(pa * np.log(pa)).sum())
+    # joint: (0,0)=1/3, (0,1)=1/3, (1,1)=1/3
+    i = (
+        1 / 3 * np.log((1 / 3) / (2 / 3 * 1 / 3))
+        + 1 / 3 * np.log((1 / 3) / (2 / 3 * 2 / 3))
+        + 1 / 3 * np.log((1 / 3) / (1 / 3 * 2 / 3))
+    )
+    want = 2 * i / (2 * h)
+    assert nmi(np.asarray([0, 0, 1]), np.asarray([0, 1, 1])) == (
+        pytest.approx(want)
+    )
+
+
+def test_clustering_nmi_separable_and_mixed():
+    from npairloss_tpu.ops.eval_retrieval import clustering_nmi
+
+    rng = np.random.default_rng(6)
+    emb, labels = make_clusters(rng, ids=6, per_id=8, dim=16, noise=0.05)
+    assert clustering_nmi(emb, labels) == pytest.approx(1.0)
+    # pure noise: NMI near 0 (kmeans finds structureless clusters)
+    noise_emb = rng.standard_normal((48, 16)).astype(np.float32)
+    assert clustering_nmi(noise_emb, labels) < 0.45
+
+
+def test_cli_eval_nmi_flag(tmp_path):
+    rng = np.random.default_rng(7)
+    emb, labels = make_clusters(rng, ids=5, per_id=4, dim=8, noise=0.1)
+    np.save(tmp_path / "f.emb.npy", emb)
+    np.save(tmp_path / "f.labels.npy", labels)
+    proc = subprocess.run(
+        [sys.executable, "-m", "npairloss_tpu", "--platform", "cpu",
+         "eval", "--prefix", str(tmp_path / "f"), "--ks", "1", "--nmi"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["nmi"] == pytest.approx(1.0, abs=0.05)
