@@ -314,7 +314,7 @@ def main() -> int:
         )
         out4 = run_cli(
             ["eval", "--prefix", os.path.join(ws, "feats"),
-             "--ks", "1", "2", "4"],
+             "--ks", "1", "2", "4", "--nmi"],
             os.path.join(ws, "eval.log"),
         )
         gallery = json.loads(out4.strip().splitlines()[-1])
