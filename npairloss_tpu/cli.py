@@ -141,6 +141,8 @@ def _build_solver(args):
     model_kw = {}
     if getattr(args, "remat", False):
         model_kw["remat"] = True  # GoogLeNet trunks; others raise loudly
+    if getattr(args, "caffe_pad", False):
+        model_kw["caffe_pad"] = True  # GoogLeNet trunks
     model = get_model(model_name, dtype=dtype, **model_kw)
 
     sim_cache = getattr(args, "sim_cache", None)
@@ -573,6 +575,12 @@ def main(argv: Optional[list] = None) -> int:
         "cannot serve this source)",
     )
     t.add_argument(
+        "--caffe-pad", dest="caffe_pad", action="store_true",
+        help="evaluate conv1 at Caffe's exact pad-3 geometry (GoogLeNet "
+        "trunks; use with imported .caffemodel weights — SAME samples a "
+        "phase-shifted grid at stride 2)",
+    )
+    t.add_argument(
         "--coordinator",
         help="multi-process coordinator HOST:PORT (the mpirun counterpart); "
         "omit on TPU pods for autodetect",
@@ -600,6 +608,10 @@ def main(argv: Optional[list] = None) -> int:
         sp.add_argument(
             "--native", choices=["auto", "never", "require"],
             default="auto", help="see train --native",
+        )
+        sp.add_argument(
+            "--caffe-pad", dest="caffe_pad", action="store_true",
+            help="see train --caffe-pad",
         )
 
     tt = sub.add_parser(

@@ -12,10 +12,13 @@ Layout notes:
   * Caffe conv kernels are OIHW; Flax wants HWIO — ``transpose(2,3,1,0)``.
   * Both run cross-correlation (no kernel flip): the weights carry over
     directly.
-  * Boundary caveat: Caffe pads conv1 symmetrically (pad: 3) while this
-    trunk uses SAME (pad (2,3) at 224/s2) — identical output shapes,
-    border-pixel differences only.  Retrieval embeddings are robust to
-    this; exact-parity work would pin explicit padding.
+  * Stem-geometry caveat: Caffe pads conv1 symmetrically (pad: 3)
+    while this trunk's default SAME pads (2, 3) at even inputs — with
+    stride 2 that is a one-input-pixel PHASE shift of the sampling
+    grid, not just a border effect.  For closest-to-Caffe inference on
+    imported weights use ``GoogLeNetEmbedding(caffe_pad=True)`` (CLI
+    ``--caffe-pad``), which evaluates conv1 at exactly Caffe's
+    geometry (pinned by test); pool layers already agree.
   * Only the embedding trunk (through pool5/7x7_s1) migrates: the
     reference's aux-classifier heads (loss1/*, loss2/*, loss3/fc...)
     have no counterpart in the metric-learning deployment and are

@@ -97,6 +97,13 @@ class GoogLeNetEmbedding(nn.Module):
     # better MXU lane occupancy on the thin reduce branches; weights
     # interchange via fuse_inception_1x1_params.
     fuse_1x1: bool = False
+    # Caffe-exact conv1 padding: Caffe pads the 7x7/s2 stem symmetrically
+    # (pad: 3, usage/def.prototxt:100) while SAME uses (2, 3) at 224 —
+    # same output shape, border-pixel differences only.  Set True when
+    # running imported .caffemodel weights for closest-to-source
+    # inference (pool layers already agree: SAME's right-biased padding
+    # reproduces Caffe's pad-0 ceil pooling at these shapes).
+    caffe_pad: bool = False
     # Space-to-depth stem: the 7x7/s2 conv over 3 input channels maps
     # poorly onto the 128-lane MXU (contraction depth 7*7*3 = 147 with
     # C_in=3 on the lane axis).  stem_s2d=True rewrites it as the exact
@@ -118,7 +125,9 @@ class GoogLeNetEmbedding(nn.Module):
             )(x, train)
         else:
             x = ConvBlock(
-                64, (7, 7), (2, 2), dtype=self.dtype, use_bn=self.use_bn,
+                64, (7, 7), (2, 2),
+                padding=((3, 3), (3, 3)) if self.caffe_pad else "SAME",
+                dtype=self.dtype, use_bn=self.use_bn,
                 name="conv1",
             )(x, train)
         x = max_pool(x, 3, 2)

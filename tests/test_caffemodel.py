@@ -317,3 +317,38 @@ def test_cli_export_from_snapshot(tmp_path, plain_params):
         blobs["conv1/7x7_s2"][0].transpose(2, 3, 1, 0),
         np.asarray(plain_params["conv1"]["Conv_0"]["kernel"]),
     )
+
+
+def test_caffe_pad_stem_matches_explicit_pad3_conv():
+    """caffe_pad=True must evaluate conv1 at Caffe's geometry: stride-2
+    windows over symmetric pad 3 (usage/def.prototxt:100).  With stride
+    2, SAME's (2,3) pad samples a DIFFERENT input phase — the two are
+    not equal anywhere — so the option is pinned against a direct lax
+    conv with explicit pad 3, and shape equality with SAME is asserted
+    (same 2x downsampling)."""
+    m_same = get_model("googlenet", dtype=jnp.float32)
+    m_caffe = get_model("googlenet", dtype=jnp.float32, caffe_pad=True)
+    rng = np.random.default_rng(21)
+    x = jnp.asarray(rng.standard_normal((1, 64, 64, 3)).astype(np.float32))
+    v = m_same.init(jax.random.PRNGKey(0), x, train=False)
+
+    def stem_out(model):
+        _, inter = model.apply(
+            v, x, train=False, capture_intermediates=True,
+            mutable=["intermediates"],
+        )
+        return np.asarray(inter["intermediates"]["conv1"]["__call__"][0])
+
+    a, b = stem_out(m_same), stem_out(m_caffe)
+    assert a.shape == b.shape  # both 32x32 on a 64 input
+
+    k = v["params"]["conv1"]["Conv_0"]["kernel"]
+    bias = v["params"]["conv1"]["Conv_0"]["bias"]
+    want = jax.lax.conv_general_dilated(
+        x, k, (2, 2), ((3, 3), (3, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + bias
+    want = np.maximum(np.asarray(want), 0.0)
+    np.testing.assert_allclose(b, want, rtol=1e-5, atol=1e-5)
+    # and SAME genuinely differs (different sampling phase)
+    assert not np.allclose(a, want, atol=1e-3)
