@@ -214,6 +214,22 @@ def main():
              input_shape=(96, 96, 3),
              num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
              noise=0.6, use_blockwise=True)),
+        # The full MXU-rewrite stack (BN trunk + space-to-depth stem +
+        # fused inception 1x1s) training end-to-end: the rewrites are
+        # algebraically exact by test, and this row shows the variant
+        # LEARNS at the same bar — the trainability evidence for the
+        # performance trunk.
+        ("flagship_googlenet_bn_mxu",
+         lambda: run_config(
+             "flagship_googlenet_bn_mxu", REFERENCE_CONFIG,
+             steps=max(200, s // 2),
+             model_name="googlenet_bn_s2d",
+             model_kw=dict(
+                 fuse_1x1=True,
+                 dtype=jnp.bfloat16 if args.tpu else jnp.float32),
+             input_shape=(96, 96, 3),
+             num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
+             noise=0.6)),
         # ViT trunk (reduced proxy of BASELINE.json cfg 5's ViT-B/16
         # stretch) with the flagship mining config — every model family
         # in the zoo demonstrates a learning curve.
