@@ -1,0 +1,149 @@
+"""Diagnose the 32k cached-stretch hang (round 4).
+
+scripts/tpu_pallas_check.py timed out on the real chip at
+``stretch 32768: absolute (sim_cache=on)`` after finishing every
+uncached measurement.  Hypotheses: (a) the 4.3 GB fp32 cache held as a
+VJP residual plus lax.scan double-buffering exceeds the 16 GB v5e HBM
+and the tunnel stalls instead of raising; (b) the cached sweeps' HBM
+traffic is pathologically slow; (c) Mosaic compile blowup for the cached
+kernel family at that operand size.
+
+This script bisects: for each pool size it times fwd-only and fwd+bwd,
+scan-of-1 and scan-of-3, cached only, and prints peak HBM after each
+phase — with a watchdog print before every phase so the log shows
+exactly where a hang begins.  Output lines are flushed immediately; run
+under ``timeout`` and read the tail.
+
+Usage: python scripts/diag_sim_cache.py [--pools 8192,16384,32768]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pools", default="8192,16384,32768")
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--block", type=int, default=512)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from npairloss_tpu.ops.npair_loss import MiningMethod, NPairLossConfig
+    from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss
+
+    dev = jax.devices()[0]
+
+    def say(msg):
+        print(f"[diag t={time.perf_counter() - T0:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    def hbm(tag):
+        try:
+            st = dev.memory_stats() or {}
+            say(f"{tag}: in_use={st.get('bytes_in_use', 0) / 2**30:.2f} GiB "
+                f"peak={st.get('peak_bytes_in_use', 0) / 2**30:.2f} GiB "
+                f"limit={st.get('bytes_limit', 0) / 2**30:.2f} GiB")
+        except Exception as e:
+            say(f"{tag}: memory_stats unavailable ({e})")
+
+    T0 = time.perf_counter()
+    say(f"backend={dev.platform} kind={dev.device_kind}")
+    hbm("start")
+
+    cfg = NPairLossConfig(margin_diff=-0.05,
+                          an_mining_method=MiningMethod.HARD)
+    rng = np.random.default_rng(0)
+
+    for pool in [int(p) for p in args.pools.split(",")]:
+        f = rng.standard_normal((pool, args.dim)).astype(np.float32)
+        f /= np.linalg.norm(f, axis=1, keepdims=True)
+        feats = jax.device_put(jnp.asarray(f))
+        labels = jax.device_put(jnp.asarray(
+            np.repeat(np.arange(pool // 2), 2).astype(np.int32)))
+        cache_gib = pool * pool * 4 / 2**30
+        say(f"=== pool {pool} (cache {cache_gib:.2f} GiB) ===")
+
+        def loss_fn(x):
+            return blockwise_npair_loss(
+                x, labels, cfg, block_size=args.block, sim_cache=True)
+
+        # Phase 1: fwd only, single call (cache is transient).
+        say("phase fwd-1: compile+run")
+        fwd = jax.jit(lambda x: loss_fn(x) * 1.0)
+        t0 = time.perf_counter()
+        l0 = float(np.asarray(fwd(feats)))
+        say(f"phase fwd-1 done: loss={l0:.6f} "
+            f"wall={time.perf_counter() - t0:.1f}s")
+        hbm("after fwd-1")
+        t0 = time.perf_counter()
+        float(np.asarray(fwd(feats * 1.000001)))
+        say(f"phase fwd-1 rerun: wall={time.perf_counter() - t0:.2f}s")
+
+        # Phase 2: fwd+bwd, single call (cache lives fwd->bwd as residual).
+        say("phase vg-1: compile+run")
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+        t0 = time.perf_counter()
+        l0, g = vg(feats)
+        l0 = float(np.asarray(l0))
+        g00 = float(np.asarray(g[0, 0]))
+        say(f"phase vg-1 done: loss={l0:.6f} g00={g00:.2e} "
+            f"wall={time.perf_counter() - t0:.1f}s")
+        hbm("after vg-1")
+        t0 = time.perf_counter()
+        l1, g = vg(feats * 1.000001)
+        float(np.asarray(l1))
+        say(f"phase vg-1 rerun: wall={time.perf_counter() - t0:.2f}s")
+
+        # Phase 3: fwd+bwd inside scan-of-3 (tpu_pallas_check's shape —
+        # adds scan double-buffering on top of the residual).
+        say("phase vg-scan3: compile+run")
+
+        @jax.jit
+        def many(x, round_id):
+            def body(acc, s):
+                loss, grad = jax.value_and_grad(loss_fn)(
+                    x * (1.0 + (round_id * 3 + s) * 1e-6))
+                return acc + loss + grad[0, 0], loss
+
+            acc, losses = jax.lax.scan(
+                body, jnp.float32(0.0), jnp.arange(3, dtype=jnp.float32))
+            return acc, losses[0]
+
+        t0 = time.perf_counter()
+        acc, _ = many(feats, jnp.float32(0))
+        float(np.asarray(acc))
+        say(f"phase vg-scan3 done: wall={time.perf_counter() - t0:.1f}s")
+        hbm("after vg-scan3")
+        t0 = time.perf_counter()
+        acc, _ = many(feats, jnp.float32(1))
+        float(np.asarray(acc))
+        dt = time.perf_counter() - t0
+        say(f"phase vg-scan3 rerun: wall={dt:.2f}s "
+            f"({dt / 3 * 1e3:.1f} ms/step)")
+
+    say("ALL DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
