@@ -87,30 +87,34 @@ def main() -> int:
             return blockwise_npair_loss(
                 x, labels, cfg, block_size=args.block, sim_cache=True)
 
-        # Phase 1: fwd only, single call (cache is transient).
+        # Phase 1: fwd only, single call (cache is transient).  The
+        # perturbation scale rides INSIDE the jitted fn — eager device
+        # ops on the axon tunnel are themselves a hang hazard and would
+        # confound the bisect (.claude/skills/verify/SKILL.md).
         say("phase fwd-1: compile+run")
-        fwd = jax.jit(lambda x: loss_fn(x) * 1.0)
+        fwd = jax.jit(lambda x, s: loss_fn(x * (1.0 + s * 1e-6)))
         t0 = time.perf_counter()
-        l0 = float(np.asarray(fwd(feats)))
+        l0 = float(np.asarray(fwd(feats, jnp.float32(0))))
         say(f"phase fwd-1 done: loss={l0:.6f} "
             f"wall={time.perf_counter() - t0:.1f}s")
         hbm("after fwd-1")
         t0 = time.perf_counter()
-        float(np.asarray(fwd(feats * 1.000001)))
+        float(np.asarray(fwd(feats, jnp.float32(1))))
         say(f"phase fwd-1 rerun: wall={time.perf_counter() - t0:.2f}s")
 
         # Phase 2: fwd+bwd, single call (cache lives fwd->bwd as residual).
         say("phase vg-1: compile+run")
-        vg = jax.jit(jax.value_and_grad(loss_fn))
+        vg = jax.jit(lambda x, s: jax.value_and_grad(
+            lambda y: loss_fn(y * (1.0 + s * 1e-6)))(x))
         t0 = time.perf_counter()
-        l0, g = vg(feats)
+        l0, g = vg(feats, jnp.float32(0))
         l0 = float(np.asarray(l0))
         g00 = float(np.asarray(g[0, 0]))
         say(f"phase vg-1 done: loss={l0:.6f} g00={g00:.2e} "
             f"wall={time.perf_counter() - t0:.1f}s")
         hbm("after vg-1")
         t0 = time.perf_counter()
-        l1, g = vg(feats * 1.000001)
+        l1, g = vg(feats, jnp.float32(1))
         float(np.asarray(l1))
         say(f"phase vg-1 rerun: wall={time.perf_counter() - t0:.2f}s")
 

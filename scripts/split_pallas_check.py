@@ -37,7 +37,13 @@ def split(rec, out_dir, date=None):
         engine += ", fp32 sim-cache; _nocache rows stream uncached"
     engine += ")"
 
-    cmd = "python scripts/tpu_pallas_check.py --pool 4096 --stretch 32768"
+    stretch_pool = (rec.get("stretch", {}).get("flagship_nocache", {})
+                    .get("pool", 32768))
+    cached_pool = rec.get("cached_pool")  # absent on legacy records
+    cmd = f"python scripts/tpu_pallas_check.py --pool 4096 --stretch {stretch_pool}"
+    if cached_pool and cached_pool != stretch_pool:
+        cmd += f" --stretch-cached {cached_pool}"
+    cache_gib = (cached_pool or stretch_pool) ** 2 * 4 / 2**30
     pallas = {
         "round": ROUND, "date": date, "device": device, "pool": rec["pool"],
         "parity": rec["parity"], "ok": rec["ok"],
@@ -45,20 +51,25 @@ def split(rec, out_dir, date=None):
         "command": cmd,
     }
     stretch = {
-        "round": ROUND, "date": date, "device": device, "pool": 32768,
+        "round": ROUND, "date": date, "device": device, "pool": stretch_pool,
         "dim": 512, "block": 512,
         "engine": engine,
         "sim_cache": sim_cached,
-        "note": ("fwd+bwd per step; the similarity cache materializes the "
-                 "4.3 GB fp32 sim matrix once in the stats sweep and streams "
-                 "it back in the radix/loss/backward sweeps (see "
-                 "docs/DESIGN.md). Timed as 3 perturbed steps inside one "
-                 "jitted lax.scan, host-fetch synced, dispatch floor "
-                 "subtracted (bench.py timing discipline)."),
+        "note": ("fwd+bwd per step; every row carries its own 'pool'. "
+                 "When enabled, the similarity cache materializes the "
+                 f"{cache_gib:.2f} GiB fp32 sim matrix once in the stats "
+                 "sweep and streams it back in the radix/loss/backward "
+                 "sweeps (see docs/DESIGN.md); cached rows run at "
+                 "'cached_pool' (a 4.3 GiB cache dispatch wedges the "
+                 "tunneled v5e backend — round-4 finding). Timed as 3 "
+                 "perturbed steps inside one jitted lax.scan, host-fetch "
+                 "synced, dispatch floor subtracted (bench.py timing "
+                 "discipline)."),
         "stretch": rec["stretch"],
         **{k: rec[k] for k in (
             "peak_bytes_in_use", "peak_bytes_in_use_cached",
-            "peak_bytes_in_use_nocache") if k in rec},
+            "peak_bytes_in_use_nocache", "cached_pool",
+            "sim_cache_auto_at_stretch") if k in rec},
         "command": cmd,
     }
     return pallas, stretch

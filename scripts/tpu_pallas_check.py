@@ -30,6 +30,14 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pool", type=int, default=4096)
     ap.add_argument("--stretch", type=int, default=32768)
+    ap.add_argument(
+        "--stretch-cached", type=int, default=None,
+        help="pool for the sim_cache=on stretch rows (default: --stretch). "
+        "Round 4 found that dispatching the cached program with a 4.3 GiB "
+        "cache (pool 32768) WEDGES the tunneled v5e backend server-side — "
+        "every later client gets UNAVAILABLE until the tunnel resets — so "
+        "the revalidation queue measures the cached rows at a pool the "
+        "auto-gate accepts and records the 32k auto verdict separately.")
     ap.add_argument("--dim", type=int, default=512)
     ap.add_argument("--block", type=int, default=512)
     ap.add_argument("--cpu", action="store_true",
@@ -103,11 +111,17 @@ def main() -> int:
 
     # Stretch: blockwise-only at a pool whose dense matrix cannot exist.
     ns = args.stretch
-    fs = rng.standard_normal((ns, args.dim)).astype(np.float32)
-    fs /= np.linalg.norm(fs, axis=1, keepdims=True)
-    feats_s = jax.device_put(jnp.asarray(fs))
-    labels_s = jax.device_put(
-        jnp.asarray(np.repeat(np.arange(ns // 2), 2).astype(np.int32)))
+
+    def stretch_arrays(n_):
+        fs = rng.standard_normal((n_, args.dim)).astype(np.float32)
+        fs /= np.linalg.norm(fs, axis=1, keepdims=True)
+        return (
+            jax.device_put(jnp.asarray(fs)),
+            jax.device_put(jnp.asarray(
+                np.repeat(np.arange(n_ // 2), 2).astype(np.int32))),
+        )
+
+    feats_s, labels_s = stretch_arrays(ns)
     # Timing discipline (see bench.py): the tunneled backend neither
     # blocks in block_until_ready nor re-executes identical dispatches,
     # so time `reps` perturbed fwd+bwd steps inside ONE jitted lax.scan,
@@ -123,10 +137,13 @@ def main() -> int:
 
     reps = 3
 
-    def time_stretch(cfg, use_cache: bool):
+    def time_stretch(cfg, use_cache: bool, feats_t=None, labels_t=None):
+        feats_t = feats_s if feats_t is None else feats_t
+        labels_t = labels_s if labels_t is None else labels_t
+        n_t = int(feats_t.shape[0])
         vg = jax.value_and_grad(
             lambda x: blockwise_npair_loss(
-                x, labels_s, cfg, block_size=args.block,
+                x, labels_t, cfg, block_size=args.block,
                 sim_cache=use_cache))
 
         @jax.jit
@@ -142,19 +159,20 @@ def main() -> int:
                 body, jnp.float32(0.0), jnp.arange(reps, dtype=jnp.float32))
             return acc, losses[0]
 
-        acc, l0 = many(feats_s, jnp.float32(0))
+        acc, l0 = many(feats_t, jnp.float32(0))
         float(np.asarray(acc))  # compile + warm
-        acc, l0 = many(feats_s, jnp.float32(1))
+        acc, l0 = many(feats_t, jnp.float32(1))
         float(np.asarray(acc))  # second warm (first-program phantom cost)
         t0 = time.perf_counter()
-        acc, l0 = many(feats_s, jnp.float32(2))
+        acc, l0 = many(feats_t, jnp.float32(2))
         float(np.asarray(acc))
         dt = max(time.perf_counter() - t0 - floor, 1e-9) / reps
         return {
             "loss": float(np.asarray(l0)),
             "ms_per_step": round(dt * 1e3, 2),
-            "embeddings_per_sec": round(ns / dt, 1),
+            "embeddings_per_sec": round(n_t / dt, 1),
             "sim_cache": use_cache,
+            "pool": n_t,
         }
 
     def peak_bytes():
@@ -167,14 +185,16 @@ def main() -> int:
             return None
 
     # Measure BOTH cache states (VERDICT r3 item 3: the cache's effect at
-    # the 32k stretch must be an artifact, not a hypothesis).
+    # the stretch must be an artifact, not a hypothesis).
     # peak_bytes_in_use is a process-lifetime high-water mark (never
     # reset), so the UNCACHED runs go first: their snapshot is a true
     # uncached peak, and the post-cached snapshot minus it attributes the
-    # ns*ns*4-byte fp32 tile allocation to the cache.
+    # n*n*4-byte fp32 tile allocation to the cache.
     # resolve_sim_cache_auto is what sim_cache=None actually does
-    # (device-memory-capped budget), so the artifact records its verdict.
+    # (device-memory-capped budget), so the artifact records its verdict
+    # at the FULL stretch pool even when the cached rows run smaller.
     cache_auto = resolve_sim_cache_auto(ns * ns * 4, "blockwise")
+    record["sim_cache_auto_at_stretch"] = cache_auto
     for name, cfg in configs:
         print(f"[tpu-check] stretch {ns}: {name} (sim_cache=off)...",
               file=sys.stderr, flush=True)
@@ -186,13 +206,33 @@ def main() -> int:
     pk = peak_bytes()
     if pk is not None:
         record["peak_bytes_in_use_nocache"] = pk
+    nc = args.stretch_cached or ns
+    record["cached_pool"] = nc
+    if nc != ns:
+        feats_c, labels_c = stretch_arrays(nc)
+        # Paired uncached rows at the cached pool so the cache delta is
+        # apples-to-apples even when nc != ns.
+        for name, cfg in configs:
+            print(f"[tpu-check] stretch {nc}: {name} (sim_cache=off)...",
+                  file=sys.stderr, flush=True)
+            rec_n = time_stretch(cfg, False, feats_c, labels_c)
+            record["stretch"][name + "_nocache_cachedpool"] = rec_n
+            print(f"[tpu-check]   {rec_n['ms_per_step']:.1f} ms/step, "
+                  f"{rec_n['embeddings_per_sec']:.0f} emb/s",
+                  file=sys.stderr, flush=True)
+    else:
+        feats_c, labels_c = feats_s, labels_s
+    cache_auto_nc = (cache_auto if nc == ns
+                     else resolve_sim_cache_auto(nc * nc * 4, "blockwise"))
     for name, cfg in configs:
-        print(f"[tpu-check] stretch {ns}: {name} (sim_cache=on)...",
+        print(f"[tpu-check] stretch {nc}: {name} (sim_cache=on)...",
               file=sys.stderr, flush=True)
-        rec_c = time_stretch(cfg, True)
-        rec_c["sim_cache_auto"] = cache_auto
+        rec_c = time_stretch(cfg, True, feats_c, labels_c)
+        rec_c["sim_cache_auto"] = cache_auto_nc
         record["stretch"][name] = rec_c
-        rec_n = record["stretch"][name + "_nocache"]
+        key = (name + "_nocache" if nc == ns
+               else name + "_nocache_cachedpool")
+        rec_n = record["stretch"][key]
         if abs(rec_c["loss"] - rec_n["loss"]) > 1e-4 * max(1.0, abs(rec_n["loss"])):
             print(f"[tpu-check]   CACHE PARITY FAIL: {rec_c['loss']} vs "
                   f"{rec_n['loss']}", file=sys.stderr, flush=True)
