@@ -252,6 +252,68 @@ def _global_relative_threshold(sims: jax.Array, mask: jax.Array, sn: float) -> j
     return _clamp_negative(val[0])
 
 
+def topk_relative_threshold(
+    topk: jax.Array, counts: jax.Array, sn: float, region: "MiningRegion",
+    count_dtype=jnp.int32,
+) -> jax.Array:
+    """RELATIVE_{HARD,EASY} threshold from per-query K-largest candidate
+    buffers — the sparse-candidate fast path for the POSITIVE side.
+
+    With identity-balanced batches each query has only
+    ``img_num_per_identity*G - 1`` same-label candidates among the whole
+    pool (def.prototxt:25-26 makes that 2 per identity), so when every
+    query's candidate count fits a K-slot buffer, the buffer IS the
+    complete per-query candidate list and the reference's ascending
+    sorted-list indexing (cu:285-287 / cu:300-302) reduces to a sort of
+    N x K values — no full-population selection needed.  The buffer must
+    hold values bit-identical to the engine's sim computation (the
+    streaming engines extract them inside the same kernel sweep that
+    computes the sims), so the selected element matches the streamed
+    radix selection exactly.
+
+    Args:
+      topk: [N, K] the K largest candidate sims per query, padded with
+        ``-FLT_MAX``.  Finite sims only — a ``-inf`` candidate would
+        sort below the padding sentinel and shift the index arithmetic.
+      counts: int [N] true candidate count per query; only valid when
+        ``counts.max() <= K`` (callers guard with ``lax.cond``).
+      sn: the identsn/diffsn rank parameter (see ``_relative_pos``).
+      region: LOCAL (per-query list, cu:285) or GLOBAL (one list over
+        the whole population, cu:300).
+      count_dtype: the dtype the RADIX path would rank the same
+        population in (``population_count_dtype`` of the full pair
+        population) — GLOBAL rank arithmetic must run in the identical
+        int/float widths or the ``lax.cond`` fast/fallback branches
+        could select ranks differing by one near fractional-sn
+        boundaries (int64 -> float64 ``_relative_pos``, int32 ->
+        float32).  LOCAL ranks are per-query int32 in both paths.
+
+    Returns: float32 [N] thresholds (GLOBAL broadcasts one value), with
+    the reference's empty -> +FLT_MAX and ``< 0 -> -FLT_MAX`` quirks.
+    """
+    n, kcap = topk.shape
+    if region == MiningRegion.GLOBAL:
+        # The buffer's n*K candidates always fit int32, but the rank
+        # arithmetic mirrors the radix path's dtype (see above).
+        total = counts.astype(count_dtype).sum()
+        k = _relative_pos(total[None], sn)[0].astype(jnp.int32)
+        total32 = total.astype(jnp.int32)  # <= n*K, always representable
+        flat = jnp.sort(topk.reshape(-1))  # ascending, padding first
+        pos = jnp.int32(flat.shape[0]) - total32 + k
+        val = flat[jnp.clip(pos, 0, flat.shape[0] - 1)]
+        val = jnp.where(total32 == 0, jnp.float32(FLT_MAX), val)
+        return _clamp_negative(jnp.broadcast_to(val, (n,)))
+    counts = counts.astype(jnp.int32)
+    k = _relative_pos(counts, sn)
+    asc = jnp.sort(topk, axis=1)  # ascending, padding first
+    pos = jnp.int32(kcap) - counts + k
+    val = jnp.take_along_axis(
+        asc, jnp.clip(pos, 0, kcap - 1)[:, None], axis=1
+    )[:, 0]
+    val = jnp.where(counts == 0, jnp.float32(FLT_MAX), val)
+    return _clamp_negative(val)
+
+
 def mining_thresholds(
     sims: jax.Array, same: jax.Array, diff: jax.Array, cfg: NPairLossConfig
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

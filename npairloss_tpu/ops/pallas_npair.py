@@ -37,7 +37,15 @@ recovered exactly by MSD radix selection (``ops.rank_select``): a few
 extra streamed passes over the pair tiles, each histogramming one
 RADIX_BITS-bit digit of the monotone sortable float key via
 scatter-free compare-and-reduce, narrow the target rank to a single
-bit pattern without ever materializing the population.
+bit pattern without ever materializing the population.  When only the
+POSITIVE side is relative (the flagship def.prototxt config), the
+sparse-positive fast path (``pos_topk``) skips those passes entirely:
+identity-balanced sampling gives each query only a handful of
+positives, so the stats sweep keeps a K-slot buffer of the largest
+same-label sims (``_accum_topk``) and the AP threshold is an N x K
+sort — the flagship config then costs the same sweeps as absolute
+mining, with a runtime ``lax.cond`` fallback to radix selection for
+labels that overflow the buffer.
 
 **Similarity cache**: every sweep above recomputes its sim tiles with a
 fp32-HIGHEST MXU matmul (6 bf16 passes) plus a full stream of the feats
@@ -79,6 +87,7 @@ from npairloss_tpu.ops.npair_loss import (
     _relative_pos,
     absolute_thresholds,
     selection_predicates,
+    topk_relative_threshold,
 )
 from npairloss_tpu.ops.rank_select import (
     NUM_DIGITS,
@@ -234,8 +243,39 @@ def _accum_digit_hist(out_ref, sims, mask, digit: int, prefix=None):
         )
 
 
+def _accum_topk(out_ref, sims, mask, k: int):
+    """Maintain the K largest masked sims per query across pool tiles.
+
+    ``out_ref`` is a (K, bn) revisited output holding the running
+    K-largest buffer (queries on lanes, slots on sublanes).  Per tile:
+    K rounds of (row-max, remove exactly one occurrence) extract the
+    tile's K largest — duplicate values are distinct candidates, so
+    removal is by max-index-among-equals, never by value — then the
+    same loop over the (2K, bn) concat merges tile and buffer.  Values
+    come from the SAME ``sims`` the sweep computes, so thresholds built
+    from the buffer are bit-identical to streamed radix selection.
+    Cost: ~4K VPU passes per tile, beside a 2*D-MAC matmul."""
+    bn, bm = sims.shape
+    neg = jnp.float32(-FLT_MAX)
+    vals = jnp.where(mask, sims, neg)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (bn, bm), 1)
+    rows = []
+    for _ in range(k):
+        mx = vals.max(axis=1, keepdims=True)  # (bn, 1)
+        mi = jnp.where(vals == mx, iota, -1).max(axis=1, keepdims=True)
+        vals = jnp.where(iota == mi, neg, vals)
+        rows.append(mx.T)
+    work = jnp.concatenate([out_ref[:]] + rows, axis=0)  # (2K, bn)
+    iota2 = jax.lax.broadcasted_iota(jnp.int32, (2 * k, bn), 0)
+    for t in range(k):
+        mx = work.max(axis=0, keepdims=True)  # (1, bn)
+        mi = jnp.where(work == mx, iota2, -1).max(axis=0, keepdims=True)
+        work = jnp.where(iota2 == mi, neg, work)
+        out_ref[t:t + 1, :] = mx
+
+
 def _make_stats_kernel(hist_same: bool, hist_diff: bool,
-                       emit_sims: bool = False):
+                       emit_sims: bool = False, topk_same: int = 0):
     """Mining-stats kernel; optionally also the digit-0 radix histograms
     for RELATIVE_* sides (digit 0 needs no prefix, so accumulating it in
     this sweep saves one whole pass per relative side), and optionally
@@ -248,6 +288,7 @@ def _make_stats_kernel(hist_same: bool, hist_diff: bool,
             out_refs[:5], list(out_refs[5:]))
         h_s_ref = rest.pop(0) if hist_same else None
         h_d_ref = rest.pop(0) if hist_diff else None
+        topk_ref = rest.pop(0) if topk_same else None
         sims_out_ref = rest.pop(0) if emit_sims else None
         # grid = (num_q_blocks, num_pool_blocks)
         qi, ii = pl.program_id(0), pl.program_id(1)
@@ -266,6 +307,8 @@ def _make_stats_kernel(hist_same: bool, hist_diff: bool,
                 h_s_ref[:] = jnp.zeros_like(h_s_ref)
             if h_d_ref is not None:
                 h_d_ref[:] = jnp.zeros_like(h_d_ref)
+            if topk_ref is not None:
+                topk_ref[:] = jnp.full_like(topk_ref, neg)
 
         sims = _sim_tile(feats_ref, pool_ref)
         if sims_out_ref is not None:
@@ -293,6 +336,8 @@ def _make_stats_kernel(hist_same: bool, hist_diff: bool,
             _accum_digit_hist(h_s_ref, sims, same, 0)
         if h_d_ref is not None:
             _accum_digit_hist(h_d_ref, sims, diff, 0)
+        if topk_ref is not None:
+            _accum_topk(topk_ref, sims, same, topk_same)
 
     return kernel
 
@@ -533,7 +578,7 @@ def _hist_block(bn: int):
 
 def _run_stats(feats_p, labels_p, pool_p, pool_labels_p, scal,
                bn, bm, interpret, hist_same=False, hist_diff=False,
-               emit_sims=False):
+               emit_sims=False, topk_same=0):
     npq, dim = feats_p.shape[0] // bn, feats_p.shape[1]
     npi = pool_p.shape[0] // bm
     n_p, m_p = feats_p.shape[0], pool_p.shape[0]
@@ -544,11 +589,17 @@ def _run_stats(feats_p, labels_p, pool_p, pool_labels_p, scal,
         + [jax.ShapeDtypeStruct((1, n_p), jnp.int32)] * 2
         + [jax.ShapeDtypeStruct((RADIX_BINS, n_p), jnp.int32)] * n_hists
     )
+    if topk_same:
+        out_specs.append(pl.BlockSpec(
+            (topk_same, bn), lambda q, i: (0, q), memory_space=pltpu.VMEM
+        ))
+        out_shape.append(
+            jax.ShapeDtypeStruct((topk_same, n_p), jnp.float32))
     if emit_sims:
         out_specs.append(_simblock(bn, bm, 0))
         out_shape.append(jax.ShapeDtypeStruct((n_p, m_p), jnp.float32))
     out = pl.pallas_call(
-        _make_stats_kernel(hist_same, hist_diff, emit_sims),
+        _make_stats_kernel(hist_same, hist_diff, emit_sims, topk_same),
         grid=(npq, npi),
         in_specs=_data_specs(bn, bm, dim, 0),
         out_specs=out_specs,
@@ -557,10 +608,11 @@ def _run_stats(feats_p, labels_p, pool_p, pool_labels_p, scal,
     )(scal, feats_p, _row(labels_p), pool_p, _row(pool_labels_p))
     flat = [o[0, :] for o in out[:5]]
     sims_cache = out[-1] if emit_sims else None
+    topk = out[5 + n_hists].T if topk_same else None  # -> [n_p, K]
     hists = [o.T for o in out[5:5 + n_hists]]  # -> [n_p, RADIX_BINS]
     h_s = hists.pop(0) if hist_same else None
     h_d = hists.pop(0) if hist_diff else None
-    return (*flat, h_s, h_d, sims_cache)
+    return (*flat, h_s, h_d, topk, sims_cache)
 
 
 def _run_hist(feats_p, labels_p, pool_p, pool_labels_p, scal,
@@ -674,7 +726,8 @@ def _run_bwd(feats_p, labels_p, pool_p, pool_labels_p, scal,
 
 def _thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
                 min_w, max_b, cnt_s, cnt_d, h0_s, h0_d,
-                cfg, bn, bm, interpret, n, sims_cache=None):
+                cfg, bn, bm, interpret, n, sims_cache=None,
+                topk_same=None):
     """(pos_thr, neg_thr) for ANY mining config: absolute methods from the
     streamed min/max stats, RELATIVE_* via exact stepwise radix selection.
 
@@ -690,12 +743,63 @@ def _thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
     flattened population (cu:296, cu:327), LOCAL per query; populations
     beyond 2^31 pairs need 64-bit counts (jax_enable_x64) or fail loudly
     at trace time.
+
+    ``topk_same`` ([n_p, K] kernel-extracted K-largest same-label sims,
+    or None) arms the sparse-positive fast path: identity-balanced
+    batches give each query only a handful of positives, so when every
+    ``cnt_s`` fits the K-slot buffer the AP threshold is an N x K sort
+    (``topk_relative_threshold``) and the AP side drops out of the
+    digit sweeps entirely — the flagship GLOBAL/RELATIVE_HARD config
+    then costs the same sweeps as absolute mining.  A ``lax.cond``
+    falls back to the radix path at runtime when some label group
+    overflows the buffer, so arbitrary label multiplicity stays exact.
     """
     pos_thr, neg_thr = absolute_thresholds(min_w, max_b, cfg)
+    ap_rel = cfg.ap_mining_method in _RELATIVE
+    an_rel = cfg.an_mining_method in _RELATIVE
+    if not (ap_rel or an_rel):
+        return pos_thr, neg_thr
+
+    # Fast path only pays off when AP is the ONLY relative side: the
+    # digit sweeps are shared across sides, so with AN also relative
+    # dropping AP saves zero sweeps while doubling the cond's compiled
+    # pipeline.  _blockwise_fwd_impl skips the buffer in that case too.
+    if ap_rel and not an_rel and topk_same is not None:
+        def radix(include_ap):
+            return _radix_thresholds(
+                feats_p, labels_p, pool_p, pool_labels_p, scal,
+                pos_thr, neg_thr, cnt_s, cnt_d, h0_s, h0_d,
+                cfg, bn, bm, interpret, n, sims_cache,
+                include_ap=include_ap, include_an=an_rel)
+
+        kcap = topk_same.shape[1]
+        fits = cnt_s.max() <= kcap
+
+        def fast(_):
+            p = topk_relative_threshold(
+                topk_same[:n], cnt_s, cfg.identsn, cfg.ap_mining_region,
+                count_dtype=population_count_dtype(n * n))
+            return p, radix(False)[1]
+
+        return jax.lax.cond(fits, fast, lambda _: radix(True), 0)
+
+    return _radix_thresholds(
+        feats_p, labels_p, pool_p, pool_labels_p, scal,
+        pos_thr, neg_thr, cnt_s, cnt_d, h0_s, h0_d,
+        cfg, bn, bm, interpret, n, sims_cache,
+        include_ap=ap_rel, include_an=an_rel)
+
+
+def _radix_thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
+                      pos_thr, neg_thr, cnt_s, cnt_d, h0_s, h0_d,
+                      cfg, bn, bm, interpret, n, sims_cache,
+                      include_ap, include_an):
+    """The streamed radix-selection path of ``_thresholds`` (see there),
+    restricted to the requested sides."""
     sides = {}
-    if cfg.ap_mining_method in _RELATIVE:
+    if include_ap:
         sides["ap"] = (True, cfg.identsn, cfg.ap_mining_region, cnt_s, h0_s)
-    if cfg.an_mining_method in _RELATIVE:
+    if include_an:
         sides["an"] = (False, cfg.diffsn, cfg.an_mining_region, cnt_d, h0_d)
     if not sides:
         return pos_thr, neg_thr
@@ -748,15 +852,17 @@ def _thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
-def _blockwise_core(features, labels, cfg, bn, bm, interpret, cache):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _blockwise_core(features, labels, cfg, bn, bm, interpret, cache,
+                    pos_topk):
     out, _ = _blockwise_fwd_impl(
-        features, labels, cfg, bn, bm, interpret, cache
+        features, labels, cfg, bn, bm, interpret, cache, pos_topk
     )
     return out
 
 
-def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret, cache):
+def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret, cache,
+                        pos_topk=0):
     features = features.astype(jnp.float32)
     labels_i = _canon_labels(labels)
     n = features.shape[0]
@@ -766,17 +872,24 @@ def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret, cache):
     pool_labels_p = _pad_rows(labels_i, bm)
     scal = jnp.array([n, 0, n], jnp.int32)  # [m_real, self_offset, n_real]
 
-    min_w, max_b, max_all, cnt_s, cnt_d, h0_s, h0_d, sims_cache = _run_stats(
+    ap_rel = cfg.ap_mining_method in _RELATIVE
+    an_rel = cfg.an_mining_method in _RELATIVE
+    (min_w, max_b, max_all, cnt_s, cnt_d, h0_s, h0_d, topk_same,
+     sims_cache) = _run_stats(
         feats_p, labels_qp, pool_p, pool_labels_p, scal, bn, bm, interpret,
-        hist_same=cfg.ap_mining_method in _RELATIVE,
-        hist_diff=cfg.an_mining_method in _RELATIVE,
+        hist_same=ap_rel,
+        hist_diff=an_rel,
         emit_sims=cache,
+        # The buffer only pays when AP is the sole relative side (see
+        # _thresholds).
+        topk_same=pos_topk if ap_rel and not an_rel else 0,
     )
     min_w, max_b, max_all = min_w[:n], max_b[:n], max_all[:n]
     pos_thr, neg_thr = _thresholds(
         feats_p, labels_qp, pool_p, pool_labels_p, scal,
         min_w, max_b, cnt_s[:n], cnt_d[:n], h0_s, h0_d,
         cfg, bn, bm, interpret, n, sims_cache=sims_cache,
+        topk_same=topk_same,
     )
     out = _run_loss(
         feats_p, labels_qp, pool_p, pool_labels_p, scal,
@@ -810,13 +923,15 @@ def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret, cache):
     return (loss, aux), residuals
 
 
-def _blockwise_fwd(features, labels, cfg, bn, bm, interpret, cache):
+def _blockwise_fwd(features, labels, cfg, bn, bm, interpret, cache,
+                   pos_topk):
     return _blockwise_fwd_impl(
-        features, labels, cfg, bn, bm, interpret, cache
+        features, labels, cfg, bn, bm, interpret, cache, pos_topk
     )
 
 
-def _blockwise_bwd(cfg, bn, bm, interpret, cache, res, cotangents):
+def _blockwise_bwd(cfg, bn, bm, interpret, cache, pos_topk, res,
+                   cotangents):
     g, _ = cotangents  # aux outputs are monitors
     features = res["features"]
     labels = res["labels"]
@@ -862,6 +977,7 @@ def blockwise_npair_loss_with_aux(
     q_block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
     sim_cache: Optional[bool] = None,
+    pos_topk: Optional[int] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """N-pair loss over a self-pool too large for the dense N x N matrix.
 
@@ -882,6 +998,17 @@ def blockwise_npair_loss_with_aux(
     ``None`` auto-enables it when that matrix is at most
     ``SIM_CACHE_AUTO_BYTES``; pass ``False`` to force the O(N x block)
     streaming-memory behavior.
+
+    ``pos_topk``: K-slot sparse-positive fast path for RELATIVE_* AP
+    mining (see ``_thresholds``): the stats sweep extracts each query's
+    K largest same-label sims, and when every query's positive count
+    fits the buffer the AP threshold needs no digit sweeps — the
+    flagship config then streams as few passes as absolute mining.  A
+    runtime ``lax.cond`` falls back to radix selection when a label
+    group overflows, so the result is exact for any labels.  Default
+    ``None`` = auto (8 slots — covers per-query positive counts up to
+    8, i.e. identity-balanced sampling with up to NINE images per
+    identity in the pool); 0 disables the buffer entirely.
     """
     if interpret is None:
         interpret = _default_interpret()
@@ -897,8 +1024,14 @@ def blockwise_npair_loss_with_aux(
     if sim_cache is None:
         n_p, m_p = _round_up(n, bn), _round_up(n, bm)
         sim_cache = resolve_sim_cache_auto(n_p * m_p * 4, "blockwise")
+    if pos_topk is None:
+        pos_topk = 8
+    # fp32 (8, 128) tiling: the K-slot buffer's sublane dim must be a
+    # multiple of 8 (extra slots just carry more padding).
+    pos_topk = _round_up(int(pos_topk), 8) if pos_topk else 0
     return _blockwise_core(
-        features, labels, cfg, bn, bm, interpret, bool(sim_cache)
+        features, labels, cfg, bn, bm, interpret, bool(sim_cache),
+        pos_topk
     )
 
 
@@ -906,10 +1039,12 @@ def blockwise_npair_loss(features, labels, cfg=NPairLossConfig(),
                          block_size: int = 512,
                          q_block_size: Optional[int] = None,
                          interpret: Optional[bool] = None,
-                         sim_cache: Optional[bool] = None) -> jax.Array:
+                         sim_cache: Optional[bool] = None,
+                         pos_topk: Optional[int] = None) -> jax.Array:
     """Scalar blockwise N-pair loss (see ``blockwise_npair_loss_with_aux``)."""
     return blockwise_npair_loss_with_aux(
-        features, labels, cfg, block_size, q_block_size, interpret, sim_cache
+        features, labels, cfg, block_size, q_block_size, interpret,
+        sim_cache, pos_topk
     )[0]
 
 

@@ -36,10 +36,16 @@ masked pair value is recovered EXACTLY by MSD radix selection over
 sortable float bit-keys: NUM_DIGITS ring passes, each histogramming one
 RADIX_BITS-bit digit of the monotone uint32 key via scatter-free
 compare-and-reduce, narrow to the target element's exact bit pattern
-(SURVEY.md §7's "distributed top-k" growth path).  RELATIVE mining
-costs NUM_DIGITS-1 extra passes REGARDLESS of whether one or both
-sides are relative — the digit-0 histogram rides the stats pass for
-free, and later digits share one pass across sides.
+(SURVEY.md §7's "distributed top-k" growth path).  When both sides
+are relative, that costs NUM_DIGITS-1 extra passes total — the digit-0
+histogram rides the stats pass for free, and later digits share one
+pass across sides.  When only the POSITIVE side is relative (the
+flagship def.prototxt config), the sparse-positive fast path applies:
+identity-balanced sampling gives each query only a handful of
+positives, so the stats pass keeps a K-slot buffer of the largest
+same-label sims and the AP threshold is an N x K sort — ZERO extra
+ring passes, with a mesh-uniform runtime fallback to radix selection
+for labels that overflow the buffer.
 
 Memory is O(N x N_block) with ``sim_cache=False``.  By default
 (``sim_cache=None``) the engine keeps this shard's (G, N, N) fp32
@@ -71,6 +77,7 @@ from npairloss_tpu.ops.npair_loss import (
     _relative_pos,
     absolute_thresholds,
     selection_mask,
+    topk_relative_threshold,
 )
 from npairloss_tpu.ops.rank_select import (
     NUM_DIGITS,
@@ -178,7 +185,7 @@ def _cache_scan(cache, accum, carry, axis_name: str):
 def _stats_pass(
     feats, labels, my_rank, axis_name: str, top_k_max: int,
     hist0_same: bool = False, hist0_diff: bool = False,
-    emit_sims: bool = False,
+    emit_sims: bool = False, topk_same_k: int = 0,
 ):
     """Mining statistics in one ring pass; optionally also the digit-0
     radix histograms for RELATIVE_* sides — digit 0 needs no prefix, so
@@ -212,6 +219,12 @@ def _stats_pass(
         carry["hist0_same"] = jnp.zeros((n_local, RADIX_BINS), jnp.int32)
     if hist0_diff:
         carry["hist0_diff"] = jnp.zeros((n_local, RADIX_BINS), jnp.int32)
+    if topk_same_k:
+        # Sparse-positive fast path: the K largest same-label sims per
+        # query, maintained across hops (values are the SAME tile sims
+        # the stats/histograms read, so thresholds built from the buffer
+        # are bit-identical to radix selection over the ring).
+        carry["topk_same"] = jnp.full((n_local, topk_same_k), neg)
     if emit_sims:
         carry["sims_cache"] = jnp.zeros((g, n_local, n_local), jnp.float32)
         carry["labels_cache"] = jnp.zeros((g,) + labels.shape, labels.dtype)
@@ -249,6 +262,13 @@ def _stats_pass(
             c["hist0_diff"] = c["hist0_diff"] + masked_digit_hist(
                 sims, diff, zero_prefix, 0
             )
+        if topk_same_k:
+            c["topk_same"] = jax.lax.top_k(
+                jnp.concatenate(
+                    [c["topk_same"], jnp.where(same, sims, neg)], axis=1
+                ),
+                topk_same_k,
+            )[0]
         nonself = same | diff
         cat_sims = jnp.concatenate(
             [c["top_sims"], jnp.where(nonself, sims, neg)], axis=1
@@ -328,11 +348,56 @@ def _ring_thresholds(
     pos_thr, neg_thr = absolute_thresholds(
         stats["min_within"], stats["max_between"], cfg
     )
+    ap_rel = cfg.ap_mining_method in _RELATIVE
+    an_rel = cfg.an_mining_method in _RELATIVE
+    if not (ap_rel or an_rel):
+        return pos_thr, neg_thr
+
+    # Sparse-positive fast path (see ops.pallas_npair._thresholds): when
+    # AP is the only relative side and every query's positive count fits
+    # the stats pass's K-slot buffer, the per-rank threshold is an
+    # N x K sort — zero extra ring passes.  The cond predicate must be
+    # IDENTICAL on every shard (the radix branch runs ppermute
+    # collectives; shards disagreeing on the branch would deadlock), so
+    # the overflow check is pmax-reduced over the mesh axis.
+    if ap_rel and not an_rel and "topk_same" in stats:
+        def radix(include_ap):
+            return _ring_radix_thresholds(
+                feats, labels, my_rank, axis_name, cfg, stats, cache,
+                pos_thr, neg_thr, include_ap=include_ap,
+                include_an=an_rel)
+
+        kcap = stats["topk_same"].shape[1]
+        fits = jax.lax.pmax(
+            stats["count_same"].max(), axis_name) <= kcap
+
+        def fast(_):
+            n_local = feats.shape[0]
+            g = jax.lax.axis_size(axis_name)
+            p = topk_relative_threshold(
+                stats["topk_same"], stats["count_same"], cfg.identsn,
+                cfg.ap_mining_region,
+                count_dtype=population_count_dtype(n_local * n_local * g))
+            return p, radix(False)[1]
+
+        return jax.lax.cond(fits, fast, lambda _: radix(True), 0)
+
+    return _ring_radix_thresholds(
+        feats, labels, my_rank, axis_name, cfg, stats, cache,
+        pos_thr, neg_thr, include_ap=ap_rel, include_an=an_rel)
+
+
+def _ring_radix_thresholds(
+    feats, labels, my_rank, axis_name: str, cfg: NPairLossConfig, stats,
+    cache, pos_thr, neg_thr, include_ap, include_an,
+):
+    """The streamed radix-selection path of ``_ring_thresholds`` (see
+    there), restricted to the requested sides."""
     sides = {}
-    if cfg.ap_mining_method in _RELATIVE:
+    if include_ap:
         sides["ap"] = (True, cfg.identsn, cfg.ap_mining_region,
                        stats["count_same"], stats["hist0_same"])
-    if cfg.an_mining_method in _RELATIVE:
+    if include_an:
         sides["an"] = (False, cfg.diffsn, cfg.an_mining_region,
                        stats["count_diff"], stats["hist0_diff"])
     if not sides:
@@ -525,25 +590,32 @@ def _backward_pass(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _ring_core(features, labels, cfg, axis_name, top_ks, sim_cache):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _ring_core(features, labels, cfg, axis_name, top_ks, sim_cache,
+               pos_topk):
     out, _ = _ring_fwd_impl(
-        features, labels, cfg, axis_name, top_ks, sim_cache
+        features, labels, cfg, axis_name, top_ks, sim_cache, pos_topk
     )
     return out
 
 
-def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks, sim_cache):
+def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks, sim_cache,
+                   pos_topk=0):
     features = features.astype(jnp.float32)
     n_local = features.shape[0]
     my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
 
+    ap_rel = cfg.ap_mining_method in _RELATIVE
+    an_rel = cfg.an_mining_method in _RELATIVE
     top_k_max = max(top_ks) if top_ks else 1
     stats = _stats_pass(
         features, labels, my_rank, axis_name, top_k_max,
-        hist0_same=cfg.ap_mining_method in _RELATIVE,
-        hist0_diff=cfg.an_mining_method in _RELATIVE,
+        hist0_same=ap_rel,
+        hist0_diff=an_rel,
         emit_sims=sim_cache,
+        # The K-slot buffer only pays when AP is the sole relative side
+        # (see _ring_thresholds).
+        topk_same_k=pos_topk if ap_rel and not an_rel else 0,
     )
     cache = None
     if sim_cache:
@@ -601,13 +673,15 @@ def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks, sim_cache):
     return (loss, metrics), residuals
 
 
-def _ring_fwd(features, labels, cfg, axis_name, top_ks, sim_cache):
+def _ring_fwd(features, labels, cfg, axis_name, top_ks, sim_cache,
+              pos_topk):
     return _ring_fwd_impl(
-        features, labels, cfg, axis_name, top_ks, sim_cache
+        features, labels, cfg, axis_name, top_ks, sim_cache, pos_topk
     )
 
 
-def _ring_bwd(cfg, axis_name, top_ks, sim_cache, res, cotangents):
+def _ring_bwd(cfg, axis_name, top_ks, sim_cache, pos_topk, res,
+              cotangents):
     g_loss, _ = cotangents  # metrics are monitors, non-differentiable
     my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
     d_features = _backward_pass(
@@ -643,6 +717,7 @@ def ring_npair_loss_and_metrics(
     axis_name: str = "dp",
     top_ks: Sequence[int] = (1, 5, 10),
     sim_cache: Optional[bool] = None,
+    pos_topk: Optional[int] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Blockwise-ring N-pair loss + retrieval metrics for one shard.
 
@@ -662,12 +737,23 @@ def ring_npair_loss_and_metrics(
     Bit-identical to recompute.  Default ``None`` auto-enables when the
     slice is at most ``SIM_CACHE_AUTO_BYTES``; ``False`` restores pure
     O(N x N_block) streaming memory.
+
+    ``pos_topk``: K-slot sparse-positive fast path for RELATIVE_* AP
+    mining (see ``_ring_thresholds``): the stats pass keeps each
+    query's K largest same-label sims, and when every positive count
+    fits the buffer the AP threshold costs zero extra ring passes — the
+    flagship config then streams as few passes as absolute mining.  A
+    mesh-uniform ``lax.cond`` falls back to radix selection when a
+    label group overflows.  Default ``None`` = auto (8 slots); 0
+    disables the buffer.
     """
     _check_cfg(cfg)
     if sim_cache is None:
         g = jax.lax.axis_size(axis_name)
         n = features.shape[0]
         sim_cache = resolve_sim_cache_auto(g * n * n * 4, "ring")
+    pos_topk = 8 if pos_topk is None else int(pos_topk)
     return _ring_core(
-        features, labels, cfg, axis_name, tuple(top_ks), bool(sim_cache)
+        features, labels, cfg, axis_name, tuple(top_ks), bool(sim_cache),
+        pos_topk
     )

@@ -247,6 +247,74 @@ def test_blockwise_relative_clamp_quirk(rng):
     )
 
 
+@pytest.mark.parametrize("region", [MiningRegion.LOCAL, MiningRegion.GLOBAL])
+@pytest.mark.parametrize("imgs_per_id", [9, 11])
+def test_blockwise_pos_topk_fallback_boundary(rng, region, imgs_per_id):
+    """The sparse-positive fast path guards on cnt_s <= K: a group of 9
+    (cnt_s = 8) fits the 8-slot buffer exactly, a group of 11 overflows
+    and the lax.cond must fall back to radix selection — parity with the
+    dense path must hold on BOTH sides of the boundary."""
+    cfg = NPairLossConfig(
+        ap_mining_region=region,
+        ap_mining_method=MiningMethod.RELATIVE_HARD, identsn=-0.3,
+        an_mining_method=MiningMethod.HARD, margin_diff=-0.05,
+    )
+    (f,), (l,) = make_identity_batch(
+        rng, num_ids=3, imgs_per_id=imgs_per_id, dim=16)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+    loss_d, aux_d = npair_loss_with_aux(f, l, cfg)
+    loss_b, aux_b = blockwise_npair_loss_with_aux(
+        f, l, cfg, block_size=5, pos_topk=8)
+    np.testing.assert_allclose(loss_b, loss_d, rtol=1e-5, atol=1e-6)
+    # rtol covers the tile-vs-dense matmul's few-ULP reduction noise
+    # (see test_blockwise_relative_matches_dense); the selection itself
+    # is exact on the streamed sims.
+    np.testing.assert_allclose(
+        aux_b["pos_threshold"], aux_d["pos_threshold"], rtol=1e-5)
+    np.testing.assert_allclose(aux_b["ident_num"], aux_d["ident_num"])
+    gd = jax.grad(lambda x: npair_loss_with_aux(x, l, cfg)[0])(f)
+    gb = jax.grad(lambda x: blockwise_npair_loss_with_aux(
+        x, l, cfg, block_size=5, pos_topk=8)[0])(f)
+    np.testing.assert_allclose(gb, gd, rtol=1e-5, atol=1e-7)
+
+
+def test_blockwise_pos_topk_disabled_matches(rng):
+    """pos_topk=0 forces the pure radix path (no K-slot buffer in the
+    stats sweep) — it must stay exact, it is the fallback's substrate."""
+    cfg = REFERENCE_CONFIG
+    (f,), (l,) = make_identity_batch(rng, num_ids=6, imgs_per_id=2, dim=16)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+    loss_d, aux_d = npair_loss_with_aux(f, l, cfg)
+    loss_b, aux_b = blockwise_npair_loss_with_aux(
+        f, l, cfg, block_size=5, pos_topk=0)
+    np.testing.assert_allclose(loss_b, loss_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        aux_b["pos_threshold"], aux_d["pos_threshold"], rtol=1e-6)
+
+
+def test_blockwise_pos_topk_with_sim_cache(rng):
+    """Fast path + fp32 sim cache together (the 32k stretch shape):
+    cached and uncached must agree bit-for-bit, and both must match the
+    dense oracle."""
+    cfg = REFERENCE_CONFIG
+    (f,), (l,) = make_identity_batch(rng, num_ids=8, imgs_per_id=2, dim=12)
+    f, l = jnp.asarray(f), jnp.asarray(l)
+    loss_d, _ = npair_loss_with_aux(f, l, cfg)
+    loss_c, aux_c = blockwise_npair_loss_with_aux(
+        f, l, cfg, block_size=4, sim_cache=True)
+    loss_n, aux_n = blockwise_npair_loss_with_aux(
+        f, l, cfg, block_size=4, sim_cache=False)
+    assert float(loss_c) == float(loss_n)
+    np.testing.assert_array_equal(
+        aux_c["pos_threshold"], aux_n["pos_threshold"])
+    np.testing.assert_allclose(loss_c, loss_d, rtol=1e-5, atol=1e-6)
+    gc = jax.grad(lambda x: blockwise_npair_loss_with_aux(
+        x, l, cfg, block_size=4, sim_cache=True)[0])(f)
+    gn = jax.grad(lambda x: blockwise_npair_loss_with_aux(
+        x, l, cfg, block_size=4, sim_cache=False)[0])(f)
+    np.testing.assert_array_equal(gc, gn)
+
+
 def test_blockwise_zero_count_queries(rng):
     """Unique labels -> no positives anywhere -> loss must be exactly 0
     (the reference's zero-count guard, cu:133-154, cu:162-169)."""

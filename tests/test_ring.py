@@ -196,6 +196,41 @@ def test_ring_relative_matches_dense(rng, cfg_idx):
     )
 
 
+@pytest.mark.parametrize("num_ids,imgs", [(9, 8), (9, 16)])
+def test_ring_pos_topk_fallback_boundary(rng, num_ids, imgs):
+    """The ring's sparse-positive fast path guards on a pmax-agreed
+    cnt_s <= K: 8 imgs per identity (cnt_s=7) fits the 8-slot buffer,
+    16 overflows and every shard must take the radix fallback branch
+    together (a split vote would deadlock the ppermute collectives).
+    9 ids x {8,16} imgs over 8 shards puts 9 (resp. 18) rows per shard,
+    so label groups SPAN shard boundaries — the buffer must merge
+    positives arriving on different ring hops.  Parity with dense must
+    hold on both sides of the boundary."""
+    cfg = NPairLossConfig(
+        ap_mining_region=MiningRegion.GLOBAL,
+        ap_mining_method=MiningMethod.RELATIVE_HARD, identsn=-0.3,
+        an_mining_method=MiningMethod.HARD, margin_diff=-0.05,
+    )
+    mesh = _mesh()
+    g = len(mesh.devices)
+    per_shard = num_ids * imgs // g
+    assert num_ids * imgs == per_shard * g and per_shard % imgs != 0
+    feats, labs = make_identity_batch(rng, num_ids=num_ids,
+                                      imgs_per_id=imgs,
+                                      dim=16, num_shards=1)
+    f, l = np.concatenate(feats), np.concatenate(labs)
+    dense_v, dense_g = _dense_fns(mesh, cfg)
+    ring_v, ring_g = _ring_fns(mesh, cfg)
+    fj, lj = jnp.asarray(f), jnp.asarray(l)
+    dl, _ = dense_v(fj, lj)
+    rl, _ = ring_v(fj, lj)
+    np.testing.assert_allclose(
+        np.asarray(rl), np.asarray(dl), rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ring_g(fj, lj)), np.asarray(dense_g(fj, lj)),
+        rtol=3e-5, atol=1e-6)
+
+
 def test_ring_sim_cache_bit_identical(rng):
     """The per-shard similarity cache (parallel.ring sim_cache) replays
     exactly the tiles the recompute path produces, so cached and
