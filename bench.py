@@ -455,6 +455,15 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
             f_, l_, REFERENCE_CONFIG, sim_cache=False),
     )
     delta("blockwise_cache_nocache_delta", l_block_rel, l_block_rel_nc)
+    # pos_topk=0 forces the streamed radix path for the AP threshold —
+    # the delta against blockwise_flagship records the sparse-positive
+    # fast path's gain (round 4) as a driver artifact.
+    l_block_rel_radix = bench_one(
+        "blockwise_flagship_radix",
+        lambda f_, l_: blockwise_npair_loss(
+            f_, l_, REFERENCE_CONFIG, pos_topk=0),
+    )
+    delta("blockwise_postopk_radix_delta", l_block_rel, l_block_rel_radix)
     # Ring engine on a 1-device mesh: same pool, same math — isolates the
     # ring machinery's overhead (multi-pass tile recompute + ppermute)
     # against dense at an identical problem size (VERDICT r2 item 7).

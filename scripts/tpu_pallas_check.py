@@ -137,14 +137,15 @@ def main() -> int:
 
     reps = 3
 
-    def time_stretch(cfg, use_cache: bool, feats_t=None, labels_t=None):
+    def time_stretch(cfg, use_cache: bool, feats_t=None, labels_t=None,
+                     pos_topk=None):
         feats_t = feats_s if feats_t is None else feats_t
         labels_t = labels_s if labels_t is None else labels_t
         n_t = int(feats_t.shape[0])
         vg = jax.value_and_grad(
             lambda x: blockwise_npair_loss(
                 x, labels_t, cfg, block_size=args.block,
-                sim_cache=use_cache))
+                sim_cache=use_cache, pos_topk=pos_topk))
 
         @jax.jit
         def many(x, round_id):
@@ -206,6 +207,26 @@ def main() -> int:
     pk = peak_bytes()
     if pk is not None:
         record["peak_bytes_in_use_nocache"] = pk
+    # Radix-forced flagship row (pos_topk=0): the delta against
+    # flagship_nocache — whose AP threshold now rides the
+    # sparse-positive fast path — records the round-4 fast path's gain
+    # on hardware.  Parity between the two is the strongest on-chip
+    # check of the fast path (identical population, different selection
+    # machinery).
+    print(f"[tpu-check] stretch {ns}: flagship (radix, sim_cache=off)...",
+          file=sys.stderr, flush=True)
+    rec_r = time_stretch(REFERENCE_CONFIG, False, pos_topk=0)
+    record["stretch"]["flagship_radix_nocache"] = rec_r
+    rec_f = record["stretch"]["flagship_nocache"]
+    print(f"[tpu-check]   {rec_r['ms_per_step']:.1f} ms/step, "
+          f"{rec_r['embeddings_per_sec']:.0f} emb/s "
+          f"(fast path was {rec_f['ms_per_step']:.1f})",
+          file=sys.stderr, flush=True)
+    if abs(rec_r["loss"] - rec_f["loss"]) > 1e-4 * max(
+            1.0, abs(rec_f["loss"])):
+        print(f"[tpu-check]   FAST-PATH PARITY FAIL: {rec_f['loss']} vs "
+              f"{rec_r['loss']}", file=sys.stderr, flush=True)
+        ok = False
     nc = args.stretch_cached or ns
     record["cached_pool"] = nc
     if nc != ns:
