@@ -66,6 +66,21 @@ def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
     )
 
 
+def _pos_topk_arg(v: str):
+    """argparse type for --pos-topk: 'auto' or a non-negative int."""
+    if v == "auto":
+        return "auto"
+    try:
+        k = int(v)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or a non-negative integer, got {v!r}")
+    if k < 0:
+        raise argparse.ArgumentTypeError(
+            f"buffer slots must be >= 0, got {k}")
+    return k
+
+
 def _build_solver(args):
     """Shared setup for train/test/extract: parse the solver + net
     prototxts, build the model and (optional) mesh, restore a snapshot.
@@ -146,10 +161,12 @@ def _build_solver(args):
     model = get_model(model_name, dtype=dtype, **model_kw)
 
     sim_cache = getattr(args, "sim_cache", None)
+    pos_topk = getattr(args, "pos_topk", None)
     solver = Solver(
         model, loss_cfg, solver_cfg, mesh=mesh, input_shape=input_shape,
         engine=engine,
         sim_cache={"auto": None, "on": True, "off": False}[sim_cache or "auto"],
+        pos_topk=None if pos_topk in (None, "auto") else int(pos_topk),
     )
     if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)
@@ -543,6 +560,11 @@ def main(argv: Optional[list] = None) -> int:
         help="loss engine (default: dense; ring streams the pool over a "
         "mesh, blockwise streams Pallas tiles on one device)",
     )
+    t.add_argument(
+        "--pos-topk", dest="pos_topk", default="auto", metavar="K",
+        type=_pos_topk_arg,
+        help="streaming engines' sparse-positive buffer slots for "
+        "RELATIVE AP mining (auto = 8; 0 forces radix selection)")
     t.add_argument(
         "--sim-cache", dest="sim_cache", choices=["auto", "on", "off"],
         default="auto",

@@ -1026,6 +1026,8 @@ def blockwise_npair_loss_with_aux(
         sim_cache = resolve_sim_cache_auto(n_p * m_p * 4, "blockwise")
     if pos_topk is None:
         pos_topk = 8
+    if int(pos_topk) < 0:
+        raise ValueError(f"pos_topk must be >= 0, got {pos_topk}")
     # fp32 (8, 128) tiling: the K-slot buffer's sublane dim must be a
     # multiple of 8 (extra slots just carry more padding).
     pos_topk = _round_up(int(pos_topk), 8) if pos_topk else 0

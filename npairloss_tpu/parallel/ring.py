@@ -753,6 +753,8 @@ def ring_npair_loss_and_metrics(
         n = features.shape[0]
         sim_cache = resolve_sim_cache_auto(g * n * n * 4, "ring")
     pos_topk = 8 if pos_topk is None else int(pos_topk)
+    if pos_topk < 0:
+        raise ValueError(f"pos_topk must be >= 0, got {pos_topk}")
     return _ring_core(
         features, labels, cfg, axis_name, tuple(top_ks), bool(sim_cache),
         pos_topk

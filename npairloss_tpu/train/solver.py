@@ -86,6 +86,7 @@ class Solver:
         use_ring: bool = False,
         engine: Optional[str] = None,
         sim_cache: Optional[bool] = None,
+        pos_topk: Optional[int] = None,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
@@ -111,6 +112,9 @@ class Solver:
         # False forces strict streaming memory) — see ops.pallas_npair /
         # parallel.ring ``sim_cache``.
         self.sim_cache = sim_cache
+        # Streaming engines' sparse-positive buffer size (None = auto 8;
+        # 0 forces radix selection) — see ``pos_topk`` there.
+        self.pos_topk = pos_topk
         self.use_ring = engine == "ring"
         if engine == "ring" and mesh is None:
             raise ValueError('engine="ring" requires a mesh')
@@ -192,7 +196,8 @@ class Solver:
             )
 
             loss, _ = blockwise_npair_loss_with_aux(
-                emb, labels, self.loss_cfg, sim_cache=self.sim_cache
+                emb, labels, self.loss_cfg, sim_cache=self.sim_cache,
+                pos_topk=self.pos_topk,
             )
             metrics = blockwise_retrieval_metrics(
                 jax.lax.stop_gradient(emb), labels, self.top_ks
@@ -217,7 +222,7 @@ class Solver:
 
                 loss, metrics = ring_npair_loss_and_metrics(
                     e, l, self.loss_cfg, self.axis, self.top_ks,
-                    sim_cache=self.sim_cache,
+                    sim_cache=self.sim_cache, pos_topk=self.pos_topk,
                 )
                 metrics = {
                     k: v for k, v in metrics.items()

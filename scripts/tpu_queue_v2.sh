@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-4 hardware queue, second pass — ORDERED BY HAZARD.
+#
+# The first pass (tpu_revalidate.sh) dispatched the 32k cached-stretch
+# program early; its 4.3 GiB-cache dispatch wedged the tunneled v5e
+# backend server-side (every later client got UNAVAILABLE), which
+# zeroed the profile artifact and degraded bench.py to its CPU-smoke
+# fallback.  This queue runs every SAFE workload first so one wedge
+# cannot void the round's evidence, and probes the cache boundary from
+# small pools upward, LAST.
+#   1. profile_flagship        -> profile/flagship.{json,md}
+#   2. bench.py full           -> /tmp/bench_out.json (+ last_good cache)
+#   3. tpu_pallas_check        -> parity + 32k uncached stretch + cached
+#                                 rows at 16384 (1 GiB cache, in-budget)
+#   4. e2e real-JPEG on chip   -> accuracy/e2e_real_jpeg_tpu.json
+#   5. diag_sim_cache 8k,16k   -> phase timings + HBM peaks (log only)
+#   6. (LAST, wedge-risk) diag 24576 — pins the boundary; a wedge here
+#      costs nothing already captured.
+# Run detached:  setsid nohup scripts/tpu_queue_v2.sh &
+# Log: /tmp/tpu_queue_v2.log
+cd "$(dirname "$0")/.."
+exec > /tmp/tpu_queue_v2.log 2>&1
+
+echo "=== $(date) waiting for tunnel ==="
+for i in $(seq 1 600); do
+  if timeout 100 python -c 'import jax,sys; sys.exit(jax.devices()[0].platform != "tpu")' >/dev/null 2>&1; then
+    echo "tunnel up (platform=tpu) after probe $i ($(date))"
+    break
+  fi
+  echo "probe $i failed ($(date)); sleeping 180s"
+  sleep 180
+  if [ "$i" = 600 ]; then echo "GAVE UP"; exit 1; fi
+done
+
+echo "=== $(date) 1/6 profile_flagship (incl. s2d + remat ablations) ==="
+timeout 3600 python scripts/profile_flagship.py --steps 10
+echo "profile rc=$?"
+
+echo "=== $(date) 2/6 bench.py full ==="
+timeout 3000 python bench.py > /tmp/bench_out.json
+echo "bench rc=$?"
+tail -c 1000 /tmp/bench_out.json
+
+echo "=== $(date) 3/6 tpu_pallas_check (parity + stretch, cached@16k) ==="
+timeout 2400 python scripts/tpu_pallas_check.py --pool 4096 \
+  --stretch 32768 --stretch-cached 16384 > /tmp/tpu_check_out.json
+rc=$?
+echo "tpu_pallas_check rc=$rc"
+tail -c 2000 /tmp/tpu_check_out.json
+if [ "$rc" = 0 ]; then python scripts/split_pallas_check.py; fi
+
+echo "=== $(date) 4/6 TPU accuracy smoke (e2e real-JPEG on the chip) ==="
+timeout 2400 env E2E_JAX_PLATFORM=default python scripts/e2e_real_jpeg.py \
+  --steps 200 --workdir /tmp/e2e_jpeg_tpu2 \
+  --artifact accuracy/e2e_real_jpeg_tpu.json
+echo "e2e tpu rc=$?"
+
+echo "=== $(date) 5/6 diag_sim_cache 8192,16384 (safe pools) ==="
+timeout 1800 python scripts/diag_sim_cache.py --pools 8192,16384
+echo "diag safe rc=$?"
+
+echo "=== $(date) 6/6 diag_sim_cache 24576 (WEDGE-RISK, runs last) ==="
+timeout 1200 python scripts/diag_sim_cache.py --pools 24576
+echo "diag 24576 rc=$?"
+
+echo "=== $(date) QUEUE V2 DONE ==="
