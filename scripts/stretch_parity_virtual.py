@@ -100,14 +100,17 @@ def main() -> int:
     # N x N*G block statistics, cu:327-334 — a G=1 dense run would be a
     # DIFFERENT math, not an oracle).  Composition mirrors
     # tests/test_ring.py::_dense_fns/_ring_fns, scaled to the full pool.
-    def ring_shard(xs, ls):
-        loss = ring_npair_loss_and_metrics(xs, ls, cfg, "dp", top_ks=())[0]
-        grad = jax.grad(
-            lambda x_: ring_npair_loss_and_metrics(
-                x_, ls, cfg, "dp", top_ks=()
-            )[0]
-        )(xs)
-        return loss[None], grad
+    def ring_shard(pos_topk):
+        def fn(xs, ls):
+            loss = ring_npair_loss_and_metrics(
+                xs, ls, cfg, "dp", top_ks=(), pos_topk=pos_topk)[0]
+            grad = jax.grad(
+                lambda x_: ring_npair_loss_and_metrics(
+                    x_, ls, cfg, "dp", top_ks=(), pos_topk=pos_topk
+                )[0]
+            )(xs)
+            return loss[None], grad
+        return fn
 
     def dense_shard(xs, ls):
         # npair_loss(axis_name=...) all-gathers the pool in-graph and
@@ -166,7 +169,8 @@ def main() -> int:
         try:
             with open(args.out) as fo:
                 prev = json.load(fo)
-            for key in ("ring", "blockwise"):
+            for key in ("ring", "ring_radix", "blockwise",
+                        "blockwise_radix"):
                 if key in prev:
                     record[key] = prev[key]
         except Exception:
@@ -174,20 +178,28 @@ def main() -> int:
 
     ok = True
     if not args.skip_ring:
-        ring_losses, gr = run(
-            "ring (8-shard ppermute streaming)", ring_shard)
         dense_losses, gd = run(
             "dense oracle (per-rank pair matrices)", dense_shard)
-        sec, sec_ok = parity(
-            "ring", "dense", ring_losses, gr, dense_losses, gd)
-        ok = ok and sec_ok
-        record["ring"] = {
-            "pool": n, "dim": d, "shards": g, **sec,
-            "note": "per-rank semantics on the 8-shard mesh, both sides",
-        }
-        log(f"ring section {'OK' if sec_ok else 'FAIL'}: "
-            f"loss d={sec['loss_delta']:.2e}, "
-            f"grad max d={sec['grad_max_delta']:.2e}")
+        # Both AP-threshold machineries at the full stretch pool: the
+        # sparse-positive fast path (default, round 4) and the radix
+        # selection it falls back to (pos_topk=0; rank population ~1e9
+        # pairs — the count-arithmetic scale no unit test reaches).
+        for key, pos_topk, label in (
+            ("ring", None, "ring (sparse-positive fast path)"),
+            ("ring_radix", 0, "ring (radix selection, pos_topk=0)"),
+        ):
+            ring_losses, gr = run(label, ring_shard(pos_topk))
+            sec, sec_ok = parity(
+                "ring", "dense", ring_losses, gr, dense_losses, gd)
+            ok = ok and sec_ok
+            record[key] = {
+                "pool": n, "dim": d, "shards": g, "pos_topk": pos_topk,
+                **sec,
+                "note": "per-rank semantics on the 8-shard mesh, both sides",
+            }
+            log(f"{key} section {'OK' if sec_ok else 'FAIL'}: "
+                f"loss d={sec['loss_delta']:.2e}, "
+                f"grad max d={sec['grad_max_delta']:.2e}")
 
     if args.blockwise_pool:
         from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss
@@ -199,29 +211,30 @@ def main() -> int:
         labels_b = jnp.asarray(
             np.repeat(np.arange(nb // 2), 2).astype(np.int32))
         log(f"blockwise section: pool {nb} (interpret mode on CPU)...")
-        t0 = time.time()
-        lb_, gb_ = jax.jit(jax.value_and_grad(
-            lambda x: blockwise_npair_loss(x, labels_b, cfg)))(feats_b)
-        lb_, gb_ = np.asarray(lb_), np.asarray(gb_)
-        log(f"blockwise loss {float(lb_):.6f} "
-            f"({time.time() - t0:.0f}s); dense oracle...")
         ld_, gd_ = jax.jit(jax.value_and_grad(
             lambda x: npair_loss(x, labels_b, cfg)))(feats_b)
         ld_, gd_ = np.asarray(ld_), np.asarray(gd_)
-        sec, sec_ok = parity(
-            "blockwise", "dense",
-            np.asarray([lb_]), gb_, np.asarray([ld_]), gd_)
-        ok = ok and sec_ok
-        record["blockwise"] = {
-            "pool": nb, "dim": d, "block": 512,
-            "interpret": True, **sec,
-            "note": ("single-rank semantics (the blockwise engine is the "
-                     "single-chip path); Pallas interpret mode — the "
-                     "Mosaic-compiled twin is PALLAS_CHECK.json"),
-        }
-        log(f"blockwise section {'OK' if sec_ok else 'FAIL'}: "
-            f"loss d={sec['loss_delta']:.2e}, "
-            f"grad max d={sec['grad_max_delta']:.2e}")
+        for key, pos_topk in (("blockwise", None), ("blockwise_radix", 0)):
+            t0 = time.time()
+            lb_, gb_ = jax.jit(jax.value_and_grad(
+                lambda x: blockwise_npair_loss(
+                    x, labels_b, cfg, pos_topk=pos_topk)))(feats_b)
+            lb_, gb_ = np.asarray(lb_), np.asarray(gb_)
+            log(f"{key} loss {float(lb_):.6f} ({time.time() - t0:.0f}s)")
+            sec, sec_ok = parity(
+                "blockwise", "dense",
+                np.asarray([lb_]), gb_, np.asarray([ld_]), gd_)
+            ok = ok and sec_ok
+            record[key] = {
+                "pool": nb, "dim": d, "block": 512,
+                "interpret": True, "pos_topk": pos_topk, **sec,
+                "note": ("single-rank semantics (the blockwise engine is "
+                         "the single-chip path); Pallas interpret mode — "
+                         "the Mosaic-compiled twin is PALLAS_CHECK.json"),
+            }
+            log(f"{key} section {'OK' if sec_ok else 'FAIL'}: "
+                f"loss d={sec['loss_delta']:.2e}, "
+                f"grad max d={sec['grad_max_delta']:.2e}")
 
     record["ok"] = bool(ok)
     record["elapsed_s"] = round(time.time() - T0, 1)
