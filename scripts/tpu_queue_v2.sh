@@ -42,7 +42,7 @@ echo "bench rc=$?"
 tail -c 1000 /tmp/bench_out.json
 
 echo "=== $(date) 3/6 tpu_pallas_check (parity + stretch, cached@16k) ==="
-timeout 2400 python scripts/tpu_pallas_check.py --pool 4096 \
+timeout 3300 python scripts/tpu_pallas_check.py --pool 4096 \
   --stretch 32768 --stretch-cached 16384 > /tmp/tpu_check_out.json
 rc=$?
 echo "tpu_pallas_check rc=$rc"
