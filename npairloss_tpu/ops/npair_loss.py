@@ -52,11 +52,19 @@ from npairloss_tpu.ops.rank_select import masked_digit_hist, radix_select
 FLT_MAX = float(np.finfo(np.float32).max)
 
 # Auto-enable a streaming engine's fp32 similarity cache when the cached
-# slice is at most this many bytes (6 GiB covers the 32k stretch pool's
-# 4.3 GB single-chip slice on a 16 GB-HBM v5e while leaving room for
-# feats/grads/workspaces).  Shared by ops.pallas_npair and parallel.ring.
-# ``resolve_sim_cache_auto`` additionally caps the budget at 3/8 of the
-# device's reported HBM, so small-memory devices don't auto-OOM.
+# slice is at most this many bytes.  Shared by ops.pallas_npair and
+# parallel.ring.  ``resolve_sim_cache_auto`` additionally caps the
+# budget at 1/5 of the device's reported HBM: round 4 found that
+# DISPATCHING the cached program with the 32k pool's 4.0 GiB (4.29 GB)
+# cache on a 16 GiB v5e wedges the tunneled backend outright (every
+# later client gets UNAVAILABLE until the server resets).  4.0 GiB is
+# EXACTLY 16 GiB / 4, so a quarter-of-HBM cap would sit at a zero
+# margin; 1/5 (3.2 GiB on v5e) rejects it with real slack while still
+# admitting the 24k pool's 2.25 GiB slice.  Backends that report no
+# memory stats get a conservative 2 GiB budget — the hazard is a
+# backend-wedging dispatch, not a recoverable OOM, so the unknown case
+# fails closed.  Pass ``sim_cache=True`` to override explicitly, at
+# your own risk.
 SIM_CACHE_AUTO_BYTES = 6 << 30
 
 _SIM_CACHE_LOGGED = set()
@@ -66,20 +74,23 @@ def resolve_sim_cache_auto(cache_bytes: int, engine: str) -> bool:
     """Decide whether a streaming engine's fp32 sim cache auto-enables.
 
     The cache rides the VJP residuals through the whole model backward,
-    so the budget is sized against the device's reported memory (3/8 of
-    ``bytes_limit``, capped at ``SIM_CACHE_AUTO_BYTES``) rather than a
-    blind constant, and every auto-enable is logged ONCE per
+    so the budget is sized against the device's reported memory (1/5 of
+    ``bytes_limit`` — see the hazard note on ``SIM_CACHE_AUTO_BYTES`` —
+    capped at that constant; a conservative 2 GiB when the backend
+    reports no memory stats), and every auto-enable is logged ONCE per
     (engine, size) so an OOM regression is attributable to the cache
     (ADVICE r3).  Explicit ``sim_cache=True/False`` never reaches here.
     """
     budget = SIM_CACHE_AUTO_BYTES
+    limit = 0
     try:
         stats = jax.devices()[0].memory_stats() or {}
         limit = int(stats.get("bytes_limit", 0))
-        if limit > 0:
-            budget = min(budget, int(limit * 3) // 8)
     except Exception:
-        pass  # backends without memory stats keep the constant budget
+        pass
+    # Unknown memory fails CLOSED (the hazard is a backend-wedging
+    # dispatch, not a recoverable OOM).
+    budget = min(budget, limit // 5 if limit > 0 else 2 << 30)
     enable = cache_bytes <= budget
     key = (engine, cache_bytes, enable)
     if enable and key not in _SIM_CACHE_LOGGED:

@@ -60,7 +60,7 @@ def split(rec, out_dir, date=None):
                  f"{cache_gib:.2f} GiB fp32 sim matrix once in the stats "
                  "sweep and streams it back in the radix/loss/backward "
                  "sweeps (see docs/DESIGN.md); cached rows run at "
-                 "'cached_pool' (a 4.3 GiB cache dispatch wedges the "
+                 "'cached_pool' (a 4.0 GiB cache dispatch wedges the "
                  "tunneled v5e backend — round-4 finding). Timed as 3 "
                  "perturbed steps inside one jitted lax.scan, host-fetch "
                  "synced, dispatch floor subtracted (bench.py timing "

@@ -33,8 +33,8 @@ def main() -> int:
     ap.add_argument(
         "--stretch-cached", type=int, default=None,
         help="pool for the sim_cache=on stretch rows (default: --stretch). "
-        "Round 4 found that dispatching the cached program with a 4.3 GiB "
-        "cache (pool 32768) WEDGES the tunneled v5e backend server-side — "
+        "Round 4 found that dispatching the cached program with the 32k "
+        "pool's exactly-4.0-GiB cache WEDGES the tunneled v5e backend — "
         "every later client gets UNAVAILABLE until the tunnel resets — so "
         "the revalidation queue measures the cached rows at a pool the "
         "auto-gate accepts and records the 32k auto verdict separately.")

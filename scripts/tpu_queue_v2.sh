@@ -2,7 +2,7 @@
 # Round-4 hardware queue, second pass — ORDERED BY HAZARD.
 #
 # The first pass (tpu_revalidate.sh) dispatched the 32k cached-stretch
-# program early; its 4.3 GiB-cache dispatch wedged the tunneled v5e
+# program early; its 4.0 GiB-cache dispatch wedged the tunneled v5e
 # backend server-side (every later client got UNAVAILABLE), which
 # zeroed the profile artifact and degraded bench.py to its CPU-smoke
 # fallback.  This queue runs every SAFE workload first so one wedge

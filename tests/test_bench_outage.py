@@ -88,6 +88,35 @@ def test_sim_cache_auto_is_budgeted_and_logged(caplog):
     assert not caplog.records
 
 
+def test_sim_cache_auto_hbm_cap(monkeypatch):
+    """The 1/5-of-HBM cap must reject the 32k pool's exactly-4.0-GiB
+    slice on a full-16-GiB report (dispatching it wedges the tunneled
+    v5e backend — round 4) and admit the 24k pool's 2.25 GiB; backends
+    with no memory stats fail CLOSED to a 2 GiB budget."""
+    import jax
+
+    from npairloss_tpu.ops.npair_loss import resolve_sim_cache_auto
+
+    class FakeDev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    def with_stats(stats):
+        monkeypatch.setattr(jax, "devices", lambda: [FakeDev(stats)])
+
+    gib = 1 << 30
+    with_stats({"bytes_limit": 16 * gib})
+    assert resolve_sim_cache_auto(32768 * 32768 * 4, "t") is False  # 4.0 GiB
+    assert resolve_sim_cache_auto(24576 * 24576 * 4, "t") is True  # 2.25 GiB
+    # No stats -> conservative 2 GiB budget, not the 6 GiB constant.
+    with_stats(None)
+    assert resolve_sim_cache_auto(3 * gib, "t") is False
+    assert resolve_sim_cache_auto(1 * gib, "t") is True
+
+
 def _load_split():
     spec = importlib.util.spec_from_file_location(
         "split_mod", os.path.join(REPO, "scripts", "split_pallas_check.py")
