@@ -464,6 +464,22 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
             f_, l_, REFERENCE_CONFIG, pos_topk=0),
     )
     delta("blockwise_postopk_radix_delta", l_block_rel, l_block_rel_radix)
+    # matmul_precision="default": the opt-in single-pass bf16 MXU mode
+    # (round 4) — records the throughput headroom users buy by giving
+    # up oracle bit-parity.  The loss delta vs the HIGHEST rows is the
+    # recorded price.
+    l_block_rel_bf16 = bench_one(
+        "blockwise_flagship_bf16matmul",
+        lambda f_, l_: blockwise_npair_loss(
+            f_, l_, REFERENCE_CONFIG, matmul_precision="default"),
+    )
+    delta("blockwise_bf16matmul_loss_delta", l_block_rel, l_block_rel_bf16)
+    l_dense_rel_bf16 = bench_one(
+        "dense_flagship_bf16matmul",
+        lambda f_, l_: npair_loss(
+            f_, l_, REFERENCE_CONFIG, matmul_precision="default"),
+    )
+    delta("dense_bf16matmul_loss_delta", l_dense_rel, l_dense_rel_bf16)
     # Ring engine on a 1-device mesh: same pool, same math — isolates the
     # ring machinery's overhead (multi-pass tile recompute + ppermute)
     # against dense at an identical problem size (VERDICT r2 item 7).

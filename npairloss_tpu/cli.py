@@ -167,6 +167,7 @@ def _build_solver(args):
         engine=engine,
         sim_cache={"auto": None, "on": True, "off": False}[sim_cache or "auto"],
         pos_topk=None if pos_topk in (None, "auto") else int(pos_topk),
+        matmul_precision=getattr(args, "matmul_precision", None),
     )
     if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)
@@ -569,6 +570,12 @@ def main(argv: Optional[list] = None) -> int:
         "--sim-cache", dest="sim_cache", choices=["auto", "on", "off"],
         default="auto",
         help="streaming engines' fp32 similarity cache (auto = by size)",
+    )
+    t.add_argument(
+        "--matmul-precision", dest="matmul_precision",
+        choices=["highest", "default"], default=None,
+        help="loss-engine gemm precision: highest = oracle bit-parity "
+        "(default), default = ~6x single-pass bf16 MXU throughput mode",
     )
     t.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
     t.add_argument(

@@ -38,6 +38,8 @@ Reference quirks that are preserved bit-for-bit (each has a named test):
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import enum
 import functools
@@ -470,11 +472,61 @@ def selection_mask(
 # ---------------------------------------------------------------------------
 
 
+def resolve_matmul_precision(precision: Optional[str]) -> jax.lax.Precision:
+    """Engine-wide similarity-matmul precision knob.
+
+    ``"highest"`` (default everywhere) keeps full fp32 on the MXU — the
+    ~6-pass bf16 decomposition that bit-matches the reference's cuBLAS
+    sgemm (cu:218) and the NumPy oracle.  ``"default"`` opts into the
+    single-pass bf16-multiply/fp32-accumulate MXU mode: ~6x faster sim
+    and backward gemms, at ~1e-3-level sim rounding — mined thresholds
+    and selections then differ from the oracle near decision boundaries,
+    so this is a THROUGHPUT mode, not a parity mode (training-quality
+    pinned by test, bit-parity deliberately not claimed).
+    """
+    if precision is None:
+        return jax.lax.Precision.HIGHEST
+    try:
+        return {
+            "highest": jax.lax.Precision.HIGHEST,
+            "default": jax.lax.Precision.DEFAULT,
+        }[precision]
+    except KeyError:
+        raise ValueError(
+            f"matmul_precision must be 'highest' or 'default', got "
+            f"{precision!r}") from None
+
+
+# Trace-time precision for the streaming engines' kernel gemms (the
+# dense engine threads the string directly).  A ContextVar — not a
+# module global — so concurrent traces in different threads cannot
+# cross-contaminate: each engine wraps its fwd/bwd tracing in
+# ``matmul_precision_ctx`` and the kernel bodies read
+# ``active_matmul_precision()`` while being traced inside it.
+_MATMUL_PRECISION_VAR = contextvars.ContextVar(
+    "npair_matmul_precision", default=jax.lax.Precision.HIGHEST)
+
+
+@contextlib.contextmanager
+def matmul_precision_ctx(matmul_precision: Optional[str]):
+    token = _MATMUL_PRECISION_VAR.set(
+        resolve_matmul_precision(matmul_precision))
+    try:
+        yield
+    finally:
+        _MATMUL_PRECISION_VAR.reset(token)
+
+
+def active_matmul_precision() -> jax.lax.Precision:
+    return _MATMUL_PRECISION_VAR.get()
+
+
 def _forward_core(
     features: jax.Array,
     labels: jax.Array,
     cfg: NPairLossConfig,
     axis_name: Optional[str],
+    matmul_precision: Optional[str] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array], Dict[str, jax.Array]]:
     """Shared forward; returns (loss, aux-for-metrics, residuals-for-vjp)."""
     features = features.astype(jnp.float32)
@@ -500,15 +552,16 @@ def _forward_core(
         num_shards = jax.lax.axis_size(axis_name)
 
     # Similarity matrix S = F_local @ F_total^T on the MXU (cu:218,
-    # dot_normalizer = 1 in forward per cu:216).  HIGHEST keeps full fp32 on
-    # the MXU — the TPU default would truncate fp32 operands to bf16 and
-    # break bit-parity with the oracle.
+    # dot_normalizer = 1 in forward per cu:216).  HIGHEST (the default —
+    # see resolve_matmul_precision) keeps full fp32 on the MXU; the TPU
+    # default mode would truncate fp32 operands to bf16 and break
+    # bit-parity with the oracle.
     with jax.named_scope("npair/sim"):
         sims = jnp.dot(
             features,
             total_features.T,
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=resolve_matmul_precision(matmul_precision),
         )
 
     with jax.named_scope("npair/mine"):
@@ -567,7 +620,8 @@ def _forward_core(
 
 
 def _reference_backward(
-    res: Dict[str, Any], g: jax.Array, axis_name: Optional[str]
+    res: Dict[str, Any], g: jax.Array, axis_name: Optional[str],
+    matmul_precision: Optional[str] = None,
 ) -> jax.Array:
     """Analytic backward with the reference's exact scaling (cu:420-499).
 
@@ -592,17 +646,18 @@ def _reference_backward(
     # dot_normalizer is the query count in backward (cu:427), unlike forward.
     w = (-p1 + p2 + p3) * (g / jnp.float32(n_local))
 
+    prec = resolve_matmul_precision(matmul_precision)
     grad_query = jnp.dot(
         w,
         total_features,
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=prec,
     )
     grad_db = jnp.dot(
         w.T,
         features,
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=prec,
     )
 
     if axis_name is not None:
@@ -615,21 +670,24 @@ def _reference_backward(
     return 0.5 * own_rows + 0.5 * grad_query
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _npair_core(features, labels, cfg: NPairLossConfig, axis_name: Optional[str]):
-    loss, aux, _ = _forward_core(features, labels, cfg, axis_name)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _npair_core(features, labels, cfg: NPairLossConfig,
+                axis_name: Optional[str], matmul_precision: Optional[str]):
+    loss, aux, _ = _forward_core(
+        features, labels, cfg, axis_name, matmul_precision)
     return loss, aux
 
 
-def _npair_core_fwd(features, labels, cfg, axis_name):
-    loss, aux, res = _forward_core(features, labels, cfg, axis_name)
+def _npair_core_fwd(features, labels, cfg, axis_name, matmul_precision):
+    loss, aux, res = _forward_core(
+        features, labels, cfg, axis_name, matmul_precision)
     res["labels"] = labels
     return (loss, aux), res
 
 
-def _npair_core_bwd(cfg, axis_name, res, cotangents):
+def _npair_core_bwd(cfg, axis_name, matmul_precision, res, cotangents):
     g, _ = cotangents  # aux outputs are non-differentiable monitors
-    d_features = _reference_backward(res, g, axis_name)
+    d_features = _reference_backward(res, g, axis_name, matmul_precision)
     labels = res["labels"]
     if jnp.issubdtype(labels.dtype, jnp.floating):
         d_labels = jnp.zeros(labels.shape, labels.dtype)
@@ -646,6 +704,7 @@ def npair_loss_with_aux(
     labels: jax.Array,
     cfg: NPairLossConfig = NPairLossConfig(),
     axis_name: Optional[str] = None,
+    matmul_precision: Optional[str] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Multi-class N-pair loss with mining; returns (loss, aux).
 
@@ -657,18 +716,23 @@ def npair_loss_with_aux(
       cfg: static mining/margin configuration.
       axis_name: mesh axis to all-gather the negative pool over; ``None``
         means single-shard (G = 1).
+      matmul_precision: sim/backward gemm MXU precision — ``None``/
+        ``"highest"`` for oracle bit-parity, ``"default"`` for the ~6x
+        faster single-pass bf16 mode (``resolve_matmul_precision``).
 
     The returned ``aux`` feeds the retrieval metrics (``ops.metrics``); it is
     NOT differentiable — gradients flow only through the loss, mirroring the
     reference where thresholds, masks and counts are constants in backward.
     """
     if cfg.grad_mode == "reference":
-        return _npair_core(features, labels, cfg, axis_name)
+        return _npair_core(features, labels, cfg, axis_name,
+                           matmul_precision)
     loss, aux, _ = _forward_core(
         features,
         jax.lax.stop_gradient(labels),
         cfg,
         axis_name,
+        matmul_precision,
     )
     return loss, jax.lax.stop_gradient(aux)
 
@@ -678,6 +742,8 @@ def npair_loss(
     labels: jax.Array,
     cfg: NPairLossConfig = NPairLossConfig(),
     axis_name: Optional[str] = None,
+    matmul_precision: Optional[str] = None,
 ) -> jax.Array:
     """Scalar multi-class N-pair loss (see ``npair_loss_with_aux``)."""
-    return npair_loss_with_aux(features, labels, cfg, axis_name)[0]
+    return npair_loss_with_aux(
+        features, labels, cfg, axis_name, matmul_precision)[0]

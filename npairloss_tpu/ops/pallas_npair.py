@@ -86,6 +86,8 @@ from npairloss_tpu.ops.npair_loss import (
     _clamp_negative,
     _relative_pos,
     absolute_thresholds,
+    active_matmul_precision,
+    matmul_precision_ctx,
     selection_predicates,
     topk_relative_threshold,
 )
@@ -165,14 +167,22 @@ def _tile_masks(scal_ref, labels_ref, pool_labels_ref, qi, ii, bn: int, bm: int)
     return same, diff
 
 
+# Every kernel gemm reads the trace-time precision ContextVar
+# (ops.npair_loss.active_matmul_precision): HIGHEST by default — the
+# TPU default mode would truncate to bf16 and break bit-parity with
+# the dense path (cu:218 semantics) — and the single-pass bf16 mode
+# when ``blockwise_npair_loss(matmul_precision="default")`` wraps the
+# trace in ``matmul_precision_ctx``.  Kernels are rebuilt at every
+# trace, so the setting is captured per-computation and thread-safely.
+_precision_ctx = matmul_precision_ctx
+
+
 def _sim_tile(feats_ref, pool_ref):
-    # HIGHEST keeps full fp32 on the MXU — the default would truncate to
-    # bf16 and break bit-parity with the dense path (cu:218 semantics).
     return jnp.dot(
         feats_ref[:],
         pool_ref[:].T,
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=active_matmul_precision(),
     )
 
 
@@ -464,7 +474,7 @@ def _make_gq_kernel(cfg: NPairLossConfig, cached: bool = False):
         gq_ref[:] += jnp.dot(
             w, pool_ref[:],
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=active_matmul_precision(),
         )
 
     return _sim_kernel(body, extra="pool")(cached)
@@ -489,7 +499,7 @@ def _make_gdb_kernel(cfg: NPairLossConfig, cached: bool = False):
         gdb_ref[:] += jnp.dot(
             w.T, feats_ref[:],
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=active_matmul_precision(),
         )
 
     return _sim_kernel(body, extra="feats")(cached)
@@ -852,17 +862,25 @@ def _radix_thresholds(feats_p, labels_p, pool_p, pool_labels_p, scal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
 def _blockwise_core(features, labels, cfg, bn, bm, interpret, cache,
-                    pos_topk):
+                    pos_topk, matmul_precision):
     out, _ = _blockwise_fwd_impl(
-        features, labels, cfg, bn, bm, interpret, cache, pos_topk
+        features, labels, cfg, bn, bm, interpret, cache, pos_topk,
+        matmul_precision
     )
     return out
 
 
 def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret, cache,
-                        pos_topk=0):
+                        pos_topk=0, matmul_precision=None):
+    with _precision_ctx(matmul_precision):
+        return _blockwise_fwd_traced(
+            features, labels, cfg, bn, bm, interpret, cache, pos_topk)
+
+
+def _blockwise_fwd_traced(features, labels, cfg, bn, bm, interpret, cache,
+                          pos_topk=0):
     features = features.astype(jnp.float32)
     labels_i = _canon_labels(labels)
     n = features.shape[0]
@@ -924,14 +942,22 @@ def _blockwise_fwd_impl(features, labels, cfg, bn, bm, interpret, cache,
 
 
 def _blockwise_fwd(features, labels, cfg, bn, bm, interpret, cache,
-                   pos_topk):
+                   pos_topk, matmul_precision):
     return _blockwise_fwd_impl(
-        features, labels, cfg, bn, bm, interpret, cache, pos_topk
+        features, labels, cfg, bn, bm, interpret, cache, pos_topk,
+        matmul_precision
     )
 
 
-def _blockwise_bwd(cfg, bn, bm, interpret, cache, pos_topk, res,
-                   cotangents):
+def _blockwise_bwd(cfg, bn, bm, interpret, cache, pos_topk,
+                   matmul_precision, res, cotangents):
+    with _precision_ctx(matmul_precision):
+        return _blockwise_bwd_traced(
+            cfg, bn, bm, interpret, cache, pos_topk, res, cotangents)
+
+
+def _blockwise_bwd_traced(cfg, bn, bm, interpret, cache, pos_topk, res,
+                          cotangents):
     g, _ = cotangents  # aux outputs are monitors
     features = res["features"]
     labels = res["labels"]
@@ -978,6 +1004,7 @@ def blockwise_npair_loss_with_aux(
     interpret: Optional[bool] = None,
     sim_cache: Optional[bool] = None,
     pos_topk: Optional[int] = None,
+    matmul_precision: Optional[str] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """N-pair loss over a self-pool too large for the dense N x N matrix.
 
@@ -1009,6 +1036,11 @@ def blockwise_npair_loss_with_aux(
     ``None`` = auto (8 slots — covers per-query positive counts up to
     8, i.e. identity-balanced sampling with up to NINE images per
     identity in the pool); 0 disables the buffer entirely.
+
+    ``matmul_precision``: ``None``/``"highest"`` for oracle bit-parity;
+    ``"default"`` opts every kernel gemm into the ~6x single-pass bf16
+    MXU mode (see ``ops.npair_loss.resolve_matmul_precision`` — a
+    throughput mode, not a parity mode).
     """
     if interpret is None:
         interpret = _default_interpret()
@@ -1033,7 +1065,7 @@ def blockwise_npair_loss_with_aux(
     pos_topk = _round_up(int(pos_topk), 8) if pos_topk else 0
     return _blockwise_core(
         features, labels, cfg, bn, bm, interpret, bool(sim_cache),
-        pos_topk
+        pos_topk, matmul_precision
     )
 
 
@@ -1042,11 +1074,12 @@ def blockwise_npair_loss(features, labels, cfg=NPairLossConfig(),
                          q_block_size: Optional[int] = None,
                          interpret: Optional[bool] = None,
                          sim_cache: Optional[bool] = None,
-                         pos_topk: Optional[int] = None) -> jax.Array:
+                         pos_topk: Optional[int] = None,
+                         matmul_precision: Optional[str] = None) -> jax.Array:
     """Scalar blockwise N-pair loss (see ``blockwise_npair_loss_with_aux``)."""
     return blockwise_npair_loss_with_aux(
         features, labels, cfg, block_size, q_block_size, interpret,
-        sim_cache, pos_topk
+        sim_cache, pos_topk, matmul_precision
     )[0]
 
 

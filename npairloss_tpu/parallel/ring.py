@@ -76,6 +76,8 @@ from npairloss_tpu.ops.npair_loss import (
     _clamp_negative,
     _relative_pos,
     absolute_thresholds,
+    active_matmul_precision,
+    matmul_precision_ctx,
     selection_mask,
     topk_relative_threshold,
 )
@@ -101,6 +103,12 @@ def _check_cfg(cfg: NPairLossConfig) -> None:
     pass  # all configs supported; kept for API stability
 
 
+# Every ring gemm (sim tiles + the two gradient-role gemms) reads the
+# trace-time precision ContextVar shared with the other engines —
+# see ops.npair_loss.matmul_precision_ctx / active_matmul_precision.
+_precision_ctx = matmul_precision_ctx
+
+
 def _tile(
     feats: jax.Array, block_f: jax.Array
 ) -> jax.Array:
@@ -109,7 +117,7 @@ def _tile(
         feats,
         block_f.T,
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=active_matmul_precision(),
     )
 
 
@@ -564,13 +572,13 @@ def _backward_pass(
         c["grad_query"] = c["grad_query"] + jnp.dot(
             w, rot["f"],
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=active_matmul_precision(),
         )
         rot = dict(rot)
         rot["grad_db"] = rot["grad_db"] + jnp.dot(
             w.T, feats,
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            precision=active_matmul_precision(),
         )
         return c, rot
 
@@ -590,17 +598,25 @@ def _backward_pass(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def _ring_core(features, labels, cfg, axis_name, top_ks, sim_cache,
-               pos_topk):
+               pos_topk, matmul_precision):
     out, _ = _ring_fwd_impl(
-        features, labels, cfg, axis_name, top_ks, sim_cache, pos_topk
+        features, labels, cfg, axis_name, top_ks, sim_cache, pos_topk,
+        matmul_precision
     )
     return out
 
 
 def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks, sim_cache,
-                   pos_topk=0):
+                   pos_topk=0, matmul_precision=None):
+    with _precision_ctx(matmul_precision):
+        return _ring_fwd_traced(
+            features, labels, cfg, axis_name, top_ks, sim_cache, pos_topk)
+
+
+def _ring_fwd_traced(features, labels, cfg, axis_name, top_ks, sim_cache,
+                     pos_topk=0):
     features = features.astype(jnp.float32)
     n_local = features.shape[0]
     my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
@@ -674,14 +690,22 @@ def _ring_fwd_impl(features, labels, cfg, axis_name, top_ks, sim_cache,
 
 
 def _ring_fwd(features, labels, cfg, axis_name, top_ks, sim_cache,
-              pos_topk):
+              pos_topk, matmul_precision):
     return _ring_fwd_impl(
-        features, labels, cfg, axis_name, top_ks, sim_cache, pos_topk
+        features, labels, cfg, axis_name, top_ks, sim_cache, pos_topk,
+        matmul_precision
     )
 
 
-def _ring_bwd(cfg, axis_name, top_ks, sim_cache, pos_topk, res,
-              cotangents):
+def _ring_bwd(cfg, axis_name, top_ks, sim_cache, pos_topk,
+              matmul_precision, res, cotangents):
+    with _precision_ctx(matmul_precision):
+        return _ring_bwd_traced(
+            cfg, axis_name, top_ks, sim_cache, pos_topk, res, cotangents)
+
+
+def _ring_bwd_traced(cfg, axis_name, top_ks, sim_cache, pos_topk, res,
+                     cotangents):
     g_loss, _ = cotangents  # metrics are monitors, non-differentiable
     my_rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
     d_features = _backward_pass(
@@ -718,6 +742,7 @@ def ring_npair_loss_and_metrics(
     top_ks: Sequence[int] = (1, 5, 10),
     sim_cache: Optional[bool] = None,
     pos_topk: Optional[int] = None,
+    matmul_precision: Optional[str] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Blockwise-ring N-pair loss + retrieval metrics for one shard.
 
@@ -746,6 +771,10 @@ def ring_npair_loss_and_metrics(
     mesh-uniform ``lax.cond`` falls back to radix selection when a
     label group overflows.  Default ``None`` = auto (8 slots); 0
     disables the buffer.
+
+    ``matmul_precision``: ``None``/``"highest"`` for oracle bit-parity;
+    ``"default"`` opts every ring gemm into the ~6x single-pass bf16
+    MXU mode (see ``ops.npair_loss.resolve_matmul_precision``).
     """
     _check_cfg(cfg)
     if sim_cache is None:
@@ -757,5 +786,5 @@ def ring_npair_loss_and_metrics(
         raise ValueError(f"pos_topk must be >= 0, got {pos_topk}")
     return _ring_core(
         features, labels, cfg, axis_name, tuple(top_ks), bool(sim_cache),
-        pos_topk
+        pos_topk, matmul_precision
     )

@@ -87,6 +87,7 @@ class Solver:
         engine: Optional[str] = None,
         sim_cache: Optional[bool] = None,
         pos_topk: Optional[int] = None,
+        matmul_precision: Optional[str] = None,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
@@ -115,6 +116,10 @@ class Solver:
         # Streaming engines' sparse-positive buffer size (None = auto 8;
         # 0 forces radix selection) — see ``pos_topk`` there.
         self.pos_topk = pos_topk
+        # Sim/backward gemm MXU precision: None/"highest" = oracle
+        # bit-parity; "default" = the ~6x single-pass bf16 throughput
+        # mode (ops.npair_loss.resolve_matmul_precision).
+        self.matmul_precision = matmul_precision
         self.use_ring = engine == "ring"
         if engine == "ring" and mesh is None:
             raise ValueError('engine="ring" requires a mesh')
@@ -198,13 +203,16 @@ class Solver:
             loss, _ = blockwise_npair_loss_with_aux(
                 emb, labels, self.loss_cfg, sim_cache=self.sim_cache,
                 pos_topk=self.pos_topk,
+                matmul_precision=self.matmul_precision,
             )
             metrics = blockwise_retrieval_metrics(
                 jax.lax.stop_gradient(emb), labels, self.top_ks
             )
             return loss, metrics
         axis = self.axis if self.mesh is not None else None
-        loss, aux = npair_loss_with_aux(emb, labels, self.loss_cfg, axis_name=axis)
+        loss, aux = npair_loss_with_aux(
+            emb, labels, self.loss_cfg, axis_name=axis,
+            matmul_precision=self.matmul_precision)
         metrics = retrieval_metrics(
             jax.lax.stop_gradient(aux), labels, jax.lax.stop_gradient(emb),
             self.top_ks,
@@ -223,6 +231,7 @@ class Solver:
                 loss, metrics = ring_npair_loss_and_metrics(
                     e, l, self.loss_cfg, self.axis, self.top_ks,
                     sim_cache=self.sim_cache, pos_topk=self.pos_topk,
+                    matmul_precision=self.matmul_precision,
                 )
                 metrics = {
                     k: v for k, v in metrics.items()
