@@ -162,22 +162,12 @@ def _fetch_floor(jax):
     memo cache — so every timing in this file (a) chains DISTINCT
     computations and (b) synchronizes by fetching a scalar to the host,
     then subtracts this floor (observed ~66 ms per round trip on the
-    axon tunnel, microseconds locally).
+    axon tunnel, microseconds locally).  One home for the discipline:
+    ``utils.profiling.dispatch_floor`` (process-wide salted probes).
     """
-    import jax.numpy as jnp
-    import numpy as np
+    from npairloss_tpu.utils.profiling import dispatch_floor
 
-    @jax.jit
-    def tiny(x):
-        return x.sum()
-
-    float(np.asarray(tiny(jnp.full((8, 8), 1.0))))
-    ts = []
-    for i in range(3):
-        t0 = time.perf_counter()
-        float(np.asarray(tiny(jnp.full((8, 8), float(i + 2)))))
-        ts.append(time.perf_counter() - t0)
-    floor = min(ts)
+    floor = dispatch_floor()
     _log(f"fetch floor: {floor * 1e3:.1f} ms")
     return floor
 

@@ -24,6 +24,17 @@ from typing import Optional
 log = logging.getLogger("npairloss_tpu.cli")
 
 
+def _identity_batch_geometry(d):
+    """(identities, images-per-identity) per batch from a MultibatchData
+    layer cfg; the flagship 60x2 geometry (def.prototxt:25-27) when the
+    layer is absent."""
+    if d is None:
+        return 60, 2
+    ids = d.identity_num_per_batch or max(2, (d.batch_size or 8) // 2)
+    imgs = d.img_num_per_identity or 2
+    return ids, imgs
+
+
 def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
                 synthetic: bool = False, native: str = "auto"):
     """Batches for a phase: the real MultibatchData pipeline from the
@@ -56,11 +67,10 @@ def _build_data(net_cfg, phase: str, input_shape, seed: int = 0,
         )
     from npairloss_tpu.data import synthetic_identity_batches
 
-    ids = d.identity_num_per_batch or max(2, (d.batch_size or 8) // 2)
-    imgs = d.img_num_per_identity or 2
+    ids, imgs = _identity_batch_geometry(d)
     return (
         synthetic_identity_batches(
-            max(ids * 4, ids), ids, imgs, input_shape, seed=seed
+            ids * 4, ids, imgs, input_shape, seed=seed
         ),
         d,
     )
@@ -90,9 +100,14 @@ def _build_solver(args):
     from npairloss_tpu.config import load_net, load_solver
     from npairloss_tpu.models import get_model
     from npairloss_tpu.parallel import data_parallel_mesh
-    from npairloss_tpu.train import Solver
+    from npairloss_tpu.train import Solver, SolverConfig
 
-    solver_cfg, net_path = load_solver(args.solver)
+    if getattr(args, "solver", None):
+        solver_cfg, net_path = load_solver(args.solver)
+    else:
+        # ``time`` needs only a net, like ``caffe time -model X``; solver
+        # hyperparameters are irrelevant to a timing run.
+        solver_cfg, net_path = SolverConfig(), None
     if args.net:
         net_path = args.net
     elif net_path and not os.path.isabs(net_path):
@@ -520,6 +535,183 @@ def cmd_parse(args) -> int:
     return 0
 
 
+def cmd_time(args) -> int:
+    """The ``caffe time`` counterpart (the reference's implied Caffe fork
+    is driven by the stock Caffe CLI, whose ``time`` action benchmarks a
+    net's forward/backward from ``-model`` + ``-iterations`` alone —
+    SURVEY.md §1 L1).  Caffe reports per-layer wall-clock; under jit the
+    step is ONE fused XLA program, so the honest analog is per-STAGE
+    attribution by differential timing: trunk forward, full forward
+    (trunk + loss + metrics), and forward+backward, each measured with
+    the fetch-synced scan discipline (docs/DESIGN.md §6) and differenced
+    for the loss/backward shares."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from npairloss_tpu.data import synthetic_identity_batches
+    from npairloss_tpu.utils.profiling import dispatch_floor, time_scan
+
+    built = _build_solver(args)
+    if isinstance(built, int):
+        return built
+    solver, net_cfg, input_shape = built
+
+    # Batch geometry from the net's data layer (either phase), exactly
+    # what `caffe time` would allocate; --batch/--ids override.
+    for flag in ("ids", "batch"):
+        v = getattr(args, flag, None)
+        if v is not None and v < 1:
+            log.error("--%s must be >= 1, got %d", flag, v)
+            return 2
+    d = net_cfg.data.get("TRAIN") or net_cfg.data.get("TEST")
+    ids, imgs = _identity_batch_geometry(d)
+    if args.ids:
+        ids = args.ids
+    elif args.batch:
+        ids = max(args.batch // imgs, 1)
+        if ids * imgs != args.batch:
+            log.warning(
+                "--batch %d is not a multiple of %d images/identity; "
+                "timing batch %d", args.batch, imgs, ids * imgs,
+            )
+    images, labels = next(
+        synthetic_identity_batches(ids * 4, ids, imgs, input_shape, seed=0)
+    )
+    images = jnp.asarray(images)
+    labels = jnp.asarray(labels)
+    batch = int(images.shape[0])
+
+    if solver.state is None:
+        solver.init(np.asarray(images[:2]))
+    state = solver.state
+    params, bstats = state["params"], state["batch_stats"]
+    steps = int(args.iterations)
+    if steps < 1:
+        log.error("--iterations must be >= 1, got %d", steps)
+        return 2
+    floor = dispatch_floor()
+    dev = jax.devices()[0]
+    log.info("timing on %s (%s), batch %d, %d iterations",
+             dev.platform, dev.device_kind, batch, steps)
+
+    # All three stages run the TRAIN-mode graph through the Solver's own
+    # apply_model/compute_loss plumbing (mutable batch stats threaded
+    # through the scan carry), so the differenced loss/backward shares
+    # compare like with like and the benchmarked graph IS the trained
+    # graph.  Two timing-integrity rules shape the bodies:
+    #   * every stage output is anchored by a WHOLE-tensor reduction
+    #     (sum of emb / sum over ALL grad leaves) — anchoring a single
+    #     element would let XLA dead-code-eliminate most of the work it
+    #     claims to time (slice-through-dot narrows the final matmul;
+    #     unconsumed grad leaves drop their weight-grad gemms);
+    #   * params/images/labels ride the scan carry, not the closure —
+    #     jit bakes captured arrays into each program as constants
+    #     (three private copies of a ~72 MB flagship batch otherwise).
+    def _f32sum(x):
+        return jnp.sum(x.astype(jnp.float32))
+
+    def trunk_body(carry, s):
+        acc, pp, bs, im, lb = carry
+        emb, bs = solver.apply_model(
+            pp, bs, im * (1.0 + s * 1e-6), train=True
+        )
+        return (acc + _f32sum(emb), pp, bs, im, lb)
+
+    def _anchor_all(loss, metrics):
+        # The trained step consumes loss AND metrics; anchor both so the
+        # retrieval-metrics subgraph isn't DCE'd out of the timing.
+        return jax.tree_util.tree_reduce(
+            lambda a, v: a + _f32sum(v), metrics, loss.astype(jnp.float32)
+        )
+
+    def forward_body(carry, s):
+        acc, pp, bs, im, lb = carry
+        emb, bs = solver.apply_model(
+            pp, bs, im * (1.0 + s * 1e-6), train=True
+        )
+        loss, metrics = solver.compute_loss(emb, lb)
+        return (acc + _anchor_all(loss, metrics) + _f32sum(emb),
+                pp, bs, im, lb)
+
+    def fb_body(carry, s):
+        acc, pp, bs, im, lb = carry
+
+        def loss_fn(p):
+            emb, new_bs = solver.apply_model(
+                p, bs, im * (1.0 + s * 1e-6), train=True
+            )
+            loss, metrics = solver.compute_loss(emb, lb)
+            return loss, (metrics, new_bs)
+
+        (loss, (metrics, new_bs)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(pp)
+        gsum = jax.tree_util.tree_reduce(
+            lambda a, g: a + _f32sum(g), grads, jnp.float32(0.0)
+        )
+        return (acc + _anchor_all(loss, metrics) + gsum, pp, new_bs, im, lb)
+
+    init = (jnp.float32(0.0), params, bstats, images, labels)
+    trunk_ms = time_scan(trunk_body, init, steps=steps, floor=floor)
+    forward_ms = time_scan(forward_body, init, steps=steps, floor=floor)
+    fb_ms = (None if args.forward_only else
+             time_scan(fb_body, init, steps=steps, floor=floor))
+
+    rec = {
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "batch": batch,
+        "iterations": steps,
+        "fetch_floor_ms": round(floor * 1e3, 2),
+        "trunk_forward_ms": round(trunk_ms, 3),
+        "forward_ms": round(forward_ms, 3),
+        "loss_forward_ms": round(max(forward_ms - trunk_ms, 0.0), 3),
+    }
+    if fb_ms is not None:
+        rec["forward_backward_ms"] = round(fb_ms, 3)
+        rec["backward_ms"] = round(max(fb_ms - forward_ms, 0.0), 3)
+        rec["emb_per_sec"] = round(batch / fb_ms * 1e3, 1)
+    print(json.dumps(rec))
+    return 0
+
+
+def cmd_device_query(args) -> int:
+    """The ``caffe device_query`` counterpart: enumerate the
+    accelerator(s) the way ``caffe device_query -gpu N`` prints CUDA
+    device properties (stock-Caffe CLI surface of the implied fork,
+    SURVEY.md §1 L1) — platform, device kind, per-device memory
+    stats, and the process/mesh topology that replaces
+    ``Caffe::NUM_GPU``/``RANK`` (reference:
+    npair_multi_class_loss.cpp:44)."""
+    import jax
+
+    devices = []
+    for dv in jax.devices():
+        mem = {}
+        try:
+            mem = dv.memory_stats() or {}
+        except Exception:  # backends without memory introspection
+            mem = {}
+        devices.append({
+            "id": dv.id,
+            "platform": dv.platform,
+            "device_kind": dv.device_kind,
+            "process_index": dv.process_index,
+            "bytes_in_use": mem.get("bytes_in_use"),
+            "bytes_limit": mem.get("bytes_limit"),
+        })
+    print(json.dumps({
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "default_backend": jax.default_backend(),
+        "devices": devices,
+    }, indent=2))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import importlib.util
 
@@ -722,6 +914,71 @@ def main(argv: Optional[list] = None) -> int:
     )
     exp.add_argument("--out", default="./model.caffemodel")
     exp.set_defaults(fn=cmd_export_caffemodel)
+
+    tm = sub.add_parser(
+        "time",
+        help="benchmark a net's forward/backward (the caffe time action)",
+    )
+    tm.add_argument(
+        "--net", help="net prototxt to time (like caffe time -model)"
+    )
+    tm.add_argument(
+        "--solver",
+        help="optional solver prototxt (only its net path is used)",
+    )
+    tm.add_argument("--model", help="model registry name (default: from net)")
+    tm.add_argument(
+        "--iterations", type=int, default=10,
+        help="scan length per timed stage (caffe time -iterations)",
+    )
+    tm_geom = tm.add_mutually_exclusive_group()
+    tm_geom.add_argument(
+        "--batch", type=int,
+        help="override total batch size (rounded down to a multiple of "
+        "the net's images/identity)",
+    )
+    tm_geom.add_argument(
+        "--ids", type=int, help="override identities per batch",
+    )
+    tm.add_argument(
+        "--forward-only", dest="forward_only", action="store_true",
+        help="skip the forward+backward stage",
+    )
+    tm.add_argument("--mesh", type=int, help="devices in the dp mesh")
+    tm.add_argument(
+        "--engine", choices=["dense", "ring", "blockwise"],
+        help="loss engine (see train --engine)",
+    )
+    tm.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
+    tm.add_argument(
+        "--sim-cache", dest="sim_cache", choices=["auto", "on", "off"],
+        default="auto", help="see train --sim-cache",
+    )
+    tm.add_argument(
+        "--pos-topk", dest="pos_topk", type=_pos_topk_arg, default="auto",
+        help="see train --pos-topk",
+    )
+    tm.add_argument(
+        "--matmul-precision", dest="matmul_precision",
+        choices=["highest", "default"],
+        help="see train --matmul-precision",
+    )
+    tm.add_argument(
+        "--remat", action="store_true",
+        help="block-remat GoogLeNet trunks (see train --remat)",
+    )
+    tm.add_argument(
+        "--caffe-pad", dest="caffe_pad", action="store_true",
+        help="see train --caffe-pad",
+    )
+    tm.add_argument("--resume", help="snapshot to time (restored weights)")
+    tm.set_defaults(fn=cmd_time)
+
+    dq = sub.add_parser(
+        "device-query",
+        help="enumerate accelerators (the caffe device_query action)",
+    )
+    dq.set_defaults(fn=cmd_device_query)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")
     pp.add_argument("file")

@@ -193,6 +193,31 @@ class Solver:
 
     # -- compiled step ----------------------------------------------------
 
+    def apply_model(self, params, batch_stats, inputs, train: bool):
+        """Trunk forward in the given mode; returns
+        ``(embeddings, new_batch_stats)``.  The single home for the
+        variables/mutable-collections plumbing — the jitted train/eval
+        steps AND external timers (``cli.py cmd_time``) build on this, so
+        a benchmarked graph is the trained graph."""
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            if train:
+                emb, updates = self.model.apply(
+                    variables, inputs, train=True, mutable=["batch_stats"]
+                )
+                return emb, updates["batch_stats"]
+            return self.model.apply(variables, inputs, train=False), \
+                batch_stats
+        return self.model.apply(variables, inputs, train=train), batch_stats
+
+    def compute_loss(self, emb, labels):
+        """(loss, metrics) through the configured engine — sharded over
+        the mesh when one is attached, single-device otherwise."""
+        if self.mesh is not None:
+            return self._sharded_loss(emb, labels)
+        return self._loss_and_metrics(emb, labels)
+
     def _loss_and_metrics(self, emb, labels):
         if self.engine == "blockwise":
             from npairloss_tpu.ops.pallas_npair import (
@@ -255,22 +280,13 @@ class Solver:
     def _make_step(self):
         def train_step(state, inputs, labels):
             def loss_fn(params):
-                variables = {"params": params}
-                if state["batch_stats"]:
-                    variables["batch_stats"] = state["batch_stats"]
-                    emb, updates = self.model.apply(
-                        variables, inputs, train=True, mutable=["batch_stats"]
-                    )
-                else:
-                    emb = self.model.apply(variables, inputs, train=True)
-                    updates = {}
-                if self.mesh is not None:
-                    loss, metrics = self._sharded_loss(labels=labels, emb=emb)
-                else:
-                    loss, metrics = self._loss_and_metrics(emb, labels)
-                return loss, (metrics, updates)
+                emb, new_bs = self.apply_model(
+                    params, state["batch_stats"], inputs, train=True
+                )
+                loss, metrics = self.compute_loss(emb, labels)
+                return loss, (metrics, new_bs)
 
-            (loss, (metrics, updates)), grads = jax.value_and_grad(
+            (loss, (metrics, new_bs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state["params"])
             # The lr reported and the lr applied both read the optimizer's
@@ -284,21 +300,17 @@ class Solver:
             )
             new_state = {
                 "params": params,
-                "batch_stats": updates.get("batch_stats", state["batch_stats"]),
+                "batch_stats": new_bs,
                 "opt": opt,
             }
             metrics["loss"] = loss
             return new_state, metrics
 
         def eval_step(state, inputs, labels):
-            variables = {"params": state["params"]}
-            if state["batch_stats"]:
-                variables["batch_stats"] = state["batch_stats"]
-            emb = self.model.apply(variables, inputs, train=False)
-            if self.mesh is not None:
-                loss, metrics = self._sharded_loss(emb, labels)
-            else:
-                loss, metrics = self._loss_and_metrics(emb, labels)
+            emb, _ = self.apply_model(
+                state["params"], state["batch_stats"], inputs, train=False
+            )
+            loss, metrics = self.compute_loss(emb, labels)
             metrics["loss"] = loss
             return metrics
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import time
 from typing import Dict, Optional
 
@@ -41,6 +42,102 @@ def trace(logdir: str, create_perfetto_trace: bool = False):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# Distinct-dispatch salting for dispatch_floor: repeated floor probes in
+# one process must not reuse input values, or a memoizing tunnel backend
+# serves them from cache and the floor collapses toward zero.
+_floor_calls = itertools.count()
+
+
+def dispatch_floor(trials: int = 3) -> float:
+    """Measured dispatch+fetch latency floor of the current backend, in
+    seconds.
+
+    On tunneled backends ``block_until_ready`` can return before device
+    compute finishes and identical dispatches may be served from a memo
+    cache (docs/DESIGN.md §6), so honest timing must (a) chain DISTINCT
+    computations, (b) synchronize by fetching a scalar to the host, and
+    (c) subtract this measured round-trip floor.  ~66 ms on the axon
+    tunnel, microseconds on a local backend.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    @jax.jit
+    def tiny(x):
+        return x.sum()
+
+    # Constant stride so uniqueness holds across calls with DIFFERENT
+    # trial counts (a trials-dependent stride would let ranges overlap).
+    base = float(next(_floor_calls)) * 1e6
+    if trials >= 1e6:
+        raise ValueError(f"trials must be < 1e6, got {trials}")
+    float(np.asarray(tiny(jnp.full((8, 8), base + 1.0))))  # compile
+    ts = []
+    for i in range(max(trials, 1)):
+        t0 = time.perf_counter()
+        float(np.asarray(tiny(jnp.full((8, 8), base + float(i + 2)))))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+# Every time_scan dispatch (warm or timed, across ALL calls in the
+# process) must be a distinct computation, or a memoizing tunnel backend
+# serves repeats from cache and reports ~the floor.  A process-wide call
+# counter keeps the salts globally unique.
+_time_scan_calls = itertools.count()
+
+
+def time_scan(body, init_carry, *, steps: int = 10, floor: float = 0.0,
+              warm: int = 2) -> float:
+    """Wall-clock one computation with the fetch-synced scan discipline;
+    returns milliseconds per iteration.
+
+    ``body(carry, s) -> carry`` is a ``lax.scan`` body over ``steps``
+    iterations; ``s`` is a float32 that differs every iteration AND every
+    dispatch — fold it into the computation (e.g. perturb an input by
+    ``s * 1e-6``) so no two dispatches are identical, and accumulate
+    something data-dependent into the carry so no iteration can be
+    elided.  The scan is jitted once, run ``warm`` times (compile +
+    one-time backend setup), then timed on a further distinct dispatch,
+    synchronized by fetching one scalar, with ``floor``
+    (see :func:`dispatch_floor`) subtracted.
+    """
+    if steps < 1:
+        raise ValueError(f"time_scan needs steps >= 1, got {steps}")
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    @jax.jit
+    def many(c0, salt):
+        def step(c, s):
+            return body(c, s + salt), ()
+
+        c, _ = jax.lax.scan(
+            step, c0, jnp.arange(steps, dtype=jnp.float32)
+        )
+        return c
+
+    def sync(c) -> float:
+        leaf = jax.tree_util.tree_leaves(c)[0]
+        return float(np.asarray(jnp.ravel(leaf)[0]))
+
+    # Constant per-call stride (not a warm/steps-dependent one, which
+    # could collide across calls with different parameters).
+    if (warm + 1) * steps >= 1e6:
+        raise ValueError(
+            f"(warm + 1) * steps must be < 1e6, got {(warm + 1) * steps}")
+    base = float(next(_time_scan_calls)) * 1e6
+    salts = [base + float(i * steps) for i in range(warm + 1)]
+    for s in salts[:warm]:
+        sync(many(init_carry, jnp.float32(s)))
+    t0 = time.perf_counter()
+    sync(many(init_carry, jnp.float32(salts[warm])))
+    dt = max(time.perf_counter() - t0 - floor, 1e-9)
+    return dt * 1e3 / steps
 
 
 class StepTimer:

@@ -190,3 +190,54 @@ def test_bench_subcommand_forwards_args(monkeypatch):
     rc = cli.main(["bench", "--smoke", "--steps", "3"])
     assert rc == 0
     assert seen["bench_args"] == ["--smoke", "--steps", "3"]
+
+
+def test_cli_time_command(capsys):
+    """`npairloss_tpu time --net X` — the `caffe time -model X` surface:
+    no solver prototxt required, stage timings + derived deltas emitted
+    as one JSON record."""
+    import json
+
+    rc = main([
+        "time", "--net", "examples/tiny_net.prototxt", "--model", "mlp",
+        "--iterations", "2",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    for key in ("trunk_forward_ms", "forward_ms", "loss_forward_ms",
+                "forward_backward_ms", "backward_ms", "emb_per_sec"):
+        assert key in rec, key
+        assert rec[key] >= 0
+    assert rec["batch"] == 16  # tiny_net.prototxt: 8 ids x 2 imgs
+    assert rec["iterations"] == 2
+
+
+def test_cli_time_forward_only_engines(capsys):
+    """--forward-only skips the backward stage; the streaming engines
+    must both time through the same entrypoint."""
+    import json
+
+    for engine in ("ring", "blockwise"):
+        rc = main([
+            "time", "--net", "examples/tiny_net.prototxt", "--model",
+            "mlp", "--iterations", "2", "--forward-only",
+            "--engine", engine,
+        ])
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert "forward_backward_ms" not in rec
+        assert rec["forward_ms"] >= 0
+
+
+def test_cli_device_query(capsys):
+    """`device-query` — the `caffe device_query` surface: topology plus
+    one record per device."""
+    import json
+
+    rc = main(["device-query"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["device_count"] >= 1
+    assert len(rec["devices"]) == rec["device_count"]
+    for d in rec["devices"]:
+        assert "platform" in d and "device_kind" in d
