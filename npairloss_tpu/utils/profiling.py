@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
-import itertools
+import os
 import time
 from typing import Dict, Optional
 
@@ -44,10 +44,40 @@ def trace(logdir: str, create_perfetto_trace: bool = False):
         jax.profiler.stop_trace()
 
 
-# Distinct-dispatch salting for dispatch_floor: repeated floor probes in
-# one process must not reuse input values, or a memoizing tunnel backend
-# serves them from cache and the floor collapses toward zero.
-_floor_calls = itertools.count()
+# Distinct-dispatch salting: on a memoizing tunnel backend, a dispatch
+# that is byte-identical (same executable, same argument values) to an
+# earlier one — even from ANOTHER process (the backend is server-side) —
+# may be served from cache and report ~zero time.  Every timed dispatch
+# in this module therefore draws a fresh integer salt.  Salts come from
+# windows of consecutive integers whose start is drawn from os.urandom,
+# so concurrent/successive processes (bench.py children, the profile
+# orchestrator's per-variant children, resumed runs) almost surely use
+# disjoint values — a PID-derived offset cannot promise that (PIDs
+# collide mod any table size).  All values stay below 2**24 so they are
+# exactly representable in float32 — past that, consecutive integers
+# collapse to the same float32 and the salting silently dies.
+_SALT_EXACT_LIMIT = 2 ** 24
+_SALT_WINDOW = 1024
+_salt_state = {"next": 0, "end": 0}
+
+
+def _next_salt_int() -> int:
+    st = _salt_state
+    if st["next"] >= st["end"]:
+        start = int.from_bytes(os.urandom(3), "big") % (
+            _SALT_EXACT_LIMIT - _SALT_WINDOW)
+        st["next"], st["end"] = start, start + _SALT_WINDOW
+    n = st["next"]
+    st["next"] += 1
+    return n
+
+
+def next_timing_salt() -> float:
+    """A process-unique salt for folding into a timed computation's
+    dispatch arguments: float32-exact, scaled by 2**-20 (exact power of
+    two) so a body's typical ``salt * 1e-6`` perturbation stays tiny
+    while the dispatch identity stays unique."""
+    return float(_next_salt_int()) * 2.0 ** -20
 
 
 def dispatch_floor(trials: int = 3) -> float:
@@ -69,25 +99,14 @@ def dispatch_floor(trials: int = 3) -> float:
     def tiny(x):
         return x.sum()
 
-    # Constant stride so uniqueness holds across calls with DIFFERENT
-    # trial counts (a trials-dependent stride would let ranges overlap).
-    base = float(next(_floor_calls)) * 1e6
-    if trials >= 1e6:
-        raise ValueError(f"trials must be < 1e6, got {trials}")
-    float(np.asarray(tiny(jnp.full((8, 8), base + 1.0))))  # compile
+    float(np.asarray(
+        tiny(jnp.full((8, 8), float(_next_salt_int())))))  # compile
     ts = []
-    for i in range(max(trials, 1)):
+    for _ in range(max(trials, 1)):
         t0 = time.perf_counter()
-        float(np.asarray(tiny(jnp.full((8, 8), base + float(i + 2)))))
+        float(np.asarray(tiny(jnp.full((8, 8), float(_next_salt_int())))))
         ts.append(time.perf_counter() - t0)
     return min(ts)
-
-
-# Every time_scan dispatch (warm or timed, across ALL calls in the
-# process) must be a distinct computation, or a memoizing tunnel backend
-# serves repeats from cache and reports ~the floor.  A process-wide call
-# counter keeps the salts globally unique.
-_time_scan_calls = itertools.count()
 
 
 def time_scan(body, init_carry, *, steps: int = 10, floor: float = 0.0,
@@ -96,14 +115,18 @@ def time_scan(body, init_carry, *, steps: int = 10, floor: float = 0.0,
     returns milliseconds per iteration.
 
     ``body(carry, s) -> carry`` is a ``lax.scan`` body over ``steps``
-    iterations; ``s`` is a float32 that differs every iteration AND every
-    dispatch — fold it into the computation (e.g. perturb an input by
-    ``s * 1e-6``) so no two dispatches are identical, and accumulate
-    something data-dependent into the carry so no iteration can be
-    elided.  The scan is jitted once, run ``warm`` times (compile +
-    one-time backend setup), then timed on a further distinct dispatch,
-    synchronized by fetching one scalar, with ``floor``
-    (see :func:`dispatch_floor`) subtracted.
+    iterations; ``s`` is a float32 that differs every iteration — fold
+    it into the computation (e.g. perturb an input by ``s * 1e-6``) so
+    scan iterations cannot be CSE'd, and accumulate something
+    data-dependent into the carry so no iteration can be elided.  Each
+    dispatch additionally carries a fresh salt argument (memoizing
+    backends key on argument values, so a distinct salt per CALL is what
+    defeats the cache; iteration values may overlap across calls
+    harmlessly — memoization is per-dispatch, not per-iteration).  The
+    scan is jitted once, run ``warm`` times (compile + one-time backend
+    setup), then timed on a further distinct dispatch, synchronized by
+    fetching one scalar, with ``floor`` (see :func:`dispatch_floor`)
+    subtracted.
     """
     if steps < 1:
         raise ValueError(f"time_scan needs steps >= 1, got {steps}")
@@ -125,13 +148,7 @@ def time_scan(body, init_carry, *, steps: int = 10, floor: float = 0.0,
         leaf = jax.tree_util.tree_leaves(c)[0]
         return float(np.asarray(jnp.ravel(leaf)[0]))
 
-    # Constant per-call stride (not a warm/steps-dependent one, which
-    # could collide across calls with different parameters).
-    if (warm + 1) * steps >= 1e6:
-        raise ValueError(
-            f"(warm + 1) * steps must be < 1e6, got {(warm + 1) * steps}")
-    base = float(next(_time_scan_calls)) * 1e6
-    salts = [base + float(i * steps) for i in range(warm + 1)]
+    salts = [next_timing_salt() for _ in range(warm + 1)]
     for s in salts[:warm]:
         sync(many(init_carry, jnp.float32(s)))
     t0 = time.perf_counter()

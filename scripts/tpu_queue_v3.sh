@@ -1,0 +1,75 @@
+#!/bin/bash
+# Round-4 hardware queue, third pass.
+#
+# Run 1 (tpu_queue_v2.sh) wedged mid-profile: the googlenet_bn variant's
+# dispatch hung the tunnel at 10:27 UTC and the single-process profile
+# script lost everything it had measured (salvaged by hand into
+# profile/flagship.json from the log).  Changes here:
+#   * bench.py runs FIRST — it is the round's single most valuable
+#     artifact (headline + engine/batch extras + last_good cache) and is
+#     already outage-proof;
+#   * profile_flagship.py now defaults to a per-variant orchestrator
+#     (child process per variant, hard timeout, artifact re-written after
+#     every variant, resume skips what run 1 already measured) — a wedge
+#     costs one variant, not the run;
+#   * every step is gated on a fresh tunnel probe (wait_tunnel) so a
+#     wedge in step N doesn't burn step N+1's timeout while down.
+# Run detached:  setsid nohup scripts/tpu_queue_v3.sh &
+# Log: /tmp/tpu_queue_v3.log
+cd "$(dirname "$0")/.."
+exec > /tmp/tpu_queue_v3.log 2>&1
+
+probe() {
+  timeout 100 python -c \
+    'import jax,sys; sys.exit(jax.devices()[0].platform != "tpu")' \
+    >/dev/null 2>&1
+}
+
+wait_tunnel() {
+  # Up to ~1.6h per step; the tunnel recovers on its own (observed).
+  for i in $(seq 1 30); do
+    probe && { echo "tunnel up after probe $i ($(date))"; return 0; }
+    echo "probe $i failed ($(date)); sleeping 180s"
+    sleep 180
+  done
+  echo "tunnel still down after 30 probes"
+  return 1
+}
+
+echo "=== $(date) waiting for tunnel ==="
+wait_tunnel || { echo "GAVE UP"; exit 1; }
+
+echo "=== $(date) 1/6 bench.py full ==="
+timeout 3000 python bench.py > /tmp/bench_out.json
+echo "bench rc=$?"
+tail -c 1000 /tmp/bench_out.json
+
+echo "=== $(date) 2/6 profile orchestrator (resumable, per-variant) ==="
+wait_tunnel && timeout 4200 python scripts/profile_flagship.py --steps 10
+echo "profile rc=$?"
+
+echo "=== $(date) 3/6 tpu_pallas_check (parity + stretch, cached@16k) ==="
+wait_tunnel && timeout 3300 python scripts/tpu_pallas_check.py --pool 4096 \
+  --stretch 32768 --stretch-cached 16384 > /tmp/tpu_check_out.json
+rc=$?
+echo "tpu_pallas_check rc=$rc"
+tail -c 2000 /tmp/tpu_check_out.json
+if [ "$rc" = 0 ]; then python scripts/split_pallas_check.py; fi
+
+echo "=== $(date) 4/6 TPU accuracy smoke (e2e real-JPEG on the chip) ==="
+wait_tunnel && timeout 2400 env E2E_JAX_PLATFORM=default \
+  python scripts/e2e_real_jpeg.py \
+  --steps 200 --workdir /tmp/e2e_jpeg_tpu2 \
+  --artifact accuracy/e2e_real_jpeg_tpu.json
+echo "e2e tpu rc=$?"
+
+echo "=== $(date) 5/6 diag_sim_cache 8192,16384 (safe pools) ==="
+wait_tunnel && timeout 1800 python scripts/diag_sim_cache.py \
+  --pools 8192,16384
+echo "diag safe rc=$?"
+
+echo "=== $(date) 6/6 diag_sim_cache 24576 (WEDGE-RISK, runs last) ==="
+wait_tunnel && timeout 1200 python scripts/diag_sim_cache.py --pools 24576
+echo "diag 24576 rc=$?"
+
+echo "=== $(date) QUEUE V3 DONE ==="

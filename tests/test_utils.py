@@ -120,3 +120,34 @@ def test_solver_debug_checks_flag(rng):
             solver.step(f, l)
     finally:
         enable_debug_checks(False)
+
+
+def test_time_scan_measures_and_salts_uniquely():
+    """time_scan returns a sane ms/iter and every dispatch in the process
+    draws a distinct salt (memoizing-tunnel defense; docs/DESIGN.md §6)."""
+    import jax.numpy as jnp
+
+    from npairloss_tpu.utils import profiling
+
+    def body(acc, s):
+        return acc + jnp.sin(s)
+
+    ms1 = profiling.time_scan(body, jnp.float32(0.0), steps=3)
+    ms2 = profiling.time_scan(body, jnp.float32(0.0), steps=3)
+    assert ms1 > 0 and ms2 > 0
+    with pytest.raises(ValueError):
+        profiling.time_scan(body, jnp.float32(0.0), steps=0)
+    # Distinctness of the underlying salt ints, and float32 exactness of
+    # the 2**-20 scaling for every value the counter can emit.
+    a, b = profiling._next_salt_int(), profiling._next_salt_int()
+    assert a != b
+    assert float(jnp.float32(a * 2.0 ** -20)) != float(
+        jnp.float32(b * 2.0 ** -20))
+
+
+def test_dispatch_floor_positive_and_bounded():
+    from npairloss_tpu.utils.profiling import dispatch_floor
+
+    f1 = dispatch_floor()
+    f2 = dispatch_floor()
+    assert 0 < f1 < 10.0 and 0 < f2 < 10.0  # seconds; CPU is microseconds
