@@ -32,7 +32,19 @@ def local_response_norm(
         window_strides=(1, 1, 1, 1),
         padding=((0, 0), (0, 0), (0, 0), (size // 2, size - 1 - size // 2)),
     )
-    out = xf / jnp.power(k + (alpha / size) * win, beta)
+    d = k + (alpha / size) * win
+    if beta == 0.75:
+        # The reference's beta: d^-0.75 == (sqrt(rsqrt(d)))^3, two fast
+        # VPU ops + two mults instead of the exp+log a generic pow
+        # lowers to.  LRN is ~25% of the flagship step
+        # (profile/flagship.json: full - no_lrn = 6.9 ms), so the
+        # transcendental on every activation element matters.  Differs
+        # from pow by a few float32 ulp — inside oracle tolerance
+        # (tests/test_models.py LRN parity).
+        r = jnp.sqrt(jax.lax.rsqrt(d))
+        out = xf * (r * r * r)
+    else:
+        out = xf / jnp.power(d, beta)
     return out.astype(x.dtype)
 
 

@@ -109,6 +109,18 @@ def _tpu_ready(timeout: int = 100) -> bool:
 
 
 def _write_artifacts(payload, artifact: str = ARTIFACT) -> None:
+    # Never destroy measurement history: if the file on disk carries
+    # prior_runs (dated, superseded measurement sets) and this payload
+    # does not, carry them forward — an --inline/--cpu run or a
+    # different-geometry orchestrator run must not delete evidence.
+    if "prior_runs" not in payload and os.path.exists(artifact):
+        try:
+            with open(artifact) as f:
+                prev = json.load(f)
+            if prev.get("prior_runs"):
+                payload["prior_runs"] = prev["prior_runs"]
+        except Exception:
+            pass
     os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
     tmp = artifact + ".tmp"
     with open(tmp, "w") as f:
