@@ -221,13 +221,30 @@ def cmd_train(args) -> int:
         return 2
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
-    # max_iter override was already baked into solver.cfg by
-    # _build_solver; train() falls back to it — one source of truth.
-    final = solver.train(
-        train_iter,
-        test_batches=test_iter,
-        log_fn=lambda s: print(s, flush=True),
-    )
+    record_fn, log_file = None, None
+    if getattr(args, "log_json", None):
+        import jax
+
+        # Rank-gate: in a multi-process run, N hosts appending to one
+        # shared path would duplicate every event N times.
+        if jax.process_index() == 0:
+            log_file = open(args.log_json, "a", buffering=1)
+
+            def record_fn(rec):
+                log_file.write(json.dumps(rec) + "\n")
+
+    try:
+        # max_iter override was already baked into solver.cfg by
+        # _build_solver; train() falls back to it — one source of truth.
+        final = solver.train(
+            train_iter,
+            test_batches=test_iter,
+            log_fn=lambda s: print(s, flush=True),
+            record_fn=record_fn,
+        )
+    finally:
+        if log_file is not None:
+            log_file.close()
     print(json.dumps({k: float(v) for k, v in final.items()}))
     return 0
 
@@ -805,6 +822,11 @@ def main(argv: Optional[list] = None) -> int:
         "--coordinator",
         help="multi-process coordinator HOST:PORT (the mpirun counterpart); "
         "omit on TPU pods for autodetect",
+    )
+    t.add_argument(
+        "--log-json", dest="log_json", metavar="PATH",
+        help="append one JSON record per display/test/snapshot event "
+        "(machine-readable counterpart of the Caffe-style text log)",
     )
     t.add_argument("--num-processes", type=int, help="total host processes")
     t.add_argument("--process-id", type=int, help="this process's rank")

@@ -400,6 +400,7 @@ class Solver:
         num_iters: Optional[int] = None,
         test_batches: Optional[Iterator[Tuple[np.ndarray, np.ndarray]]] = None,
         log_fn: Callable[[str], None] = log.info,
+        record_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
     ) -> Dict[str, float]:
         """The Caffe Solver::Solve loop: train/display/test/snapshot cadence.
 
@@ -407,6 +408,11 @@ class Solver:
         a solver restored from the iteration-k snapshot continues at k+1
         and runs ``num_iters - k`` more steps, keeping every cadence
         aligned (next snapshot lands at k + ``snapshot``).
+
+        ``record_fn`` receives one structured dict per display/test/
+        snapshot event (``{"event": ..., "iteration": ..., metrics...}``)
+        — the machine-readable counterpart of ``log_fn``'s Caffe-style
+        text lines (CLI ``--log-json`` writes them as JSONL).
         """
         cfg = self.cfg
         num_iters = num_iters if num_iters is not None else cfg.max_iter
@@ -427,6 +433,9 @@ class Solver:
         ):
             m = self.evaluate(test_batches, cfg.test_iter)
             log_fn(f"iter 0 TEST {_fmt(m)}")
+            if record_fn is not None:
+                record_fn({"event": "test", "iteration": 0,
+                           **{k: float(v) for k, v in m.items()}})
         last = {}
         for it in range(start, num_iters):
             inputs, labels = next(train_batches)
@@ -445,6 +454,9 @@ class Solver:
                     f"loss={avg:.6g} (avg over {len(self._loss_window)}) "
                     + _fmt({k: v for k, v in host.items() if k not in ('loss', 'lr')})
                 )
+                if record_fn is not None:
+                    record_fn({"event": "display", "iteration": step_num,
+                               "loss_avg": avg, **host})
             if (
                 test_batches is not None
                 and cfg.test_interval
@@ -452,8 +464,13 @@ class Solver:
             ):
                 m = self.evaluate(test_batches, cfg.test_iter)
                 log_fn(f"iter {step_num} TEST {_fmt(m)}")
+                if record_fn is not None:
+                    record_fn({"event": "test", "iteration": step_num,
+                               **{k: float(v) for k, v in m.items()}})
             if cfg.snapshot and step_num % cfg.snapshot == 0:
                 self.save_snapshot(step_num)
+                if record_fn is not None:
+                    record_fn({"event": "snapshot", "iteration": step_num})
         if self._checkpointer is not None:
             # Async Orbax saves must land before the process can exit, or the
             # final snapshot is left as an .orbax-checkpoint-tmp dir.

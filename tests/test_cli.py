@@ -241,3 +241,23 @@ def test_cli_device_query(capsys):
     assert len(rec["devices"]) == rec["device_count"]
     for d in rec["devices"]:
         assert "platform" in d and "device_kind" in d
+
+
+def test_cli_train_log_json(tmp_path, capsys):
+    """--log-json appends structured display/test events the Caffe text
+    log only renders as prose."""
+    import json
+
+    path = tmp_path / "metrics.jsonl"
+    rc = main([
+        "train", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--max_iter", "10", "--synthetic",
+        "--log-json", str(path),
+    ])
+    assert rc == 0
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    events = {r["event"] for r in recs}
+    assert "display" in events
+    displays = [r for r in recs if r["event"] == "display"]
+    assert all("loss_avg" in r and "iteration" in r for r in displays)
+    assert displays[-1]["iteration"] == 10

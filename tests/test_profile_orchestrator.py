@@ -1,0 +1,112 @@
+"""The profile orchestrator runs unattended on flaky hardware; pin its
+contract: per-variant child isolation, artifact written after EVERY
+variant, resume skips completed variants, a timeout costs one variant
+(not the run), and measurement history (prior_runs) survives rewrites.
+(Round-4 lesson: a single-process profile run wedged at variant 7 of 11
+and lost six on-chip measurements — scripts/profile_flagship.py.)"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def pf():
+    spec = importlib.util.spec_from_file_location(
+        "profile_flagship", os.path.join(REPO, "scripts",
+                                         "profile_flagship.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _args(pf, artifact, **kw):
+    return types.SimpleNamespace(
+        steps=2, batch=8, image=32, artifact=str(artifact),
+        variant_timeout=5, recover_wait=0, cpu=False, variant=None,
+        inline=False, **kw,
+    )
+
+
+def _fake_run(fail=()):
+    """subprocess.run stand-in: emits a child payload for the requested
+    variant; raises TimeoutExpired for names in ``fail``."""
+
+    def run(cmd, timeout=None, capture_output=None, text=None, **kw):
+        name = cmd[cmd.index("--variant") + 1]
+        if name in fail:
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        payload = {
+            "device": "fake", "batch": 8, "image": 32,
+            "steps_per_timing": 2, "fetch_floor_ms": 1.0,
+            "results": {name: {"ms_per_step": 1.5, "emb_per_sec": 5333.3}},
+        }
+        return types.SimpleNamespace(
+            returncode=0, stdout=json.dumps(payload) + "\n", stderr="")
+
+    return run
+
+
+def test_orchestrator_full_run(pf, tmp_path, monkeypatch):
+    monkeypatch.setattr(pf, "_tpu_ready", lambda timeout=100: True)
+    monkeypatch.setattr(subprocess, "run", _fake_run())
+    art = tmp_path / "p.json"
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 0
+    rec = json.loads(art.read_text())
+    assert set(rec["results"]) == set(pf.VARIANT_ORDER)
+    assert rec["device"] == "fake"
+
+
+def test_orchestrator_timeout_costs_one_variant(pf, tmp_path, monkeypatch):
+    monkeypatch.setattr(pf, "_tpu_ready", lambda timeout=100: True)
+    monkeypatch.setattr(subprocess, "run", _fake_run(fail={"s2d"}))
+    art = tmp_path / "p.json"
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 4  # incomplete, but not dead
+    rec = json.loads(art.read_text())
+    assert "error" in rec["results"]["s2d"]
+    done = [n for n in pf.VARIANT_ORDER if n != "s2d"]
+    assert all("ms_per_step" in rec["results"][n] for n in done)
+
+
+def test_orchestrator_resume_skips_completed(pf, tmp_path, monkeypatch):
+    monkeypatch.setattr(pf, "_tpu_ready", lambda timeout=100: True)
+    ran = []
+
+    def spy(cmd, **kw):
+        ran.append(cmd[cmd.index("--variant") + 1])
+        return _fake_run()(cmd, **kw)
+
+    art = tmp_path / "p.json"
+    art.write_text(json.dumps({
+        "device": "fake", "batch": 8, "image": 32, "steps_per_timing": 2,
+        "fetch_floor_ms": 1.0,
+        "results": {"full": {"ms_per_step": 9.9, "emb_per_sec": 808.1},
+                    "s2d": {"error": "timeout"}},
+        "prior_runs": [{"date": "earlier", "results": {}}],
+    }))
+    monkeypatch.setattr(subprocess, "run", spy)
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 0
+    rec = json.loads(art.read_text())
+    assert "full" not in ran              # completed -> skipped
+    assert "s2d" in ran                   # errored -> retried
+    assert rec["results"]["full"]["ms_per_step"] == 9.9
+    assert rec["prior_runs"][0]["date"] == "earlier"  # history preserved
+
+
+def test_orchestrator_tunnel_down_fails_structured(pf, tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setattr(pf, "_tpu_ready", lambda timeout=100: False)
+    art = tmp_path / "p.json"
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 3
+    rec = json.loads(art.read_text())
+    assert any("error" in v for v in rec["results"].values())
