@@ -60,17 +60,9 @@ LAST_GOOD_PATH = os.path.join(REPO, "bench_cache", "last_good.json")
 METRIC = "googlenet_npair_train_embeddings_per_sec_per_chip"
 UNIT = "embeddings/sec/chip"
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs);
-# used only for the MFU estimate.
-PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
+# Peak-FLOP/s table and cost analysis live in utils.profiling
+# (peak_flops / cost_flops) — one home, shared with the CLI `time`
+# subcommand.
 
 
 def _log(msg: str) -> None:
@@ -106,24 +98,19 @@ def _child_setup(platform: str):
 
 
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return None
+    from npairloss_tpu.utils.profiling import peak_flops
+
+    return peak_flops(device_kind)
 
 
 def _cost_flops(compiled):
     """XLA's analytic FLOPs for one compiled step, or None."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):  # older jax returns [dict]
-            cost = cost[0]
-        f = float(cost.get("flops", 0.0))
-        return f if f > 0 else None
-    except Exception as e:
-        _log(f"cost_analysis unavailable: {e}")
-        return None
+    from npairloss_tpu.utils.profiling import cost_flops
+
+    f = cost_flops(compiled)
+    if f is None:
+        _log("cost_analysis unavailable")
+    return f
 
 
 def child_probe(platform: str) -> int:

@@ -568,7 +568,12 @@ def cmd_time(args) -> int:
     import jax.numpy as jnp
 
     from npairloss_tpu.data import synthetic_identity_batches
-    from npairloss_tpu.utils.profiling import dispatch_floor, time_scan
+    from npairloss_tpu.utils.profiling import (
+        cost_flops,
+        dispatch_floor,
+        peak_flops,
+        time_scan,
+    )
 
     built = _build_solver(args)
     if isinstance(built, int):
@@ -689,6 +694,22 @@ def cmd_time(args) -> int:
         rec["forward_backward_ms"] = round(fb_ms, 3)
         rec["backward_ms"] = round(max(fb_ms - forward_ms, 0.0), 3)
         rec["emb_per_sec"] = round(batch / fb_ms * 1e3, 1)
+        # XLA's analytic FLOPs for one step, from the LOWERED program
+        # (client-side; never asks the backend to compile a second
+        # executable), plus MFU when the device's peak is known.
+        try:
+            lowered = jax.jit(
+                lambda c: fb_body(c, jnp.float32(0.0))
+            ).lower(init)
+            flops = cost_flops(lowered)
+        except Exception as e:
+            log.info("step_flops estimate unavailable: %s", e)
+            flops = None
+        if flops:
+            rec["step_flops"] = flops
+            peak = peak_flops(dev.device_kind)
+            if peak:
+                rec["mfu"] = round(flops / (fb_ms * 1e-3) / peak, 4)
     print(json.dumps(rec))
     return 0
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import logging
 import os
 import time
 from typing import Dict, Optional
@@ -155,6 +156,47 @@ def time_scan(body, init_carry, *, steps: int = 10, floor: float = 0.0,
     sync(many(init_carry, jnp.float32(salts[warm])))
     dt = max(time.perf_counter() - t0 - floor, 1e-9)
     return dt * 1e3 / steps
+
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public
+# specs); used only for MFU estimates.
+PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops(device_kind: str):
+    """Peak dense bf16 FLOP/s for a device kind, or None if unknown."""
+    kind = device_kind.lower()
+    for key, peak in PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def cost_flops(stage):
+    """XLA's analytic FLOPs for a lowered or compiled program, or None.
+
+    Accepts a ``jax.stages.Lowered`` (client-side, no device compile —
+    what the CLI ``time`` command uses so the tunnel isn't asked to
+    compile a second program) or a ``Compiled`` (bench.py's children).
+    """
+    try:
+        cost = stage.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception as e:
+        logging.getLogger("npairloss_tpu.profiling").debug(
+            "cost_analysis failed: %s", e)
+        return None
 
 
 class StepTimer:
