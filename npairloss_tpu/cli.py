@@ -552,6 +552,78 @@ def cmd_parse(args) -> int:
     return 0
 
 
+def _time_stage_bodies(solver, images, labels):
+    """Scan bodies for the three timed stages of ``cmd_time`` plus the
+    shared carry, built on the Solver's own apply_model/compute_loss
+    plumbing (mutable batch stats threaded through the carry), so the
+    differenced loss/backward shares compare like with like and the
+    benchmarked graph IS the trained graph.  Two timing-integrity rules
+    shape the bodies (regression-pinned by a FLOPs-ratio test):
+      * every stage output is anchored by a WHOLE-tensor reduction
+        (sum of emb / loss AND metrics / sum over ALL grad leaves) —
+        anchoring a single element would let XLA dead-code-eliminate
+        most of the work it claims to time (slice-through-dot narrows
+        the final matmul; unconsumed grad leaves drop their weight-grad
+        gemms; unconsumed metrics drop the retrieval subgraph);
+      * params/images/labels ride the scan carry, not the closure —
+        jit bakes captured arrays into each program as constants
+        (three private copies of a ~72 MB flagship batch otherwise).
+    Solver state must be initialized.  Returns
+    ``(trunk_body, forward_body, fb_body, init_carry)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    state = solver.state
+    params, bstats = state["params"], state["batch_stats"]
+
+    def _f32sum(x):
+        return jnp.sum(x.astype(jnp.float32))
+
+    def _anchor_all(loss, metrics):
+        return jax.tree_util.tree_reduce(
+            lambda a, v: a + _f32sum(v), metrics, loss.astype(jnp.float32)
+        )
+
+    def trunk_body(carry, s):
+        acc, pp, bs, im, lb = carry
+        emb, bs = solver.apply_model(
+            pp, bs, im * (1.0 + s * 1e-6), train=True
+        )
+        return (acc + _f32sum(emb), pp, bs, im, lb)
+
+    def forward_body(carry, s):
+        acc, pp, bs, im, lb = carry
+        emb, bs = solver.apply_model(
+            pp, bs, im * (1.0 + s * 1e-6), train=True
+        )
+        loss, metrics = solver.compute_loss(emb, lb)
+        return (acc + _anchor_all(loss, metrics) + _f32sum(emb),
+                pp, bs, im, lb)
+
+    def fb_body(carry, s):
+        acc, pp, bs, im, lb = carry
+
+        def loss_fn(p):
+            emb, new_bs = solver.apply_model(
+                p, bs, im * (1.0 + s * 1e-6), train=True
+            )
+            loss, metrics = solver.compute_loss(emb, lb)
+            return loss, (metrics, new_bs)
+
+        (loss, (metrics, new_bs)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(pp)
+        gsum = jax.tree_util.tree_reduce(
+            lambda a, g: a + _f32sum(g), grads, jnp.float32(0.0)
+        )
+        return (acc + _anchor_all(loss, metrics) + gsum, pp, new_bs, im, lb)
+
+    init = (jnp.float32(0.0), params, bstats,
+            jnp.asarray(images), jnp.asarray(labels))
+    return trunk_body, forward_body, fb_body, init
+
+
 def cmd_time(args) -> int:
     """The ``caffe time`` counterpart (the reference's implied Caffe fork
     is driven by the stock Caffe CLI, whose ``time`` action benchmarks a
@@ -607,8 +679,6 @@ def cmd_time(args) -> int:
 
     if solver.state is None:
         solver.init(np.asarray(images[:2]))
-    state = solver.state
-    params, bstats = state["params"], state["batch_stats"]
     steps = int(args.iterations)
     if steps < 1:
         log.error("--iterations must be >= 1, got %d", steps)
@@ -618,64 +688,9 @@ def cmd_time(args) -> int:
     log.info("timing on %s (%s), batch %d, %d iterations",
              dev.platform, dev.device_kind, batch, steps)
 
-    # All three stages run the TRAIN-mode graph through the Solver's own
-    # apply_model/compute_loss plumbing (mutable batch stats threaded
-    # through the scan carry), so the differenced loss/backward shares
-    # compare like with like and the benchmarked graph IS the trained
-    # graph.  Two timing-integrity rules shape the bodies:
-    #   * every stage output is anchored by a WHOLE-tensor reduction
-    #     (sum of emb / sum over ALL grad leaves) — anchoring a single
-    #     element would let XLA dead-code-eliminate most of the work it
-    #     claims to time (slice-through-dot narrows the final matmul;
-    #     unconsumed grad leaves drop their weight-grad gemms);
-    #   * params/images/labels ride the scan carry, not the closure —
-    #     jit bakes captured arrays into each program as constants
-    #     (three private copies of a ~72 MB flagship batch otherwise).
-    def _f32sum(x):
-        return jnp.sum(x.astype(jnp.float32))
-
-    def trunk_body(carry, s):
-        acc, pp, bs, im, lb = carry
-        emb, bs = solver.apply_model(
-            pp, bs, im * (1.0 + s * 1e-6), train=True
-        )
-        return (acc + _f32sum(emb), pp, bs, im, lb)
-
-    def _anchor_all(loss, metrics):
-        # The trained step consumes loss AND metrics; anchor both so the
-        # retrieval-metrics subgraph isn't DCE'd out of the timing.
-        return jax.tree_util.tree_reduce(
-            lambda a, v: a + _f32sum(v), metrics, loss.astype(jnp.float32)
-        )
-
-    def forward_body(carry, s):
-        acc, pp, bs, im, lb = carry
-        emb, bs = solver.apply_model(
-            pp, bs, im * (1.0 + s * 1e-6), train=True
-        )
-        loss, metrics = solver.compute_loss(emb, lb)
-        return (acc + _anchor_all(loss, metrics) + _f32sum(emb),
-                pp, bs, im, lb)
-
-    def fb_body(carry, s):
-        acc, pp, bs, im, lb = carry
-
-        def loss_fn(p):
-            emb, new_bs = solver.apply_model(
-                p, bs, im * (1.0 + s * 1e-6), train=True
-            )
-            loss, metrics = solver.compute_loss(emb, lb)
-            return loss, (metrics, new_bs)
-
-        (loss, (metrics, new_bs)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(pp)
-        gsum = jax.tree_util.tree_reduce(
-            lambda a, g: a + _f32sum(g), grads, jnp.float32(0.0)
-        )
-        return (acc + _anchor_all(loss, metrics) + gsum, pp, new_bs, im, lb)
-
-    init = (jnp.float32(0.0), params, bstats, images, labels)
+    trunk_body, forward_body, fb_body, init = _time_stage_bodies(
+        solver, images, labels
+    )
     trunk_ms = time_scan(trunk_body, init, steps=steps, floor=floor)
     forward_ms = time_scan(forward_body, init, steps=steps, floor=floor)
     fb_ms = (None if args.forward_only else

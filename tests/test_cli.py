@@ -261,3 +261,44 @@ def test_cli_train_log_json(tmp_path, capsys):
     displays = [r for r in recs if r["event"] == "display"]
     assert all("loss_avg" in r and "iteration" in r for r in displays)
     assert displays[-1]["iteration"] == 10
+
+
+def test_time_stage_bodies_resist_dce():
+    """The timed stage programs must contain the work they claim to time:
+    forward+backward FLOPs well above forward FLOPs (grad leaves all
+    consumed), forward above trunk (loss+metrics consumed).  If an
+    anchor regresses, XLA dead-code-eliminates the missing subgraph and
+    these ratios collapse toward 1."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from npairloss_tpu.cli import _time_stage_bodies
+    from npairloss_tpu.data import synthetic_identity_batches
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.ops.npair_loss import NPairLossConfig
+    from npairloss_tpu.train import Solver, SolverConfig
+    from npairloss_tpu.utils.profiling import cost_flops
+
+    # Tiny trunk + larger batch/embedding so the loss+metrics subgraph
+    # (O(N^2 D)) is a visible share of forward FLOPs.
+    solver = Solver(
+        get_model("mlp", hidden=(8,), embedding_dim=64),
+        NPairLossConfig(),
+        SolverConfig(display=0, snapshot=0),
+        input_shape=(16,),
+    )
+    images, labels = next(synthetic_identity_batches(32, 16, 2, (16,)))
+    solver.init(np.asarray(images[:2]))
+    trunk, fwd, fb, init = _time_stage_bodies(solver, images, labels)
+
+    def flops(body):
+        lowered = jax.jit(
+            lambda c: body(c, jnp.float32(0.0))
+        ).lower(init)
+        return cost_flops(lowered)
+
+    f_trunk, f_fwd, f_fb = flops(trunk), flops(fwd), flops(fb)
+    assert f_trunk and f_fwd and f_fb
+    assert f_fwd > f_trunk * 1.2, (f_trunk, f_fwd)  # loss+metrics present
+    assert f_fb > f_fwd * 1.7, (f_fwd, f_fb)        # full backward present
