@@ -48,7 +48,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    from npairloss_tpu.models import get_model
+    from npairloss_tpu.models import get_model, jit_init
     from npairloss_tpu.ops.npair_loss import (
         REFERENCE_CONFIG,
         MiningMethod,
@@ -69,10 +69,9 @@ def main():
     print(f"devices={len(devices)} ({devices[0].platform}), mode={mode}")
 
     model = get_model("vit_b16", dtype=jnp.bfloat16)
-    variables = model.init(
-        jax.random.PRNGKey(0),
+    variables = jit_init(
+        model, jax.random.PRNGKey(0),
         jnp.zeros((2, args.image, args.image, 3), jnp.float32),
-        train=False,
     )
 
     batches = synthetic_identity_batches(

@@ -57,5 +57,20 @@ def get_model(name: str, **kwargs):
     return _REGISTRY[key](**kwargs)
 
 
+def jit_init(model, key, example_input, train: bool = False, **kwargs):
+    """flax ``model.init`` as ONE compiled program.
+
+    Eager init issues hundreds of small per-op dispatches; on a tunneled
+    backend each costs ~a full round-trip, and a burst of them has
+    wedged the tunnel outright (docs/DESIGN.md §6).  Every init that can
+    run against real hardware should go through here.
+    """
+    import jax
+
+    return jax.jit(
+        lambda k, x: model.init(k, x, train=train, **kwargs)
+    )(key, example_input)
+
+
 def available_models():
     return sorted(_REGISTRY)

@@ -163,16 +163,23 @@ class Solver:
     def init(self, example_input: Optional[np.ndarray] = None):
         if example_input is None:
             example_input = np.zeros((2, *self.input_shape), np.float32)
-        variables = self.model.init(
+        # One jitted program builds the WHOLE training state — flax init
+        # plus the optimizer's zeros-like momentum tree.  Eagerly these
+        # are hundreds of small dispatches, which through a tunneled
+        # backend cost ~a round-trip each and have wedged the tunnel
+        # (docs/DESIGN.md §6).
+        def build_state(key, x):
+            variables = self.model.init(key, x, train=False)
+            return variables, self.tx.init(variables["params"])
+
+        variables, opt = jax.jit(build_state)(
             jax.random.PRNGKey(self.cfg.random_seed),
             jnp.asarray(example_input),
-            train=False,
         )
-        params = variables["params"]
         self.state = {
-            "params": params,
+            "params": variables["params"],
             "batch_stats": variables.get("batch_stats", {}),
-            "opt": self.tx.init(params),
+            "opt": opt,
         }
         if self.mesh is not None:
             replicated = NamedSharding(self.mesh, P())

@@ -303,12 +303,18 @@ def run_inline(args):
         print(f"[profile] {name}: {dt * 1e3:.2f} ms/step",
               file=sys.stderr, flush=True)
 
+    from npairloss_tpu.models import jit_init as _jit_init
+
+    def jit_init(model):
+        # ONE compiled program for init (shared helper; the round-4
+        # googlenet_bn wedge started in an init-adjacent dispatch).
+        return _jit_init(model, jax.random.PRNGKey(0),
+                         np.zeros((2, image, image, 3), np.float32))
+
     def model_step(model_name, with_loss=True, **model_kw):
         def make():
             model = get_model(model_name, **model_kw)
-            variables = model.init(
-                jax.random.PRNGKey(0), np.zeros((2, image, image, 3),
-                                                np.float32), train=False)
+            variables = jit_init(model)
             params = variables["params"]
             bstats = variables.get("batch_stats", {})
 
@@ -341,9 +347,7 @@ def run_inline(args):
     # -- variants ---------------------------------------------------------
     def fwd_only():
         model = get_model("googlenet", dtype=jnp.bfloat16)
-        variables = model.init(
-            jax.random.PRNGKey(0),
-            np.zeros((2, image, image, 3), np.float32), train=False)
+        variables = jit_init(model)
 
         def step(p, x, s):
             emb = model.apply({"params": p}, x * (1.0 + s * 1e-6),
