@@ -698,6 +698,8 @@ def cmd_time(args) -> int:
 
     rec = {
         "device": f"{dev.platform}:{dev.device_kind}",
+        "engine": solver.engine or "dense",
+        "mesh_devices": solver.mesh.size if solver.mesh is not None else 1,
         "batch": batch,
         "iterations": steps,
         "fetch_floor_ms": round(floor * 1e3, 2),

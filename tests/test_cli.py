@@ -214,19 +214,27 @@ def test_cli_time_command(capsys):
 
 def test_cli_time_forward_only_engines(capsys):
     """--forward-only skips the backward stage; the streaming engines
-    must both time through the same entrypoint."""
+    must both time through the same entrypoint, and the emitted record
+    must prove which engine/mesh actually ran (ring on an explicit
+    2-device mesh — the multi-chip shard_map timing path; blockwise
+    single-device by contract)."""
     import json
 
-    for engine in ("ring", "blockwise"):
+    for engine, extra, mesh_devices in (
+        ("ring", ["--mesh", "2"], 2),
+        ("blockwise", [], 1),
+    ):
         rc = main([
             "time", "--net", "examples/tiny_net.prototxt", "--model",
             "mlp", "--iterations", "2", "--forward-only",
-            "--engine", engine,
+            "--engine", engine, *extra,
         ])
         assert rc == 0
         rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert "forward_backward_ms" not in rec
         assert rec["forward_ms"] >= 0
+        assert rec["engine"] == engine
+        assert rec["mesh_devices"] == mesh_devices
 
 
 def test_cli_device_query(capsys):
