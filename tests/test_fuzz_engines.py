@@ -1,0 +1,155 @@
+"""Seeded randomized cross-engine sweep.
+
+The deterministic grids (test_golden_*, test_pallas, test_ring) pin
+every (region, method) cell on identity-balanced batches; this sweep
+drives RANDOM points of the config space — margins, sn signs/fractions,
+mixed AP/AN cells — against IRREGULAR label structure (uneven group
+sizes, shuffled order) through all three engines at once:
+
+  dense    == NumPy oracle        (loss, thresholds, counts)
+  blockwise == dense              (loss + grad, non-divisor block)
+  ring(2)  == dense-gather(2)     (loss + grad on a 2-shard mesh)
+
+The quirk surface (C-truncation of relative ranks, the negative-value
+-> -FLT_MAX clamp, zero-count guards — npair_multi_class_loss.cu:
+277-337) is exactly where an untested parameter combination could break
+silently; random points + the oracle keep the engines honest between
+grid nodes.  Seeded, so failures reproduce.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from npairloss_tpu.ops.npair_loss import (
+    MiningMethod,
+    MiningRegion,
+    NPairLossConfig,
+    npair_loss_with_aux,
+)
+from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss_with_aux
+from npairloss_tpu.parallel import data_parallel_mesh
+from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
+from npairloss_tpu.testing import oracle
+
+AXIS = "dp"
+REGIONS = [MiningRegion.GLOBAL, MiningRegion.LOCAL]
+METHODS = list(MiningMethod)
+
+
+def _random_cfg(rng) -> NPairLossConfig:
+    # sn draws cover both semantics: negative fraction-of-list and
+    # positive absolute-rank-from-top (cu:285-287), plus -0.0 (the
+    # flagship's identsn — rank 0 via the sn>=0 branch... the sign of
+    # zero matters and the oracle pins which branch wins).
+    sn_pool = [-0.7, -0.45, -0.3, -0.2, -0.0, 0.0, 1.0, 2.0, 3.0]
+    return NPairLossConfig(
+        margin_ident=float(rng.uniform(-0.08, 0.08)),
+        margin_diff=float(rng.uniform(-0.08, 0.08)),
+        identsn=float(rng.choice(sn_pool)),
+        diffsn=float(rng.choice(sn_pool)),
+        ap_mining_region=REGIONS[rng.integers(2)],
+        ap_mining_method=METHODS[rng.integers(len(METHODS))],
+        an_mining_region=REGIONS[rng.integers(2)],
+        an_mining_method=METHODS[rng.integers(len(METHODS))],
+    )
+
+
+def _irregular_batch(rng, dim=12):
+    """Shuffled batch with UNEVEN identity group sizes (2..4 images) —
+    the grids only ever use uniform imgs-per-id; the mining statistics
+    see ragged per-query positive/negative list lengths here."""
+    sizes = rng.integers(2, 5, size=int(rng.integers(4, 7)))
+    ids = rng.choice(1000, size=len(sizes), replace=False)
+    lab = np.concatenate(
+        [np.full(s, i, np.int32) for s, i in zip(sizes, ids)]
+    )
+    f = rng.standard_normal((len(lab), dim)).astype(np.float32)
+    f /= np.linalg.norm(f, axis=1, keepdims=True)
+    perm = rng.permutation(len(lab))
+    return f[perm], lab[perm]
+
+
+@pytest.mark.parametrize("trial", range(8))
+def test_fuzz_dense_oracle_blockwise(trial):
+    rng = np.random.default_rng(20260731 + trial)
+    cfg = _random_cfg(rng)
+    f, l = _irregular_batch(rng)
+
+    want = oracle.forward([f], [l], cfg)[0]
+    loss_d, aux_d = jax.jit(
+        lambda ff, ll: npair_loss_with_aux(ff, ll, cfg)
+    )(jnp.asarray(f), jnp.asarray(l))
+    np.testing.assert_allclose(
+        float(loss_d), want.loss, rtol=1e-5, atol=1e-7, err_msg=str(cfg))
+    np.testing.assert_allclose(
+        aux_d["pos_threshold"], want.pos_thr, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        aux_d["neg_threshold"], want.neg_thr, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        aux_d["ident_num"], (want.same & want.select).sum(1))
+    np.testing.assert_allclose(
+        aux_d["diff_num"], (want.diff & want.select).sum(1))
+
+    # Blockwise (interpret mode): loss + grad vs dense in ONE
+    # value_and_grad compile each (interpret-mode Pallas is the slow
+    # part of this sweep).
+    (loss_b, _), gb = jax.value_and_grad(
+        lambda x: blockwise_npair_loss_with_aux(
+            x, jnp.asarray(l), cfg, block_size=5),
+        has_aux=True,
+    )(jnp.asarray(f))
+    np.testing.assert_allclose(
+        float(loss_b), float(loss_d), rtol=1e-5, atol=1e-6,
+        err_msg=str(cfg))
+    gd = jax.grad(
+        lambda x: npair_loss_with_aux(x, jnp.asarray(l), cfg)[0]
+    )(jnp.asarray(f))
+    np.testing.assert_allclose(gb, gd, rtol=1e-5, atol=1e-7,
+                               err_msg=str(cfg))
+
+
+def _sharded_value_and_grad(fn, mesh, feats, labs):
+    """One compile per engine: value_and_grad of the shard-mean loss."""
+
+    def mean_loss(ff, ll):
+        return jnp.mean(
+            jax.shard_map(
+                lambda a, b: fn(a, b)[None],
+                mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+                out_specs=P(AXIS),
+            )(ff, ll)
+        )
+
+    val, grad = jax.jit(jax.value_and_grad(mean_loss))(
+        jnp.asarray(feats), jnp.asarray(labs))
+    return np.asarray(val), np.asarray(grad)
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_fuzz_ring_vs_dense_two_shards(trial):
+    rng = np.random.default_rng(77310000 + trial)
+    cfg = _random_cfg(rng)
+    # Equal-length shards (shard_map contract); irregular groups inside.
+    shards = [_irregular_batch(rng) for _ in range(2)]
+    n = min(len(s[1]) for s in shards)
+    n -= n % 2
+    feats = np.concatenate([s[0][:n] for s in shards])
+    labs = np.concatenate([s[1][:n] for s in shards])
+
+    mesh = data_parallel_mesh(jax.devices()[:2])
+
+    def dense_loss(ff, ll):
+        return npair_loss_with_aux(ff, ll, cfg, axis_name=AXIS)[0]
+
+    def ring_loss(ff, ll):
+        return ring_npair_loss_and_metrics(ff, ll, cfg, AXIS, (1,))[0]
+
+    vd, gd = _sharded_value_and_grad(dense_loss, mesh, feats, labs)
+    vr, gr = _sharded_value_and_grad(ring_loss, mesh, feats, labs)
+    np.testing.assert_allclose(vr, vd, rtol=1e-5, atol=1e-6,
+                               err_msg=str(cfg))
+    np.testing.assert_allclose(gr, gd, rtol=1e-5, atol=1e-7,
+                               err_msg=str(cfg))
