@@ -200,10 +200,43 @@ def cmd_train(args) -> int:
         args.coordinator, args.num_processes, args.process_id
     )
 
+    if getattr(args, "caffe_solverstate", None):
+        # Checked BEFORE _build_solver, which eagerly restores --resume.
+        if getattr(args, "resume", None):
+            log.error("--caffe-solverstate conflicts with --resume "
+                      "(pick the Caffe snapshot or the Orbax one)")
+            return 2
+        if not getattr(args, "weights", None):
+            # `caffe train --snapshot` restores the paired .caffemodel
+            # automatically; here the weights arrive separately — a
+            # solverstate on top of RANDOM init would be a silently
+            # corrupt resume (50k-step momentum, fresh weights).
+            log.error(
+                "--caffe-solverstate needs --weights (the paired "
+                ".caffemodel, converted via import-caffemodel) — "
+                "resuming momentum over random-init weights would be "
+                "a corrupt trajectory")
+            return 2
+
     built = _build_solver(args)
     if isinstance(built, int):
         return built
     solver, net_cfg, input_shape = built
+
+    if getattr(args, "caffe_solverstate", None):
+        # The `caffe train --snapshot X.solverstate` semantics: resume
+        # the optimizer (momentum + iteration) from a Caffe snapshot;
+        # weights come from the paired .caffemodel via --weights.
+        try:
+            it = solver.load_caffe_solverstate(
+                args.caffe_solverstate,
+                args.model or _model_for_net(net_cfg),
+            )
+        except NotImplementedError as e:
+            log.error("%s", e)
+            return 2
+        log.info("resumed optimizer from %s at iteration %d",
+                 args.caffe_solverstate, it)
 
     train_iter, _ = _build_data(
         net_cfg, "TRAIN", input_shape, seed=0, synthetic=args.synthetic,
@@ -485,6 +518,22 @@ def cmd_export_caffemodel(args) -> int:
         batch_stats = tree.get("batch_stats") or {}
     else:
         params = tree
+    # Validate --solverstate-out preconditions BEFORE any file is
+    # written: failing halfway would leave a .caffemodel on disk next
+    # to an error exit.
+    opt = None
+    if getattr(args, "solverstate_out", None):
+        if "resnet" in args.model.lower():
+            log.error("--solverstate-out supports GoogLeNet trunks only "
+                      "(history blob order is pinned by the layer map)")
+            return 2
+        opt = tree.get("opt") if isinstance(tree, dict) else None
+        if not opt:
+            log.error("--solverstate-out needs a training snapshot "
+                      "(--snapshot) carrying optimizer state; "
+                      "--weights files hold parameters only")
+            return 2
+
     if "resnet" in args.model.lower():
         layers = caffemodel_layers_from_resnet50_params(params, batch_stats)
     else:
@@ -492,9 +541,29 @@ def cmd_export_caffemodel(args) -> int:
     blob = write_caffemodel(layers)
     with open(args.out, "wb") as f:
         f.write(blob)
-    print(json.dumps({
-        "out": args.out, "layers": len(layers), "bytes": len(blob),
-    }))
+    rec = {"out": args.out, "layers": len(layers), "bytes": len(blob)}
+    if opt is not None:
+        # Optimizer-state migration: momentum history + iteration as a
+        # .solverstate next to the .caffemodel, so a Caffe stack can
+        # `caffe train --snapshot` the run trained here.
+        from npairloss_tpu.config.caffemodel import write_solverstate
+        from npairloss_tpu.models.caffe_import import (
+            googlenet_history_from_momentum,
+        )
+
+        if isinstance(opt, dict):
+            momentum, step = opt["momentum_buf"], opt["step"]
+        else:  # NamedTuple survived serialization
+            momentum, step = opt.momentum_buf, opt.step
+        ss = write_solverstate(
+            int(step), googlenet_history_from_momentum(momentum),
+            learned_net=os.path.basename(args.out),
+        )
+        with open(args.solverstate_out, "wb") as f:
+            f.write(ss)
+        rec["solverstate_out"] = args.solverstate_out
+        rec["solverstate_iter"] = int(step)
+    print(json.dumps(rec))
     return 0
 
 
@@ -838,6 +907,12 @@ def main(argv: Optional[list] = None) -> int:
         "finetune from — fresh optimizer state, iteration 0 (use "
         "--resume for mid-training snapshots instead)",
     )
+    t.add_argument(
+        "--caffe-solverstate", dest="caffe_solverstate", metavar="PATH",
+        help="resume the optimizer (momentum + iteration) from a Caffe "
+        ".solverstate — the `caffe train --snapshot` semantics; pair "
+        "with --weights for the matching .caffemodel parameters",
+    )
     t.add_argument("--snapshot_prefix", help="override snapshot prefix")
     t.add_argument(
         "--synthetic", action="store_true",
@@ -973,6 +1048,11 @@ def main(argv: Optional[list] = None) -> int:
         help="trunk family the weights belong to (googlenet | resnet50)",
     )
     exp.add_argument("--out", default="./model.caffemodel")
+    exp.add_argument(
+        "--solverstate-out", dest="solverstate_out", metavar="PATH",
+        help="also write the optimizer state (momentum + iteration) as "
+        "a Caffe .solverstate (GoogLeNet trunks; needs --snapshot)",
+    )
     exp.set_defaults(fn=cmd_export_caffemodel)
 
     tm = sub.add_parser(

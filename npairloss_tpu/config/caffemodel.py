@@ -202,6 +202,61 @@ def parse_caffemodel(data: bytes) -> Dict[str, List[np.ndarray]]:
     return out
 
 
+def parse_solverstate(data: bytes) -> Dict[str, object]:
+    """Decode ``.solverstate`` bytes (Caffe's optimizer snapshot — the
+    file ``caffe train --snapshot`` resumes from; the reference's solver
+    writes one next to each .caffemodel, solver.prototxt:15-16).
+
+    SolverState wire layout (public Caffe proto): ``iter``=1 (varint),
+    ``learned_net``=2 (string path of the paired .caffemodel),
+    ``history``=3 (repeated BlobProto — SGD momentum, one blob per
+    learnable parameter in net order), ``current_step``=4 (varint).
+    Returns {"iter", "learned_net", "history": [np.ndarray],
+    "current_step"}.
+    """
+    buf = memoryview(data)
+    out: Dict[str, object] = {
+        "iter": 0, "learned_net": "", "history": [], "current_step": 0,
+    }
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == _WIRE_VARINT:
+            out["iter"] = int(val)
+        elif field == 2 and wire == _WIRE_LEN:
+            out["learned_net"] = bytes(val).decode("utf-8")
+        elif field == 3 and wire == _WIRE_LEN:
+            out["history"].append(_parse_blob(val))
+        elif field == 4 and wire == _WIRE_VARINT:
+            out["current_step"] = int(val)
+    return out
+
+
+def write_solverstate(
+    iteration: int,
+    history: List[np.ndarray],
+    current_step: int = 0,
+    learned_net: str = "",
+) -> bytes:
+    """Serialize optimizer state as ``.solverstate`` bytes — the inverse
+    of :func:`parse_solverstate`, so a run trained here can be resumed
+    by a Caffe stack (and for round-trip tests)."""
+    out = bytearray()
+    _write_varint(out, (1 << 3) | _WIRE_VARINT)
+    _write_varint(out, int(iteration))
+    if learned_net:
+        nm = learned_net.encode("utf-8")
+        _write_varint(out, (2 << 3) | _WIRE_LEN)
+        _write_varint(out, len(nm))
+        out += nm
+    for arr in history:
+        payload = _write_blob(np.asarray(arr))
+        _write_varint(out, (3 << 3) | _WIRE_LEN)
+        _write_varint(out, len(payload))
+        out += payload
+    _write_varint(out, (4 << 3) | _WIRE_VARINT)
+    _write_varint(out, int(current_step))
+    return bytes(out)
+
+
 def write_caffemodel(
     layers: Dict[str, List[np.ndarray]], net_name: str = "npairloss_tpu"
 ) -> bytes:

@@ -264,3 +264,93 @@ def caffemodel_layers_from_resnet50_params(params, batch_stats):
                 f"res{cb}_{branch}",
                 f"bn{cb}_{branch}", f"scale{cb}_{branch}")
     return out
+
+
+# -- SolverState history (optimizer-state migration) ------------------------
+#
+# Caffe's SGDSolver snapshots its momentum as SolverState.history: one
+# BlobProto per learnable parameter, in net parameter order (layer order
+# of the prototxt, weight then bias within a layer).  The GoogLeNet
+# trunk's learnable params are exactly the conv kernels+biases that
+# caffe_layer_map() enumerates, and our CaffeSGDState.momentum_buf tree
+# mirrors the params tree — so the weight converters apply verbatim to
+# momentum and define the canonical blob order.
+
+
+def googlenet_history_from_momentum(momentum_params) -> List[np.ndarray]:
+    """SolverState ``history`` blob list (net order, OIHW kernels) from a
+    momentum tree shaped like the GoogLeNet params tree."""
+    hist: List[np.ndarray] = []
+    for blobs in caffemodel_layers_from_googlenet_params(
+            momentum_params).values():
+        hist.extend(blobs)
+    return hist
+
+
+def googlenet_momentum_from_history(history, momentum_template,
+                                    strict: bool = False):
+    """(momentum tree, skipped blob count) from SolverState ``history``.
+
+    The reference's full training net carries aux-classifier heads
+    (loss1/*, loss2/*) whose learnable params are INTERLEAVED with the
+    trunk's in net order, so a genuine reference ``.solverstate`` has
+    more history blobs than the embedding trunk.  Default mode aligns
+    by shape-guided greedy matching: expected trunk blobs (OIHW kernel
+    then bias per conv, layer-map order) consume history in order,
+    skipping non-matching aux blobs — safe for the GoogLeNet+aux
+    topology because within a layer the bias immediately follows its
+    kernel (nothing can interpose), and across layers the skip scans
+    for a 4-D kernel shape no aux blob shares.  ``strict=True`` demands
+    an exact 1:1 sequence (round-trip tests / files this repo wrote).
+    Every expected blob must be found and shapes are validated — a
+    silent partial load would corrupt the resumed trajectory."""
+    named: Dict[str, List[np.ndarray]] = {}
+    i = 0
+    skipped = 0
+    for path, caffe_name in caffe_layer_map().items():
+        node = momentum_template
+        for p in path.split("/"):
+            node = node[p]
+        conv = node["Conv_0"]
+        h, w, cin, cout = conv["kernel"].shape
+        expect = [(cout, cin, h, w)]  # history kernels are OIHW
+        if "bias" in conv:
+            expect.append(tuple(conv["bias"].shape))
+
+        def _matches(blob, shp):
+            if len(shp) == 4:  # kernel: exact 4-D match
+                return tuple(blob.shape) == shp
+            # bias (n,): tolerate the legacy 4-D (1,1,1,n) blob storage
+            # the weight path also accepts (old-Caffe forks write it).
+            return blob.size == shp[0] and max(blob.shape) == blob.size
+
+        blobs: List[np.ndarray] = []
+        for shp in expect:
+            while i < len(history) and not _matches(history[i], shp):
+                if strict:
+                    raise ValueError(
+                        f"solverstate history blob {i} has shape "
+                        f"{tuple(history[i].shape)}; layer "
+                        f"{caffe_name!r} wanted {shp} (strict mode)"
+                    )
+                skipped += 1
+                i += 1
+            if i >= len(history):
+                raise ValueError(
+                    f"solverstate history exhausted at layer "
+                    f"{caffe_name!r} (wanted shape {shp}) — "
+                    f"{len(history)} blobs, {skipped} skipped"
+                )
+            blobs.append(np.asarray(history[i]))
+            i += 1
+        named[caffe_name] = blobs
+    trailing = len(history) - i
+    if trailing:
+        if strict:
+            raise ValueError(
+                f"solverstate history has {trailing} trailing blobs the "
+                "GoogLeNet trunk does not consume (strict mode)"
+            )
+        skipped += trailing
+    return googlenet_params_from_caffemodel(named, momentum_template), \
+        skipped

@@ -305,9 +305,11 @@ def test_cli_export_from_snapshot(tmp_path, plain_params):
     solver._ckpt().wait_until_finished()
 
     out = tmp_path / "deploy.caffemodel"
+    ss_out = tmp_path / "deploy.solverstate"
     proc = subprocess.run(
         [sys.executable, "-m", "npairloss_tpu", "--platform", "cpu",
-         "export-caffemodel", "--snapshot", snap, "--out", str(out)],
+         "export-caffemodel", "--snapshot", snap, "--out", str(out),
+         "--solverstate-out", str(ss_out)],
         capture_output=True, text=True, timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
@@ -317,6 +319,17 @@ def test_cli_export_from_snapshot(tmp_path, plain_params):
         blobs["conv1/7x7_s2"][0].transpose(2, 3, 1, 0),
         np.asarray(plain_params["conv1"]["Conv_0"]["kernel"]),
     )
+    # The paired optimizer snapshot rode along: momentum history (one
+    # blob per learnable param) + the snapshot's iteration.
+    from npairloss_tpu.config.caffemodel import parse_solverstate
+
+    st = parse_solverstate(ss_out.read_bytes())
+    # iter comes from the optimizer's own step counter (the solver's
+    # single source of truth) — 0 here, since no training step ran;
+    # save_snapshot(1) only names the file.
+    assert st["iter"] == 0
+    assert st["learned_net"] == "deploy.caffemodel"
+    assert len(st["history"]) == sum(len(b) for b in blobs.values())
 
 
 def test_caffe_pad_stem_matches_explicit_pad3_conv():
@@ -352,3 +365,178 @@ def test_caffe_pad_stem_matches_explicit_pad3_conv():
     np.testing.assert_allclose(b, want, rtol=1e-5, atol=1e-5)
     # and SAME genuinely differs (different sampling phase)
     assert not np.allclose(a, want, atol=1e-3)
+
+
+# -- SolverState (optimizer-state migration) --------------------------------
+
+
+def test_solverstate_wire_and_history_roundtrip(plain_params):
+    """momentum tree -> history blobs (net order) -> .solverstate bytes
+    -> parse -> momentum tree: exact, with iter/current_step/learned_net
+    preserved (the `caffe train --snapshot` resume surface)."""
+    from npairloss_tpu.config.caffemodel import (
+        parse_solverstate,
+        write_solverstate,
+    )
+    from npairloss_tpu.models.caffe_import import (
+        googlenet_history_from_momentum,
+        googlenet_momentum_from_history,
+    )
+
+    rng = np.random.default_rng(5)
+    momentum = jax.tree_util.tree_map(
+        lambda a: rng.standard_normal(a.shape).astype(np.float32),
+        plain_params,
+    )
+    hist = googlenet_history_from_momentum(momentum)
+    data = write_solverstate(
+        1234, hist, current_step=7, learned_net="net.caffemodel"
+    )
+    st = parse_solverstate(data)
+    assert st["iter"] == 1234
+    assert st["current_step"] == 7
+    assert st["learned_net"] == "net.caffemodel"
+    assert len(st["history"]) == len(hist)
+    back, skipped = googlenet_momentum_from_history(
+        st["history"],
+        jax.tree_util.tree_map(np.zeros_like, momentum),
+        strict=True,
+    )
+    assert skipped == 0
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, back, momentum
+    )
+
+
+def test_solverstate_history_mismatch_fails_loudly(plain_params):
+    from npairloss_tpu.models.caffe_import import (
+        googlenet_history_from_momentum,
+        googlenet_momentum_from_history,
+    )
+
+    momentum = jax.tree_util.tree_map(np.zeros_like, plain_params)
+    hist = googlenet_history_from_momentum(momentum)
+    # Truncated history: the last expected blob is missing — error in
+    # both modes.
+    with pytest.raises(ValueError, match="history"):
+        googlenet_momentum_from_history(hist[:-1], momentum)
+    with pytest.raises(ValueError, match="history"):
+        googlenet_momentum_from_history(hist[:-1], momentum, strict=True)
+    # Trailing extra blob: strict refuses; default counts it as skipped.
+    with pytest.raises(ValueError, match="history"):
+        googlenet_momentum_from_history(hist + [hist[0]], momentum,
+                                        strict=True)
+    _, skipped = googlenet_momentum_from_history(
+        hist + [hist[0]], momentum)
+    assert skipped == 1
+
+
+def test_solverstate_skips_aux_classifier_blobs(plain_params):
+    """A genuine reference .solverstate interleaves aux-classifier
+    momentum (loss1/*, loss2/* — learnable params of the FULL training
+    net) with the trunk's; the shape-guided alignment must skip them and
+    still recover the trunk momentum exactly."""
+    from npairloss_tpu.models.caffe_import import (
+        googlenet_history_from_momentum,
+        googlenet_momentum_from_history,
+    )
+
+    rng = np.random.default_rng(3)
+    momentum = jax.tree_util.tree_map(
+        lambda a: rng.standard_normal(a.shape).astype(np.float32),
+        plain_params,
+    )
+    hist = googlenet_history_from_momentum(momentum)
+    # Splice aux-head-shaped blobs mid-sequence (after an arbitrary
+    # trunk layer boundary) + a classifier pair at the end — shapes no
+    # trunk blob position expects at those points.
+    aux = [
+        np.zeros((128, 512, 1, 1), np.float32),  # loss1/conv kernel
+        np.zeros((128,), np.float32),            # loss1/conv bias
+        np.zeros((1024, 2048), np.float32),      # loss1/fc (InnerProduct)
+        np.zeros((1024,), np.float32),
+    ]
+    # Splice at a layer boundary (kernel+bias pairs -> even index) where
+    # the next expected kernel shape differs from the aux kernel's, as
+    # in the real net order (the aux heads attach between inception
+    # stages whose neighbors have different channel counts).
+    pos = next(
+        i for i in range(20, len(hist), 2)
+        if tuple(hist[i].shape) != tuple(aux[0].shape)
+    )
+    spliced = (hist[:pos] + aux + hist[pos:]
+               + [np.zeros((1000, 1024), np.float32),   # classifier
+                  np.zeros((1000,), np.float32)])
+    back, skipped = googlenet_momentum_from_history(
+        spliced, jax.tree_util.tree_map(np.zeros_like, momentum))
+    assert skipped == len(aux) + 2
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, back, momentum
+    )
+
+
+def test_solver_resumes_from_caffe_solverstate(tmp_path, plain_params):
+    """Solver.load_caffe_solverstate restores momentum + iteration —
+    display/test/snapshot cadence and the lr schedule continue from the
+    Caffe run's step."""
+    from npairloss_tpu import NPairLossConfig
+    from npairloss_tpu.config.caffemodel import write_solverstate
+    from npairloss_tpu.models.caffe_import import (
+        googlenet_history_from_momentum,
+    )
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    rng = np.random.default_rng(11)
+    momentum = jax.tree_util.tree_map(
+        lambda a: rng.standard_normal(a.shape).astype(np.float32),
+        plain_params,
+    )
+    path = tmp_path / "iter_500.solverstate"
+    path.write_bytes(write_solverstate(
+        500, googlenet_history_from_momentum(momentum)
+    ))
+
+    solver = Solver(
+        get_model("googlenet", dtype=jnp.float32),
+        NPairLossConfig(),
+        SolverConfig(base_lr=0.001, lr_policy="fixed", display=0,
+                     snapshot=0),
+        input_shape=(64, 64, 3),
+    )
+    it = solver.load_caffe_solverstate(str(path))
+    assert it == 500
+    assert solver.iteration == 500
+    jax.tree_util.tree_map(
+        lambda got, want: np.testing.assert_allclose(
+            np.asarray(got), want, rtol=1e-6),
+        solver.state["opt"].momentum_buf,
+        momentum,
+    )
+    with pytest.raises(NotImplementedError, match="GoogLeNet"):
+        solver.load_caffe_solverstate(str(path), model_name="resnet50")
+
+
+def test_solverstate_accepts_legacy_4d_bias_blobs(plain_params):
+    """Old-Caffe forks store bias blobs with the legacy 4-D
+    (1,1,1,N) shape (the weight path normalizes them with reshape(-1));
+    the history alignment must accept that storage too."""
+    from npairloss_tpu.models.caffe_import import (
+        googlenet_history_from_momentum,
+        googlenet_momentum_from_history,
+    )
+
+    rng = np.random.default_rng(7)
+    momentum = jax.tree_util.tree_map(
+        lambda a: rng.standard_normal(a.shape).astype(np.float32),
+        plain_params,
+    )
+    hist = [
+        b if b.ndim == 4 else b.reshape(1, 1, 1, -1)
+        for b in googlenet_history_from_momentum(momentum)
+    ]
+    back, skipped = googlenet_momentum_from_history(
+        hist, jax.tree_util.tree_map(np.zeros_like, momentum))
+    assert skipped == 0
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, back, momentum
+    )

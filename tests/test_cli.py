@@ -310,3 +310,29 @@ def test_time_stage_bodies_resist_dce():
     assert f_trunk and f_fwd and f_fb
     assert f_fwd > f_trunk * 1.2, (f_trunk, f_fwd)  # loss+metrics present
     assert f_fb > f_fwd * 1.7, (f_fwd, f_fb)        # full backward present
+
+
+def test_train_caffe_solverstate_resume_conflict(tmp_path):
+    """--caffe-solverstate and --resume are mutually exclusive snapshot
+    sources; the conflict errors out before any restore runs."""
+    f = tmp_path / "x.solverstate"
+    f.write_bytes(b"")
+    rc = main([
+        "train", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--max_iter", "1", "--synthetic",
+        "--caffe-solverstate", str(f), "--resume", "/nonexistent",
+    ])
+    assert rc == 2
+
+
+def test_train_caffe_solverstate_requires_weights(tmp_path):
+    """A solverstate resume over random-init weights is a corrupt
+    trajectory; the CLI demands the paired .caffemodel via --weights."""
+    f = tmp_path / "x.solverstate"
+    f.write_bytes(b"")
+    rc = main([
+        "train", "--solver", "examples/tiny_solver.prototxt",
+        "--model", "mlp", "--max_iter", "1", "--synthetic",
+        "--caffe-solverstate", str(f),
+    ])
+    assert rc == 2
