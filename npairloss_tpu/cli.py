@@ -183,6 +183,7 @@ def _build_solver(args):
         sim_cache={"auto": None, "on": True, "off": False}[sim_cache or "auto"],
         pos_topk=None if pos_topk in (None, "auto") else int(pos_topk),
         matmul_precision=getattr(args, "matmul_precision", None),
+        param_mults=net_cfg.param_mults,
     )
     if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)

@@ -184,6 +184,14 @@ class NetConfig:
     transformer: Optional[TransformerConfig] = None
     loss: Optional[LossLayerConfig] = None
     l2_normalize: bool = False
+    # Per-parameter ((w_lr_mult, w_decay_mult), (b_lr_mult,
+    # b_decay_mult)) from the net's conv `param` blocks, or None when
+    # the net declares none.  The reference template trains biases at
+    # 2x lr with no decay (usage/def.prototxt:90-97); Caffe scopes this
+    # per layer, but the template (like bvlc_googlenet) uses one recipe
+    # throughout, so the first declaring layer defines it.
+    param_mults: Optional[Tuple[Tuple[float, float],
+                                Tuple[float, float]]] = None
     # All layers in file order as raw Messages, for anything not modeled.
     layers: Tuple[Message, ...] = ()
 
@@ -260,6 +268,7 @@ def net_from_message(msg: Message) -> NetConfig:
     transformer: Optional[TransformerConfig] = None
     loss: Optional[LossLayerConfig] = None
     l2_normalize = False
+    param_mults = None
     for layer in layers:
         ltype = str(layer.get("type", ""))
         if ltype == "MultibatchData":
@@ -271,14 +280,47 @@ def net_from_message(msg: Message) -> NetConfig:
             l2_normalize = True
         elif ltype == "NPairMultiClassLoss":
             loss = _loss_layer(layer)
+        lm = _layer_param_mults(layer)
+        if lm is not None:
+            if param_mults is not None and lm != param_mults:
+                # One net-wide recipe is an approximation (Caffe scopes
+                # param blocks per layer); two DIFFERENT recipes in one
+                # net (e.g. a frozen trunk + trainable head) cannot be
+                # honored — fail loudly rather than train silently
+                # wrong.
+                raise ValueError(
+                    "net declares conflicting param lr/decay multipliers"
+                    f" ({param_mults} vs {lm} at layer "
+                    f"{str(layer.get('name', '?'))!r}); per-layer "
+                    "multipliers beyond one net-wide recipe are not "
+                    "supported"
+                )
+            param_mults = lm
     return NetConfig(
         name=str(msg.get("name", "")),
         data=data,
         transformer=transformer,
         loss=loss,
         l2_normalize=l2_normalize,
+        param_mults=param_mults,
         layers=layers,
     )
+
+
+def _layer_param_mults(layer: Message):
+    """((w_lr, w_decay), (b_lr, b_decay)) from a layer's two ``param``
+    blocks (weight blob then bias blob, Caffe's positional order —
+    usage/def.prototxt:90-97), else None.  Legacy string-valued
+    ``param`` entries (blob name sharing) are ignored."""
+    blocks = [b for b in layer.getlist("param") if isinstance(b, Message)]
+    if len(blocks) != 2:
+        return None
+
+    def mults(b: Message) -> Tuple[float, float]:
+        return (float(b.get("lr_mult", 1.0)),
+                float(b.get("decay_mult", 1.0)))
+
+    return (mults(blocks[0]), mults(blocks[1]))
 
 
 def load_net(path: str) -> NetConfig:

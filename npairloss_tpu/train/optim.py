@@ -61,12 +61,46 @@ class CaffeSGDState(NamedTuple):
     step: jax.Array
 
 
+def _path_key(entry) -> str:
+    key = getattr(entry, "key", None)
+    if key is None:
+        key = getattr(entry, "name", None)
+    return str(key)
+
+
+def _leaf_is_bias(path) -> bool:
+    """True for CONV/DENSE bias leaves — Caffe's second per-layer param
+    blob, the one the reference's net template gives ``lr_mult: 2,
+    decay_mult: 0`` (usage/def.prototxt:94-97).
+
+    Scoped to Conv/Dense modules deliberately: BatchNorm/LayerNorm beta
+    is also keyed ``bias`` in flax, but Caffe's BN/Scale layers carry
+    their own param blocks (typically lr_mult 1) — the conv recipe must
+    not leak onto normalization parameters.
+    """
+    if len(path) < 2 or _path_key(path[-1]) != "bias":
+        return False
+    parent = _path_key(path[-2]).split("_")[0]
+    return parent in ("Conv", "Dense", "ConvTranspose", "ConvLocal")
+
+
 def caffe_sgd(
     rate_fn: Callable[[jax.Array], jax.Array],
     momentum: float = 0.9,
     weight_decay: float = 0.0,
+    param_mults: Optional[tuple] = None,
 ) -> optax.GradientTransformation:
-    """SGD with lr-inside-momentum semantics (see module docstring)."""
+    """SGD with lr-inside-momentum semantics (see module docstring).
+
+    ``param_mults`` = ``((w_lr_mult, w_decay_mult), (b_lr_mult,
+    b_decay_mult))`` reproduces Caffe's per-parameter ``param { lr_mult
+    decay_mult }`` blocks: each blob's local rate is ``lr * lr_mult``
+    and local decay ``weight_decay * decay_mult``, with the weight/bias
+    split by tree key (Caffe's positional blob 0/blob 1).  The
+    reference's template uses 1/1 for weights and 2/0 for biases —
+    double bias lr, no bias decay (usage/def.prototxt:90-97).  ``None``
+    (default) keeps uniform treatment.
+    """
 
     def init(params):
         return CaffeSGDState(
@@ -74,22 +108,35 @@ def caffe_sgd(
             step=jnp.zeros((), jnp.int32),
         )
 
+    if param_mults is not None:
+        (w_lr, w_dk), (b_lr, b_dk) = (
+            (float(param_mults[0][0]), float(param_mults[0][1])),
+            (float(param_mults[1][0]), float(param_mults[1][1])),
+        )
+    else:
+        (w_lr, w_dk), (b_lr, b_dk) = (1.0, 1.0), (1.0, 1.0)
+
     def update(grads, state, params=None):
         lr = rate_fn(state.step)
         mu = jnp.float32(momentum)
         wd = jnp.float32(weight_decay)
 
-        def upd(v, grad, w):
+        def upd(path, v, grad, w):
+            lmul, dmul = (b_lr, b_dk) if _leaf_is_bias(path) else (
+                w_lr, w_dk)
             grad = grad.astype(jnp.float32)
-            if params is not None and weight_decay:
-                grad = grad + wd * w.astype(jnp.float32)
-            return mu * v + lr * grad
+            if w is not None and weight_decay and dmul:
+                grad = grad + wd * jnp.float32(dmul) * w.astype(
+                    jnp.float32)
+            return mu * v + lr * jnp.float32(lmul) * grad
 
         if params is not None:
-            new_buf = jax.tree_util.tree_map(upd, state.momentum_buf, grads, params)
+            new_buf = jax.tree_util.tree_map_with_path(
+                upd, state.momentum_buf, grads, params
+            )
         else:
-            new_buf = jax.tree_util.tree_map(
-                lambda v, grad: mu * v + lr * grad.astype(jnp.float32),
+            new_buf = jax.tree_util.tree_map_with_path(
+                lambda path, v, grad: upd(path, v, grad, None),
                 state.momentum_buf,
                 grads,
             )

@@ -88,9 +88,16 @@ class Solver:
         sim_cache: Optional[bool] = None,
         pos_topk: Optional[int] = None,
         matmul_precision: Optional[str] = None,
+        param_mults: Optional[tuple] = None,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
+        # Per-parameter lr/decay multipliers ((w_lr, w_decay), (b_lr,
+        # b_decay)) — Caffe `param { lr_mult decay_mult }` semantics;
+        # the reference template trains biases at 2x lr with no decay
+        # (usage/def.prototxt:90-97).  Set BEFORE the cfg property
+        # below builds the optimizer.
+        self.param_mults = param_mults
         self.mesh = mesh
         self.axis = axis
         # Loss engine (see docs/DESIGN.md §2): "dense" materializes the
@@ -151,7 +158,13 @@ class Solver:
             cfg.lr_policy, cfg.base_lr, cfg.gamma, cfg.stepsize, cfg.power,
             cfg.max_iter, cfg.stepvalues,
         )
-        self.tx = caffe_sgd(self.rate_fn, cfg.momentum, cfg.weight_decay)
+        # Direct read: __init__ assigns param_mults before this setter
+        # runs (constructor-only — assigning solver.param_mults later
+        # does NOT rebuild the optimizer).
+        self.tx = caffe_sgd(
+            self.rate_fn, cfg.momentum, cfg.weight_decay,
+            param_mults=self.param_mults,
+        )
         self._loss_window: collections.deque = collections.deque(
             maxlen=max(cfg.average_loss, 1)
         )

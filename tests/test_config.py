@@ -273,3 +273,43 @@ def test_example_configs_parse():
         os.path.join(repo, "examples", "googlenet_cub_solver.prototxt"))
     assert solver_cfg.stepsize == 10000 and solver_cfg.gamma == 0.5
     assert net_path.endswith("googlenet_cub.prototxt")
+
+
+def test_net_param_mults_from_reference_template():
+    """The reference net trains conv biases at 2x lr with no decay
+    (param blocks, usage/def.prototxt:90-97); the schema must surface
+    that recipe so the solver reproduces the trajectory."""
+    from npairloss_tpu.config import load_net
+
+    net = load_net("/root/reference/usage/def.prototxt")
+    assert net.param_mults == ((1.0, 1.0), (2.0, 0.0))
+
+
+def test_net_param_mults_absent_without_blocks():
+    from npairloss_tpu.config import net_from_text
+
+    net = net_from_text('name: "X"\nlayer { name: "d" type: "ReLU" }\n')
+    assert net.param_mults is None
+
+
+def test_net_param_mults_conflict_is_loud():
+    """Two layers declaring DIFFERENT recipes (e.g. frozen trunk +
+    trainable head) cannot be honored net-wide — must raise, not train
+    silently wrong."""
+    from npairloss_tpu.config import net_from_text
+
+    text = '''
+name: "X"
+layer {
+  name: "frozen" type: "Convolution"
+  param { lr_mult: 0 decay_mult: 0 }
+  param { lr_mult: 0 decay_mult: 0 }
+}
+layer {
+  name: "head" type: "Convolution"
+  param { lr_mult: 1 decay_mult: 1 }
+  param { lr_mult: 2 decay_mult: 0 }
+}
+'''
+    with pytest.raises(ValueError, match="conflicting"):
+        net_from_text(text)

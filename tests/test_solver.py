@@ -222,3 +222,44 @@ def test_iteration_resume_cadence(tmp_path):
     # (it=6) applied rate(6) = base * gamma^floor(6/2), which a restore
     # that reset the step to 0 would report as base * gamma^floor(3/2).
     assert float(last["lr"]) == pytest.approx(0.5 * 0.5**3)
+
+
+def test_caffe_sgd_param_mults_bias_recipe():
+    """param_mults=((1,1),(2,0)) — the reference template's recipe —
+    must give biases 2x the learning rate and exempt them from weight
+    decay, with weights unchanged vs the uniform optimizer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from npairloss_tpu.train.optim import caffe_sgd, lr_schedule
+
+    rate = lr_schedule("fixed", 0.1)
+    # Conv-scoped: the recipe applies to Conv/Dense biases (flax key
+    # layout) but must NOT leak onto BatchNorm beta (also keyed "bias").
+    params = {"blk": {"Conv_0": {"kernel": jnp.ones((2, 2)),
+                                 "bias": jnp.ones((2,))},
+                      "BatchNorm_0": {"bias": jnp.ones((2,)),
+                                      "scale": jnp.ones((2,))}}}
+    grads = jax.tree_util.tree_map(lambda a: jnp.full_like(a, 0.5), params)
+
+    tx = caffe_sgd(rate, momentum=0.0, weight_decay=0.01,
+                   param_mults=((1.0, 1.0), (2.0, 0.0)))
+    upd, _ = tx.update(grads, tx.init(params), params)
+    # weights: -lr * (g + wd*w) = -0.1 * (0.5 + 0.01) = -0.051
+    np.testing.assert_allclose(
+        np.asarray(upd["blk"]["Conv_0"]["kernel"]), -0.051, rtol=1e-6)
+    # conv bias: -lr * 2 * g (no decay) = -0.1 * 2 * 0.5 = -0.1
+    np.testing.assert_allclose(
+        np.asarray(upd["blk"]["Conv_0"]["bias"]), -0.1, rtol=1e-6)
+    # BatchNorm beta/gamma: NOT a conv bias — weight recipe applies.
+    np.testing.assert_allclose(
+        np.asarray(upd["blk"]["BatchNorm_0"]["bias"]), -0.051, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(upd["blk"]["BatchNorm_0"]["scale"]), -0.051, rtol=1e-6)
+
+    # Uniform (param_mults=None) treats every leaf identically.
+    tx_u = caffe_sgd(rate, momentum=0.0, weight_decay=0.01)
+    upd_u, _ = tx_u.update(grads, tx_u.init(params), params)
+    np.testing.assert_allclose(
+        np.asarray(upd_u["blk"]["Conv_0"]["bias"]), -0.051, rtol=1e-6)
