@@ -61,27 +61,28 @@ class CaffeSGDState(NamedTuple):
     step: jax.Array
 
 
-def _path_key(entry) -> str:
-    key = getattr(entry, "key", None)
-    if key is None:
-        key = getattr(entry, "name", None)
-    return str(key)
-
-
-def _leaf_is_bias(path) -> bool:
-    """True for CONV/DENSE bias leaves — Caffe's second per-layer param
-    blob, the one the reference's net template gives ``lr_mult: 2,
-    decay_mult: 0`` (usage/def.prototxt:94-97).
-
-    Scoped to Conv/Dense modules deliberately: BatchNorm/LayerNorm beta
-    is also keyed ``bias`` in flax, but Caffe's BN/Scale layers carry
-    their own param blocks (typically lr_mult 1) — the conv recipe must
-    not leak onto normalization parameters.
+def _conv_bias_mask(tree):
+    """Matching-structure pytree of bools marking Caffe 'second blob'
+    biases: leaves keyed ``bias`` whose PARENT also holds a ``kernel``
+    — true for conv/dense layers under any module name, false for
+    BatchNorm/LayerNorm beta (bias + scale, no kernel), which Caffe's
+    BN/Scale layers cover with their own param blocks (typically
+    lr_mult 1) — the conv recipe must not leak onto normalization
+    parameters.  (A name-prefix check was tried first and silently
+    missed custom module names.)
     """
-    if len(path) < 2 or _path_key(path[-1]) != "bias":
-        return False
-    parent = _path_key(path[-2]).split("_")[0]
-    return parent in ("Conv", "Dense", "ConvTranspose", "ConvLocal")
+    from collections.abc import Mapping
+
+    if not isinstance(tree, Mapping):
+        return False  # bare-array "tree": nothing to classify
+    has_kernel = "kernel" in tree
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, Mapping):
+            out[k] = _conv_bias_mask(v)
+        else:
+            out[k] = bool(has_kernel and k == "bias")
+    return out
 
 
 def caffe_sgd(
@@ -120,10 +121,10 @@ def caffe_sgd(
         lr = rate_fn(state.step)
         mu = jnp.float32(momentum)
         wd = jnp.float32(weight_decay)
+        mask = _conv_bias_mask(state.momentum_buf)
 
-        def upd(path, v, grad, w):
-            lmul, dmul = (b_lr, b_dk) if _leaf_is_bias(path) else (
-                w_lr, w_dk)
+        def upd(v, grad, w, is_bias):
+            lmul, dmul = (b_lr, b_dk) if is_bias else (w_lr, w_dk)
             grad = grad.astype(jnp.float32)
             if w is not None and weight_decay and dmul:
                 grad = grad + wd * jnp.float32(dmul) * w.astype(
@@ -131,14 +132,15 @@ def caffe_sgd(
             return mu * v + lr * jnp.float32(lmul) * grad
 
         if params is not None:
-            new_buf = jax.tree_util.tree_map_with_path(
-                upd, state.momentum_buf, grads, params
+            new_buf = jax.tree_util.tree_map(
+                upd, state.momentum_buf, grads, params, mask
             )
         else:
-            new_buf = jax.tree_util.tree_map_with_path(
-                lambda path, v, grad: upd(path, v, grad, None),
+            new_buf = jax.tree_util.tree_map(
+                lambda v, grad, is_bias: upd(v, grad, None, is_bias),
                 state.momentum_buf,
                 grads,
+                mask,
             )
         updates = jax.tree_util.tree_map(lambda v: -v, new_buf)
         return updates, CaffeSGDState(new_buf, state.step + 1)

@@ -33,7 +33,8 @@ sys.path.insert(0, REPO)
 
 def run_config(name, loss_cfg, model_name, model_kw, input_shape, num_ids,
                ids_per_batch, steps, lr, use_ring=False, use_blockwise=False,
-               record_every=10, seed=0, noise=0.6):
+               record_every=10, seed=0, noise=0.6, param_mults=None,
+               weight_decay=0.0):
     import jax
     import numpy as np
 
@@ -51,12 +52,14 @@ def run_config(name, loss_cfg, model_name, model_kw, input_shape, num_ids,
         get_model(model_name, **model_kw),
         loss_cfg,
         SolverConfig(
-            base_lr=lr, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
+            base_lr=lr, lr_policy="fixed", momentum=0.9,
+            weight_decay=weight_decay,
             display=0, test_interval=0, snapshot=0, random_seed=seed,
         ),
         mesh=mesh,
         input_shape=input_shape,
         use_ring=use_ring,
+        param_mults=param_mults,
     )
     if use_blockwise:
         # Swap the dense loss for the Pallas blockwise engine inside the
@@ -147,6 +150,16 @@ def main():
         ("flagship_def_prototxt",
          lambda: run_config("flagship_def_prototxt", REFERENCE_CONFIG,
                             steps=s, **mlp)),
+        # Flagship config WITH the reference template's per-param
+        # recipe (bias lr x2, no bias decay — def.prototxt:90-97, now
+        # honored by caffe_sgd param_mults) AND the reference solver's
+        # weight_decay 2e-5 (solver.prototxt:11), so BOTH halves of the
+        # recipe (lr_mult and decay_mult) are live in this trajectory.
+        ("flagship_caffe_param_mults",
+         lambda: run_config(
+             "flagship_caffe_param_mults", REFERENCE_CONFIG, steps=s,
+             param_mults=((1.0, 1.0), (2.0, 0.0)), weight_decay=2e-5,
+             **mlp)),
         # Paper-baseline LOCAL/RAND (BASELINE.json cfg 2: CUB).
         ("local_rand_cub",
          lambda: run_config("local_rand_cub", NPairLossConfig(),

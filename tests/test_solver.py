@@ -235,12 +235,17 @@ def test_caffe_sgd_param_mults_bias_recipe():
     from npairloss_tpu.train.optim import caffe_sgd, lr_schedule
 
     rate = lr_schedule("fixed", 0.1)
-    # Conv-scoped: the recipe applies to Conv/Dense biases (flax key
-    # layout) but must NOT leak onto BatchNorm beta (also keyed "bias").
+    # Structural classification: a "bias" whose parent also holds a
+    # "kernel" is a conv/dense second blob — under ANY module name
+    # (mlp's custom "dense0" caught a name-prefix version silently
+    # no-opping) — while BatchNorm beta (bias + scale, no kernel) must
+    # NOT inherit the conv recipe.
     params = {"blk": {"Conv_0": {"kernel": jnp.ones((2, 2)),
                                  "bias": jnp.ones((2,))},
                       "BatchNorm_0": {"bias": jnp.ones((2,)),
-                                      "scale": jnp.ones((2,))}}}
+                                      "scale": jnp.ones((2,))}},
+              "dense0": {"kernel": jnp.ones((2, 2)),
+                         "bias": jnp.ones((2,))}}
     grads = jax.tree_util.tree_map(lambda a: jnp.full_like(a, 0.5), params)
 
     tx = caffe_sgd(rate, momentum=0.0, weight_decay=0.01,
@@ -252,6 +257,9 @@ def test_caffe_sgd_param_mults_bias_recipe():
     # conv bias: -lr * 2 * g (no decay) = -0.1 * 2 * 0.5 = -0.1
     np.testing.assert_allclose(
         np.asarray(upd["blk"]["Conv_0"]["bias"]), -0.1, rtol=1e-6)
+    # Custom-named dense layer: same recipe by structure, not by name.
+    np.testing.assert_allclose(
+        np.asarray(upd["dense0"]["bias"]), -0.1, rtol=1e-6)
     # BatchNorm beta/gamma: NOT a conv bias — weight recipe applies.
     np.testing.assert_allclose(
         np.asarray(upd["blk"]["BatchNorm_0"]["bias"]), -0.051, rtol=1e-6)
