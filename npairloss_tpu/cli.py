@@ -184,6 +184,9 @@ def _build_solver(args):
         pos_topk=None if pos_topk in (None, "auto") else int(pos_topk),
         matmul_precision=getattr(args, "matmul_precision", None),
         param_mults=net_cfg.param_mults,
+        loss_weight=(net_cfg.loss.loss_weights[0]
+                     if net_cfg.loss and net_cfg.loss.loss_weights
+                     else 1.0),
     )
     if getattr(args, "resume", None):
         solver.restore_snapshot(args.resume)

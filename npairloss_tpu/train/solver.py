@@ -89,9 +89,14 @@ class Solver:
         pos_topk: Optional[int] = None,
         matmul_precision: Optional[str] = None,
         param_mults: Optional[tuple] = None,
+        loss_weight: float = 1.0,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
+        # The loss top's `loss_weight` (reference: cu:435 scales the
+        # whole backward by top[0]'s weight; Caffe's objective is the
+        # weighted loss).  The shipped template uses 1.
+        self.loss_weight = float(loss_weight)
         # Per-parameter lr/decay multipliers ((w_lr, w_decay), (b_lr,
         # b_decay)) — Caffe `param { lr_mult decay_mult }` semantics;
         # the reference template trains biases at 2x lr with no decay
@@ -233,10 +238,17 @@ class Solver:
 
     def compute_loss(self, emb, labels):
         """(loss, metrics) through the configured engine — sharded over
-        the mesh when one is attached, single-device otherwise."""
+        the mesh when one is attached, single-device otherwise.  The
+        loss is the OBJECTIVE: scaled by the loss top's ``loss_weight``
+        (reference cu:435 semantics), so gradients and the displayed
+        loss both carry it."""
         if self.mesh is not None:
-            return self._sharded_loss(emb, labels)
-        return self._loss_and_metrics(emb, labels)
+            loss, metrics = self._sharded_loss(emb, labels)
+        else:
+            loss, metrics = self._loss_and_metrics(emb, labels)
+        if self.loss_weight != 1.0:
+            loss = loss * jnp.float32(self.loss_weight)
+        return loss, metrics
 
     def _loss_and_metrics(self, emb, labels):
         if self.engine == "blockwise":

@@ -271,3 +271,46 @@ def test_caffe_sgd_param_mults_bias_recipe():
     upd_u, _ = tx_u.update(grads, tx_u.init(params), params)
     np.testing.assert_allclose(
         np.asarray(upd_u["blk"]["Conv_0"]["bias"]), -0.051, rtol=1e-6)
+
+
+def test_loss_weight_scales_objective_and_gradient():
+    """The loss top's loss_weight scales the whole backward (reference
+    cu:435) and the displayed objective; weight 2 must double both vs
+    weight 1."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from npairloss_tpu import NPairLossConfig
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    from conftest import make_identity_batch
+
+    rng = np.random.default_rng(0)
+    (f,), (l,) = make_identity_batch(rng, 4, 2, 8)
+
+    def one_step(weight):
+        s = Solver(
+            get_model("mlp", hidden=(8,), embedding_dim=4),
+            NPairLossConfig(),
+            SolverConfig(base_lr=0.1, lr_policy="fixed", momentum=0.0,
+                         weight_decay=0.0, display=0, snapshot=0),
+            input_shape=(8,),
+            loss_weight=weight,
+        )
+        s.init(f[:2])
+        before = jax.tree_util.tree_map(np.asarray, s.state["params"])
+        m = s.step(f, l)
+        after = jax.tree_util.tree_map(np.asarray, s.state["params"])
+        delta = jax.tree_util.tree_map(lambda a, b: b - a, before, after)
+        return float(m["loss"]), delta
+
+    loss1, d1 = one_step(1.0)
+    loss2, d2 = one_step(2.0)
+    np.testing.assert_allclose(loss2, 2 * loss1, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(b, 2 * a, rtol=1e-4,
+                                                atol=1e-8),
+        d1, d2,
+    )
