@@ -57,11 +57,12 @@ def _random_cfg(rng) -> NPairLossConfig:
     )
 
 
-def _irregular_batch(rng, dim=12):
-    """Shuffled batch with UNEVEN identity group sizes (2..4 images) —
-    the grids only ever use uniform imgs-per-id; the mining statistics
-    see ragged per-query positive/negative list lengths here."""
-    sizes = rng.integers(2, 5, size=int(rng.integers(4, 7)))
+def _irregular_batch(rng, dim=12, max_group=4):
+    """Shuffled batch with UNEVEN identity group sizes (2..max_group
+    images) — the grids only ever use uniform imgs-per-id; the mining
+    statistics see ragged per-query positive/negative list lengths
+    here."""
+    sizes = rng.integers(2, max_group + 1, size=int(rng.integers(4, 7)))
     ids = rng.choice(1000, size=len(sizes), replace=False)
     lab = np.concatenate(
         [np.full(s, i, np.int32) for s, i in zip(sizes, ids)]
@@ -153,3 +154,36 @@ def test_fuzz_ring_vs_dense_two_shards(trial):
                                err_msg=str(cfg))
     np.testing.assert_allclose(gr, gd, rtol=1e-5, atol=1e-7,
                                err_msg=str(cfg))
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_fuzz_pos_topk_fast_path_vs_radix(trial):
+    """The sparse-positive fast path (pos_topk buffer) and forced radix
+    selection (pos_topk=0) are two different machineries for the same
+    RELATIVE AP threshold — both must equal the dense path at random
+    config points.  The fast path is only live for RELATIVE AP + a
+    NON-relative AN (its gate), so AN is pinned to an absolute method;
+    and group sizes run up to 12 against the 8-slot buffer so the
+    lax.cond overflow fallback genuinely fires in some groups."""
+    import dataclasses
+
+    rng = np.random.default_rng(55550000 + trial)
+    cfg = dataclasses.replace(
+        _random_cfg(rng),
+        ap_mining_method=[MiningMethod.RELATIVE_HARD,
+                          MiningMethod.RELATIVE_EASY][int(rng.integers(2))],
+        an_mining_method=[MiningMethod.HARD, MiningMethod.EASY,
+                          MiningMethod.RAND][int(rng.integers(3))],
+    )
+    f, l = _irregular_batch(rng, max_group=12)
+    loss_d, _ = jax.jit(
+        lambda ff, ll: npair_loss_with_aux(ff, ll, cfg)
+    )(jnp.asarray(f), jnp.asarray(l))
+    for pos_topk in (0, 8, None):
+        loss_b, _ = blockwise_npair_loss_with_aux(
+            jnp.asarray(f), jnp.asarray(l), cfg, block_size=5,
+            pos_topk=pos_topk,
+        )
+        np.testing.assert_allclose(
+            float(loss_b), float(loss_d), rtol=1e-5, atol=1e-6,
+            err_msg=f"pos_topk={pos_topk} {cfg}")
