@@ -389,13 +389,14 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
 
     mesh = data_parallel_mesh(jax.devices()[:1])
 
-    def ring_loss(cfg, sim_cache=None):
+    def ring_loss(cfg, sim_cache=None, matmul_precision=None):
         # top_ks=() keeps the comparison fair: dense/blockwise are timed
         # as loss+grad only, so the ring must not pay for streamed
         # retrieval-metric top-k maintenance the others skip.
         fn = jax.shard_map(
             lambda f_, l_: ring_npair_loss_and_metrics(
-                f_, l_, cfg, "dp", top_ks=(), sim_cache=sim_cache
+                f_, l_, cfg, "dp", top_ks=(), sim_cache=sim_cache,
+                matmul_precision=matmul_precision,
             )[0][None],
             mesh=mesh,
             in_specs=(P("dp"), P("dp")),
@@ -469,6 +470,13 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
         ring_loss(REFERENCE_CONFIG, sim_cache=False),
     )
     delta("ring_cache_nocache_delta", l_ring_rel, l_ring_rel_nc)
+    # Ring at matmul_precision="default": completes the bf16-mode
+    # coverage across all three engines (dense/blockwise rows above).
+    l_ring_rel_bf16 = bench_one(
+        "ring_flagship_bf16matmul",
+        ring_loss(REFERENCE_CONFIG, matmul_precision="default"),
+    )
+    delta("ring_bf16matmul_loss_delta", l_ring_rel, l_ring_rel_bf16)
     return extras
 
 
