@@ -1,0 +1,28 @@
+#!/bin/bash
+# Keeps tpu_queue_v3.sh alive until it completes: the queue gives up
+# after 30 failed probes (~2.3h) so one long outage doesn't leave a
+# zombie prober, and this supervisor simply starts the next attempt —
+# logs rotated per attempt.  Run detached:
+#   setsid nohup scripts/tpu_queue_supervisor.sh &
+# Supervisor log: /tmp/tpu_queue_supervisor.log
+cd "$(dirname "$0")/.."
+exec >> /tmp/tpu_queue_supervisor.log 2>&1
+
+for attempt in $(seq 1 48); do
+  # Never run two queues at once.
+  while pgrep -f "bash scripts/tpu_queue_v3.sh" > /dev/null; do
+    sleep 60
+  done
+  if grep -q "QUEUE V3 DONE" /tmp/tpu_queue_v3.log 2>/dev/null; then
+    echo "$(date) queue completed; supervisor exiting"
+    exit 0
+  fi
+  if [ -f /tmp/tpu_queue_v3.log ]; then
+    cp /tmp/tpu_queue_v3.log "/tmp/tpu_queue_v3.attempt${attempt}.log"
+  fi
+  echo "$(date) starting queue attempt ${attempt}"
+  scripts/tpu_queue_v3.sh
+  echo "$(date) queue attempt ${attempt} exited rc=$?"
+  sleep 30
+done
+echo "$(date) supervisor budget exhausted"
