@@ -435,6 +435,18 @@ def _write_profile_md(payload):
     def pct(ms):
         return f"{ms:.1f} ms ({100 * ms / full:.0f}%)" if full else f"{ms:.1f} ms"
 
+    def _table_lines(results):
+        out = ["| variant | ms/step | emb/s |", "|---|---|---|"]
+        for k, v in results.items():
+            if "ms_per_step" in v:
+                out.append(
+                    f"| {k} | {v['ms_per_step']} | {v['emb_per_sec']} |")
+            else:
+                out.append(f"| {k} | ERROR: {v.get('error', '?')} | — |")
+        if len(out) == 2:
+            out.append("| (no measurements yet — re-run pending) | — | — |")
+        return out
+
     lines = [
         "# Flagship step profile (differential)",
         "",
@@ -448,16 +460,8 @@ def _write_profile_md(payload):
         "lax.scan, host-fetch synced, dispatch floor",
         f"({payload['fetch_floor_ms']} ms) subtracted.",
         "",
-        "| variant | ms/step | emb/s |",
-        "|---|---|---|",
     ]
-    for k, v in payload["results"].items():
-        if "ms_per_step" in v:
-            lines.append(
-                f"| {k} | {v['ms_per_step']} | {v['emb_per_sec']} |"
-            )
-        else:
-            lines.append(f"| {k} | ERROR: {v.get('error', '?')} | — |")
+    lines += _table_lines(payload["results"])
     lines += ["", "## Attribution", ""]
     if all(k in r for k in ("full", "fwd_only", "fwd_bwd", "npair_only")):
         lines += [
@@ -482,6 +486,17 @@ def _write_profile_md(payload):
         lines.append(
             f"- Inception-BN trunk (BN instead of LRN): {pct(r['bn'])} total"
         )
+    # Dated superseded measurement sets stay visible (e.g. the rows
+    # captured before the LRN pow->rsqrt rewrite).
+    for run in payload.get("prior_runs", []):
+        lines += [
+            "",
+            f"## Prior measurements ({run.get('date', '?')})",
+            "",
+            run.get("note", ""),
+            "",
+        ]
+        lines += _table_lines(run.get("results", {}))
     lines.append("")
     with open(os.path.join(REPO, "profile", "flagship.md"), "w") as f:
         f.write("\n".join(lines))
