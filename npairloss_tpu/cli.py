@@ -227,6 +227,14 @@ def cmd_train(args) -> int:
         return built
     solver, net_cfg, input_shape = built
 
+    if net_cfg.param_mults_conflict:
+        # Parse records (rather than raises) conflicting per-layer
+        # param recipes so inference-only commands can still load the
+        # net; training would silently apply NO multipliers, so it is
+        # the one path that must refuse.
+        log.error("%s", net_cfg.param_mults_conflict)
+        return 2
+
     if getattr(args, "caffe_solverstate", None):
         # The `caffe train --snapshot X.solverstate` semantics: resume
         # the optimizer (momentum + iteration) from a Caffe snapshot;
@@ -498,6 +506,19 @@ def cmd_export_caffemodel(args) -> int:
     if not args.weights and not args.snapshot:
         log.error("pass --weights (msgpack) or --snapshot (.ckpt dir)")
         return 2
+    if getattr(args, "solverstate_out", None):
+        # Mirror load_caffe_solverstate's gate, and do it before even
+        # restoring the tree: the variant trunks (googlenet_bn/s2d/
+        # fused/mxu) have momentum trees the unnamed positional history
+        # cannot map onto, and letting them past this point would raise
+        # from googlenet_history_from_momentum only AFTER the
+        # .caffemodel is written — defeating the validate-before-any-
+        # write rule below.
+        if args.model.lower() != "googlenet":
+            log.error("--solverstate-out supports the plain 'googlenet' "
+                      "trunk only (history blob order is pinned by the "
+                      "plain-trunk layer map)")
+            return 2
 
     from npairloss_tpu.config.caffemodel import write_caffemodel
     from npairloss_tpu.models.caffe_import import (
@@ -527,10 +548,6 @@ def cmd_export_caffemodel(args) -> int:
     # to an error exit.
     opt = None
     if getattr(args, "solverstate_out", None):
-        if "resnet" in args.model.lower():
-            log.error("--solverstate-out supports GoogLeNet trunks only "
-                      "(history blob order is pinned by the layer map)")
-            return 2
         opt = tree.get("opt") if isinstance(tree, dict) else None
         if not opt:
             log.error("--solverstate-out needs a training snapshot "

@@ -192,6 +192,14 @@ class NetConfig:
     # throughout, so the first declaring layer defines it.
     param_mults: Optional[Tuple[Tuple[float, float],
                                 Tuple[float, float]]] = None
+    # Set (with param_mults=None) when the net declares two DIFFERENT
+    # per-layer recipes (e.g. frozen layers at lr_mult 0 plus a
+    # trainable head).  One net-wide recipe is all the solver honors,
+    # so TRAINING such a net must fail loudly — but parse-time is too
+    # early: inference-only commands (test/extract/parse/eval) don't
+    # consume multipliers and must still load the net.  The train path
+    # checks this field before stepping (cli.cmd_train).
+    param_mults_conflict: Optional[str] = None
     # All layers in file order as raw Messages, for anything not modeled.
     layers: Tuple[Message, ...] = ()
 
@@ -269,6 +277,7 @@ def net_from_message(msg: Message) -> NetConfig:
     loss: Optional[LossLayerConfig] = None
     l2_normalize = False
     param_mults = None
+    param_mults_conflict = None
     for layer in layers:
         ltype = str(layer.get("type", ""))
         if ltype == "MultibatchData":
@@ -282,20 +291,24 @@ def net_from_message(msg: Message) -> NetConfig:
             loss = _loss_layer(layer)
         lm = _layer_param_mults(layer)
         if lm is not None:
-            if param_mults is not None and lm != param_mults:
+            if (param_mults_conflict is None and param_mults is not None
+                    and lm != param_mults):
                 # One net-wide recipe is an approximation (Caffe scopes
                 # param blocks per layer); two DIFFERENT recipes in one
                 # net (e.g. a frozen trunk + trainable head) cannot be
-                # honored — fail loudly rather than train silently
-                # wrong.
-                raise ValueError(
+                # honored.  Recorded (not raised) so inference-only
+                # commands still load the net; the train path fails
+                # loudly on this field rather than train silently wrong.
+                param_mults_conflict = (
                     "net declares conflicting param lr/decay multipliers"
                     f" ({param_mults} vs {lm} at layer "
                     f"{str(layer.get('name', '?'))!r}); per-layer "
                     "multipliers beyond one net-wide recipe are not "
-                    "supported"
+                    "supported for training"
                 )
             param_mults = lm
+    if param_mults_conflict is not None:
+        param_mults = None
     return NetConfig(
         name=str(msg.get("name", "")),
         data=data,
@@ -303,6 +316,7 @@ def net_from_message(msg: Message) -> NetConfig:
         loss=loss,
         l2_normalize=l2_normalize,
         param_mults=param_mults,
+        param_mults_conflict=param_mults_conflict,
         layers=layers,
     )
 

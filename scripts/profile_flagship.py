@@ -109,18 +109,41 @@ def _tpu_ready(timeout: int = 100) -> bool:
 
 
 def _write_artifacts(payload, artifact: str = ARTIFACT) -> None:
-    # Never destroy measurement history: if the file on disk carries
-    # prior_runs (dated, superseded measurement sets) and this payload
-    # does not, carry them forward — an --inline/--cpu run or a
-    # different-geometry orchestrator run must not delete evidence.
-    if "prior_runs" not in payload and os.path.exists(artifact):
+    # Never destroy measurement history.  Two rules, applied to the file
+    # on disk before it is replaced:
+    #   1. prior_runs (dated, superseded measurement sets) carry forward
+    #      when this payload doesn't already have them;
+    #   2. any MEASURED rows (ms_per_step present) the new payload does
+    #      not itself carry are demoted into prior_runs — so a
+    #      different-geometry orchestrator run, an --inline/--cpu run,
+    #      or the CPU-vs-TPU resume rejection all preserve evidence
+    #      instead of overwriting it.  (Resumed runs adopt the previous
+    #      results dict wholesale, so nothing is demoted there.)
+    prev = None
+    if os.path.exists(artifact):
         try:
             with open(artifact) as f:
                 prev = json.load(f)
-            if prev.get("prior_runs"):
-                payload["prior_runs"] = prev["prior_runs"]
         except Exception:
-            pass
+            prev = None
+    if prev:
+        if "prior_runs" not in payload and prev.get("prior_runs"):
+            payload["prior_runs"] = prev["prior_runs"]
+        new_results = payload.get("results") or {}
+        lost = {k: v for k, v in (prev.get("results") or {}).items()
+                if "ms_per_step" in v and new_results.get(k) != v}
+        already = [r.get("results") for r in payload.get("prior_runs", [])]
+        if lost and lost not in already:
+            payload.setdefault("prior_runs", []).append({
+                "date": time.strftime("%Y-%m-%d"),
+                "note": (
+                    f"superseded: rows measured on {prev.get('device')!r}"
+                    f" (batch {prev.get('batch')}, image"
+                    f" {prev.get('image')}) not carried forward by a"
+                    " later run — geometry/device mismatch or fresh"
+                    " start"),
+                "results": lost,
+            })
     os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
     tmp = artifact + ".tmp"
     with open(tmp, "w") as f:
@@ -148,12 +171,25 @@ def orchestrate(args) -> int:
         try:
             with open(artifact) as f:
                 prev = json.load(f)
-            # Resume only against the same workload geometry.
+            # Resume only against the same workload geometry AND device
+            # class: the orchestrator's children run on the default (TPU)
+            # backend, so rows measured by a --cpu/--inline run on a CPU
+            # backend must not be skipped as "completed" — that would
+            # silently publish CPU timings as the flagship TPU profile.
+            # prev["device"] is None until the first child reports in
+            # (skeleton from an all-down run), which is safe to resume;
+            # the rejection keys on recognizably-CPU device kinds so
+            # non-CPU kinds (TPU v5 lite, test doubles) still resume.
+            prev_dev = prev.get("device")
+            dev_ok = prev_dev is None or "cpu" not in str(prev_dev).lower()
             if (prev.get("batch") == args.batch
                     and prev.get("image") == args.image
-                    and prev.get("steps_per_timing") == args.steps):
+                    and prev.get("steps_per_timing") == args.steps
+                    and dev_ok):
                 payload = prev
                 payload.setdefault("results", {})
+            # else: start fresh — _write_artifacts demotes the old
+            # measured rows into prior_runs (never-destroy-history).
         except Exception:
             pass
 

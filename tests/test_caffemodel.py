@@ -332,6 +332,25 @@ def test_cli_export_from_snapshot(tmp_path, plain_params):
     assert len(st["history"]) == sum(len(b) for b in blobs.values())
 
 
+def test_cli_export_solverstate_rejects_variant_trunks(tmp_path):
+    """--solverstate-out with a variant GoogLeNet trunk (googlenet_bn/
+    s2d/fused/mxu) must fail in the upfront validation block, BEFORE
+    the .caffemodel is written: the variant momentum trees don't map
+    onto the plain-trunk layer order, and the old gate ('resnet' only)
+    let them through to raise AFTER the weights file landed on disk."""
+    from npairloss_tpu.cli import main
+
+    out = tmp_path / "deploy.caffemodel"
+    ss_out = tmp_path / "deploy.solverstate"
+    rc = main([
+        "export-caffemodel", "--model", "googlenet_bn",
+        "--snapshot", str(tmp_path / "never_loaded"),
+        "--out", str(out), "--solverstate-out", str(ss_out),
+    ])
+    assert rc == 2
+    assert not out.exists() and not ss_out.exists()
+
+
 def test_caffe_pad_stem_matches_explicit_pad3_conv():
     """caffe_pad=True must evaluate conv1 at Caffe's geometry: stride-2
     windows over symmetric pad 3 (usage/def.prototxt:100).  With stride

@@ -292,13 +292,7 @@ def test_net_param_mults_absent_without_blocks():
     assert net.param_mults is None
 
 
-def test_net_param_mults_conflict_is_loud():
-    """Two layers declaring DIFFERENT recipes (e.g. frozen trunk +
-    trainable head) cannot be honored net-wide — must raise, not train
-    silently wrong."""
-    from npairloss_tpu.config import net_from_text
-
-    text = '''
+CONFLICTING_MULTS_NET = '''
 name: "X"
 layer {
   name: "frozen" type: "Convolution"
@@ -311,5 +305,40 @@ layer {
   param { lr_mult: 2 decay_mult: 0 }
 }
 '''
-    with pytest.raises(ValueError, match="conflicting"):
-        net_from_text(text)
+
+
+def test_net_param_mults_conflict_recorded_not_raised():
+    """Two layers declaring DIFFERENT recipes (e.g. frozen trunk +
+    trainable head) cannot be honored net-wide — but a legitimate Caffe
+    net using per-layer recipes must still LOAD for inference-only
+    commands (test/extract/parse/eval), where multipliers are
+    irrelevant.  Parse records the conflict; only training refuses."""
+    from npairloss_tpu.config import net_from_text
+
+    net = net_from_text(CONFLICTING_MULTS_NET)
+    assert net.param_mults is None
+    assert "conflicting" in net.param_mults_conflict
+    assert "'head'" in net.param_mults_conflict
+
+
+def test_net_param_mults_conflict_refuses_training(tmp_path):
+    """cmd_train must fail loudly on the recorded conflict — training
+    with the multipliers silently dropped would be a different
+    trajectory than the net declares.  (Inference-only commands keep
+    working: test_net_param_mults_conflict_recorded_not_raised.)"""
+    import os
+
+    from npairloss_tpu.cli import main
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "examples", "tiny_net.prototxt")) as f:
+        tiny = f.read()
+    net = tmp_path / "net.prototxt"
+    net.write_text(tiny + CONFLICTING_MULTS_NET.split("\n", 2)[2])
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{net}"\nbase_lr: 0.001\nmax_iter: 1\n'
+        'lr_policy: "fixed"\nsnapshot: 0\n')
+    rc = main(["train", "--solver", str(solver), "--model", "mlp",
+               "--max_iter", "1", "--synthetic"])
+    assert rc == 2

@@ -102,6 +102,62 @@ def test_orchestrator_resume_skips_completed(pf, tmp_path, monkeypatch):
     assert rec["prior_runs"][0]["date"] == "earlier"  # history preserved
 
 
+def test_orchestrator_cpu_artifact_not_resumed(pf, tmp_path, monkeypatch):
+    """A prior --cpu run with matching geometry must NOT satisfy the
+    resume check — skipping its variants would silently publish CPU
+    timings as the flagship TPU profile.  The CPU rows are demoted to
+    prior_runs (history preserved), and every variant re-runs."""
+    monkeypatch.setattr(pf, "_tpu_ready", lambda timeout=100: True)
+    ran = []
+
+    def spy(cmd, **kw):
+        ran.append(cmd[cmd.index("--variant") + 1])
+        return _fake_run()(cmd, **kw)
+
+    art = tmp_path / "p.json"
+    art.write_text(json.dumps({
+        "device": "cpu", "batch": 8, "image": 32, "steps_per_timing": 2,
+        "fetch_floor_ms": 1.0,
+        "results": {"full": {"ms_per_step": 400.0, "emb_per_sec": 20.0}},
+        "prior_runs": [{"date": "earlier", "results": {}}],
+    }))
+    monkeypatch.setattr(subprocess, "run", spy)
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 0
+    rec = json.loads(art.read_text())
+    assert "full" in ran                  # CPU row did not count
+    assert rec["results"]["full"]["ms_per_step"] == 1.5
+    dates = [r.get("date") for r in rec["prior_runs"]]
+    assert "earlier" in dates             # old history carried forward
+    demoted = [r for r in rec["prior_runs"]
+               if "superseded" in r.get("note", "")]
+    assert demoted and demoted[0]["results"]["full"]["ms_per_step"] == 400.0
+
+
+def test_orchestrator_geometry_mismatch_demotes_not_destroys(
+        pf, tmp_path, monkeypatch):
+    """Re-running the orchestrator at a different batch must not delete
+    the previous geometry's measured rows — they demote to prior_runs
+    (the never-destroy-history invariant, generalized past the CPU
+    special case)."""
+    monkeypatch.setattr(pf, "_tpu_ready", lambda timeout=100: True)
+    monkeypatch.setattr(subprocess, "run", _fake_run())
+    art = tmp_path / "p.json"
+    art.write_text(json.dumps({
+        "device": "fake", "batch": 120, "image": 224,
+        "steps_per_timing": 2, "fetch_floor_ms": 1.0,
+        "results": {"full": {"ms_per_step": 27.8, "emb_per_sec": 4316.5}},
+    }))
+    rc = pf.orchestrate(_args(pf, art))  # batch=8 != 120
+    assert rc == 0
+    rec = json.loads(art.read_text())
+    assert rec["batch"] == 8
+    assert rec["results"]["full"]["ms_per_step"] == 1.5
+    demoted = [r for r in rec["prior_runs"]
+               if "superseded" in r.get("note", "")]
+    assert demoted and demoted[0]["results"]["full"]["ms_per_step"] == 27.8
+
+
 def test_orchestrator_tunnel_down_fails_structured(pf, tmp_path,
                                                    monkeypatch):
     monkeypatch.setattr(pf, "_tpu_ready", lambda timeout=100: False)
