@@ -60,31 +60,41 @@ def forward(
     labels: Sequence[np.ndarray],
     cfg: NPairLossConfig,
     top_ks: Sequence[int] = (1, 5, 10),
+    dtype=np.float32,
 ) -> List[RankResult]:
-    """Run the forward pass for every simulated rank."""
+    """Run the forward pass for every simulated rank.
+
+    ``dtype`` is the reference's ``Dtype`` template parameter
+    (npair_multi_class_loss.cu:38-41 dispatches MPI_FLOAT/MPI_DOUBLE by
+    ``sizeof(Dtype)``): ``np.float64`` renders the double instantiation.
+    The mining clamps stay FLT_MAX in BOTH precisions — the reference
+    writes ``(Dtype)-FLT_MAX`` (cu:230-236, cu:288), not DBL_MAX.
+    """
     g = len(features)
-    total_f = np.concatenate([f.astype(np.float32) for f in features], axis=0)
-    total_l = np.concatenate([l.astype(np.float32) for l in labels], axis=0)
+    total_f = np.concatenate([f.astype(dtype) for f in features], axis=0)
+    total_l = np.concatenate([l.astype(dtype) for l in labels], axis=0)
     out = []
     for rank in range(g):
         out.append(
             _forward_rank(
-                features[rank].astype(np.float32),
-                labels[rank].astype(np.float32),
+                features[rank].astype(dtype),
+                labels[rank].astype(dtype),
                 total_f,
                 total_l,
                 rank,
                 cfg,
                 top_ks,
+                dtype,
             )
         )
     return out
 
 
-def _forward_rank(f, l, total_f, total_l, rank, cfg, top_ks):
+def _forward_rank(f, l, total_f, total_l, rank, cfg, top_ks,
+                  dtype=np.float32):
     n, d = f.shape
     ng = total_f.shape[0]
-    sims = (f @ total_f.T).astype(np.float32)
+    sims = (f @ total_f.T).astype(dtype)
 
     # Masks (GetLabelDiffMtx, cu:44-66): self pair excluded from both.
     same = np.zeros((n, ng), dtype=bool)
@@ -98,10 +108,11 @@ def _forward_rank(f, l, total_f, total_l, rank, cfg, top_ks):
             else:
                 diff[q, b] = True
 
-    # Mining statistics (cu:222-273).
-    max_all = np.full(n, -FLT_MAX, dtype=np.float32)
-    min_within = np.full(n, FLT_MAX, dtype=np.float32)
-    max_between = np.full(n, -FLT_MAX, dtype=np.float32)
+    # Mining statistics (cu:222-273).  FLT_MAX fills in both precisions
+    # — the reference caffe_sets (Dtype)-FLT_MAX (cu:230-236).
+    max_all = np.full(n, -FLT_MAX, dtype=dtype)
+    min_within = np.full(n, FLT_MAX, dtype=dtype)
+    max_between = np.full(n, -FLT_MAX, dtype=dtype)
     ident_global: List[float] = []
     diff_global: List[float] = []
     ident_local: List[List[float]] = []
@@ -128,8 +139,8 @@ def _forward_rank(f, l, total_f, total_l, rank, cfg, top_ks):
 
     # Threshold selection (cu:275-337).
     relative = (MiningMethod.RELATIVE_HARD, MiningMethod.RELATIVE_EASY)
-    pos_thr = np.zeros(n, dtype=np.float32)
-    neg_thr = np.zeros(n, dtype=np.float32)
+    pos_thr = np.zeros(n, dtype=dtype)
+    neg_thr = np.zeros(n, dtype=dtype)
     if cfg.ap_mining_region == MiningRegion.LOCAL:
         if cfg.ap_mining_method in relative:
             for q in range(n):
@@ -156,8 +167,8 @@ def _forward_rank(f, l, total_f, total_l, rank, cfg, top_ks):
     # Selection (GetSampledPairMtx, cu:69-122).
     select = np.zeros((n, ng), dtype=bool)
     for q in range(n):
-        pt = pos_thr[q] + np.float32(cfg.margin_ident)
-        nt = neg_thr[q] + np.float32(cfg.margin_diff)
+        pt = pos_thr[q] + dtype(cfg.margin_ident)
+        nt = neg_thr[q] + dtype(cfg.margin_diff)
         for b in range(ng):
             s = sims[q, b]
             if same[q, b]:
@@ -178,11 +189,11 @@ def _forward_rank(f, l, total_f, total_l, rank, cfg, top_ks):
                     or (m == MiningMethod.RELATIVE_HARD and s >= nt)
                     or (m == MiningMethod.RELATIVE_EASY and s <= nt)
                 )
-    sel_pos = (same & select).astype(np.float32)
-    sel_neg = (diff & select).astype(np.float32)
+    sel_pos = (same & select).astype(dtype)
+    sel_neg = (diff & select).astype(dtype)
 
     # Stabilized loss (cu:124-171, cu:362-388).
-    sim_exp = np.exp(sims - max_all[:, None]).astype(np.float32)
+    sim_exp = np.exp(sims - max_all[:, None]).astype(dtype)
     exp_pos = sim_exp * sel_pos
     exp_neg = sim_exp * sel_neg
     ident_sum = exp_pos.sum(axis=1)
@@ -233,15 +244,17 @@ def backward(
     features: Sequence[np.ndarray],
     results: Sequence[RankResult],
     loss_weight: float = 1.0,
+    dtype=np.float32,
 ) -> List[np.ndarray]:
     """Per-rank feature gradients with the reference's exact scaling.
 
     (Backward_gpu, cu:420-499: dot_normalizer = N; MPI_Allreduce(SUM) of the
-    database-role gradient then 1/G; final 0.5/0.5 role averaging.)
+    database-role gradient then 1/G; final 0.5/0.5 role averaging.
+    ``dtype`` as in :func:`forward` — np.float64 for the double path.)
     """
     g_ranks = len(features)
     n = features[0].shape[0]
-    total_f = np.concatenate([f.astype(np.float32) for f in features], axis=0)
+    total_f = np.concatenate([f.astype(dtype) for f in features], axis=0)
 
     db_grads = []
     query_grads = []
@@ -262,13 +275,13 @@ def backward(
     # Allreduce(SUM) of database-role grads then scale 1/G (cu:462-489).
     db_total = np.zeros_like(total_f)
     for rank in range(g_ranks):
-        db_total += db_grads[rank] @ features[rank].astype(np.float32)
+        db_total += db_grads[rank] @ features[rank].astype(dtype)
     db_total /= g_ranks
 
     out = []
     for rank in range(g_ranks):
         local = db_total[rank * n : (rank + 1) * n]
         final = 0.5 * local + 0.5 * query_grads[rank]  # cu:492-497
-        out.append(final.astype(np.float32))
+        out.append(final.astype(dtype))
         results[rank].grad = out[-1]
     return out

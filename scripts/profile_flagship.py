@@ -119,19 +119,19 @@ def _write_artifacts(payload, artifact: str = ARTIFACT) -> None:
     #      or the CPU-vs-TPU resume rejection all preserve evidence
     #      instead of overwriting it.  (Resumed runs adopt the previous
     #      results dict wholesale, so nothing is demoted there.)
-    prev = None
-    if os.path.exists(artifact):
-        try:
-            with open(artifact) as f:
-                prev = json.load(f)
-        except Exception:
-            prev = None
-    if prev:
+    # All prev access stays inside one try/except: a malformed artifact
+    # (hand-edited, legacy shape) must degrade to "no history carried",
+    # never crash this function — it runs after every measured variant,
+    # and an exception here would lose the row it was called to save.
+    try:
+        with open(artifact) as f:
+            prev = json.load(f)
         if "prior_runs" not in payload and prev.get("prior_runs"):
             payload["prior_runs"] = prev["prior_runs"]
         new_results = payload.get("results") or {}
         lost = {k: v for k, v in (prev.get("results") or {}).items()
-                if "ms_per_step" in v and new_results.get(k) != v}
+                if isinstance(v, dict) and "ms_per_step" in v
+                and new_results.get(k) != v}
         already = [r.get("results") for r in payload.get("prior_runs", [])]
         if lost and lost not in already:
             payload.setdefault("prior_runs", []).append({
@@ -144,6 +144,8 @@ def _write_artifacts(payload, artifact: str = ARTIFACT) -> None:
                     " start"),
                 "results": lost,
             })
+    except Exception:
+        pass
     os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
     tmp = artifact + ".tmp"
     with open(tmp, "w") as f:

@@ -1,0 +1,102 @@
+"""The reference layer is templated over Dtype and its MPI dispatch
+handles double (npair_multi_class_loss.cu:38-41, cu:471-487).  The TPU
+engines are fp32-by-design (fp64 is software-emulated on TPU; see
+PARITY.md "Dtype=double"), so the double instantiation lives in the
+ORACLE: ``oracle.forward/backward(dtype=np.float64)`` renders the exact
+double semantics — including the (Dtype)-FLT_MAX clamps the reference
+keeps even at double precision (cu:230-236, cu:288).
+
+These tests pin (a) that the fp64 oracle is self-consistent with the
+fp32 oracle to fp32 tolerance (so fp32 loses nothing on flagship-shaped
+inputs), and (b) that the fp32 JAX engine matches the fp64 oracle as
+closely as it matches the fp32 one — the evidence behind the fp32-only
+decision.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import make_identity_batch
+from npairloss_tpu import MiningMethod, MiningRegion, NPairLossConfig
+from npairloss_tpu.ops.npair_loss import REFERENCE_CONFIG, npair_loss_with_aux
+from npairloss_tpu.testing import oracle
+
+GRID = [
+    REFERENCE_CONFIG,
+    NPairLossConfig(
+        margin_ident=0.02, identsn=-0.4,
+        ap_mining_region=MiningRegion.GLOBAL,
+        ap_mining_method=MiningMethod.RELATIVE_HARD,
+        an_mining_region=MiningRegion.LOCAL,
+        an_mining_method=MiningMethod.HARD,
+    ),
+    NPairLossConfig(
+        margin_diff=-0.05, diffsn=-0.3,
+        ap_mining_region=MiningRegion.LOCAL,
+        ap_mining_method=MiningMethod.EASY,
+        an_mining_region=MiningRegion.GLOBAL,
+        an_mining_method=MiningMethod.RELATIVE_EASY,
+    ),
+]
+
+
+@pytest.mark.parametrize("cfg", GRID)
+def test_fp64_oracle_matches_fp32_oracle(rng, cfg):
+    feats, labs = make_identity_batch(rng, 4, 3, 8)
+    r32 = oracle.forward(feats, labs, cfg)
+    r64 = oracle.forward(feats, labs, cfg, dtype=np.float64)
+    assert r64[0].sims.dtype == np.float64
+    # Mining SELECTIONS must be identical — thresholds are order
+    # statistics of the similarity list, and fp32 rounding must not
+    # flip any on these well-separated inputs.
+    np.testing.assert_array_equal(r32[0].select, r64[0].select)
+    np.testing.assert_allclose(r32[0].loss, r64[0].loss, rtol=1e-5)
+    g32 = oracle.backward(feats, r32)
+    g64 = oracle.backward(feats, r64, dtype=np.float64)
+    assert g64[0].dtype == np.float64
+    np.testing.assert_allclose(g32[0], g64[0], rtol=1e-4, atol=1e-7)
+
+
+@pytest.mark.parametrize("cfg", GRID)
+def test_fp32_engine_matches_fp64_oracle(rng, cfg):
+    """The fp32 JAX engine agrees with the DOUBLE instantiation's
+    semantics to fp32 tolerance — fp64 would add precision the flagship
+    workload cannot observe."""
+    feats, labs = make_identity_batch(rng, 4, 3, 8)
+    want = oracle.forward(feats, labs, cfg, dtype=np.float64)[0]
+    loss, aux = jax.jit(
+        lambda f, l: npair_loss_with_aux(f, l, cfg)
+    )(feats[0], labs[0])
+    np.testing.assert_allclose(float(loss), want.loss, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(aux["pos_threshold"], np.float64), want.pos_thr,
+        rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(aux["neg_threshold"], np.float64), want.neg_thr,
+        rtol=1e-5, atol=1e-7)
+
+
+def test_fp64_keeps_flt_max_clamps():
+    """cu:230-236/cu:288 write (Dtype)-FLT_MAX even for double: the
+    empty-list fill and the <0 clamp must be FLT_MAX-magnitude in the
+    fp64 oracle, NOT DBL_MAX."""
+    # One identity, one image: no positives and no negatives anywhere
+    # -> every mining statistic keeps its fill value.
+    feats = [np.ones((1, 4), np.float64)]
+    labs = [np.zeros((1,), np.float64)]
+    cfg = NPairLossConfig(
+        ap_mining_region=MiningRegion.LOCAL,
+        ap_mining_method=MiningMethod.RELATIVE_HARD,
+        an_mining_region=MiningRegion.LOCAL,
+        an_mining_method=MiningMethod.RELATIVE_HARD,
+    )
+    res = oracle.forward(feats, labs, cfg, top_ks=(), dtype=np.float64)[0]
+    flt_max = float(np.finfo(np.float32).max)
+    assert res.max_all[0] == -flt_max
+    assert res.pos_thr[0] == flt_max  # empty ident list -> +FLT_MAX fill
+    # (loss is nan here in BOTH precisions: exp(s + FLT_MAX) overflows
+    # and inf*0 = nan — the reference's own batch-of-1 hazard, which the
+    # oracle reproduces faithfully and the JAX engine guards to 0;
+    # tests/test_pallas.py::test_blockwise_batch_of_one_grad_finite.)
+    assert np.isnan(res.loss)
