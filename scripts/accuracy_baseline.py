@@ -114,6 +114,52 @@ def run_config(name, loss_cfg, model_name, model_kw, input_shape, num_ids,
     }
 
 
+def run_band_config(name, loss_cfg, expected_band, seeds=(0, 1, 2),
+                    tail_points=8, **kw):
+    """A config whose expected Recall@1 is a BAND below 1.0, not ~1.0.
+
+    The separable-cluster rows catch broken gradients/mining/metrics but
+    a mining regression that merely *slows* convergence on hard data
+    would still reach R@1=1.0 there.  This row trains on OVERLAPPING
+    clusters where final accuracy is mining-limited: the flagship mining
+    config lands inside ``expected_band`` while unmined (RAND=ALL)
+    training falls below its lower edge at the same geometry/steps —
+    calibrated on CPU, seeds 0-2 (flagship tail-avgs 0.65-0.77, mean
+    0.728; unmined 0.55-0.62, mean 0.590; noise 1.4, 600 steps).
+
+    Per-batch R@1 over 32 queries is quantized (1/32 steps), so the
+    score is the mean of the last ``tail_points`` recorded points,
+    averaged over ``seeds``.
+    """
+    import numpy as np
+
+    per_seed = []
+    curves = {}
+    for seed in seeds:
+        r = run_config(f"{name}_seed{seed}", loss_cfg, seed=seed, **kw)
+        tail = float(np.mean(
+            [p["retrieve_top1"] for p in r["curve"][-tail_points:]]))
+        per_seed.append(round(tail, 4))
+        curves[f"seed{seed}"] = r["curve"]
+    score = round(sum(per_seed) / len(per_seed), 4)
+    lo, hi = expected_band
+    print(f"  {name}: tail-avg R@1 per seed {per_seed} -> mean {score} "
+          f"(expected band [{lo}, {hi}])", flush=True)
+    return {
+        "name": name,
+        "engine": "dense",
+        "steps": kw.get("steps"),
+        "final_loss": None,
+        "final_recall_at_1": score,
+        "expected_band": [lo, hi],
+        "per_seed_tail_recall": per_seed,
+        # Every seed's raw trajectory — a band miss on seed 1 or 2 must
+        # be diagnosable from the artifact, not just seed 0's curve.
+        "curve": curves[f"seed{seeds[0]}"],
+        "curves_per_seed": curves,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=300)
@@ -257,6 +303,18 @@ def main():
              input_shape=(32, 32, 3),
              num_ids=16, ids_per_batch=16, lr=0.05, record_every=10,
              noise=0.6)),
+        # OVERLAPPING clusters: final R@1 is mining-limited (expected
+        # band, NOT 1.0) — the convergence-RATE regression detector the
+        # separable rows cannot provide (VERDICT r4 weak #7).  Unmined
+        # training at this geometry falls below the band's lower edge.
+        # Steps pinned at the calibrated 600 (NOT scaled by --steps):
+        # the two-sided band is calibrated at this exact budget, and
+        # more steps would drift the tail recall past the upper edge.
+        ("overlap_mined_band",
+         lambda: run_band_config(
+             "overlap_mined_band", REFERENCE_CONFIG,
+             expected_band=(0.63, 0.92),
+             steps=600, noise=1.4, record_every=10, **mlp)),
         # Conv trunk: ResNet-18 (the reduced proxy of BASELINE.json
         # cfg 3's ResNet-50/SOP run) with LOCAL/HARD mining.
         ("resnet18_small",
@@ -317,15 +375,28 @@ def main():
         "|---|---|---|---|---|",
     ]
     for r in results:
+        loss_cell = ("—" if r.get("final_loss") is None
+                     else f"{r['final_loss']:.4f}")
+        recall_cell = f"{r['final_recall_at_1']:.3f}"
+        if r.get("expected_band"):
+            lo, hi = r["expected_band"]
+            recall_cell += f" (band [{lo}, {hi}])"
         lines.append(
             f"| {r['name']} | {r['engine']} | {r['steps']} | "
-            f"{r['final_loss']:.4f} | {r['final_recall_at_1']:.3f} |"
+            f"{loss_cell} | {recall_cell} |"
         )
     lines += [
         "",
         f"Backend: `{jax.default_backend()}`.  All configs must reach "
-        "Recall@1 >= 0.95 (conv trunks at the same bar); "
-        "`tests/test_accuracy_baseline.py` replays a short run in CI.",
+        "Recall@1 >= 0.95 (conv trunks at the same bar), EXCEPT rows "
+        "with an expected band: those train on overlapping clusters "
+        "where final R@1 is mining-limited, and the seed-averaged "
+        "tail recall must land INSIDE the band — below means a "
+        "convergence-rate regression (unmined training falls below "
+        "the lower edge by construction), above means the data "
+        "stopped being hard.  `tests/test_accuracy_baseline.py` "
+        "replays short runs (incl. the band row and its unmined "
+        "counterexample) in CI.",
         "",
         "The flagship def.prototxt config trains END-TO-END on the real",
         "GoogLeNet trunk via the Inception-BN variant",
@@ -342,7 +413,15 @@ def main():
 
     # One bar for every row, conv trunks included (the round-3 0.85
     # conv concession is obsolete: every trunk converges to ~1.0).
-    bad = [r for r in results if r["final_recall_at_1"] < 0.95]
+    # Band rows gate BOTH directions: below = convergence regression,
+    # above = the data stopped being hard (a test-bug signal).
+    def _ok(r):
+        if r.get("expected_band"):
+            lo, hi = r["expected_band"]
+            return lo <= r["final_recall_at_1"] <= hi
+        return r["final_recall_at_1"] >= 0.95
+
+    bad = [r for r in results if not _ok(r)]
     if bad:
         print(f"FAILED configs: {[r['name'] for r in bad]}", file=sys.stderr)
         return 1

@@ -144,8 +144,14 @@ def _write_artifacts(payload, artifact: str = ARTIFACT) -> None:
                     " start"),
                 "results": lost,
             })
-    except Exception:
+    except FileNotFoundError:
         pass
+    except Exception as e:
+        # Degrade (don't crash — this runs after every measured variant)
+        # but say so: silent history loss is the failure mode this
+        # function exists to prevent.
+        print(f"[profile] WARNING: could not carry history from "
+              f"{artifact}: {e!r}", file=sys.stderr, flush=True)
     os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
     tmp = artifact + ".tmp"
     with open(tmp, "w") as f:
