@@ -487,69 +487,88 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None):
     of the K=147/C_in=3 conv1 (models/layers.conv1_kernel_to_s2d), the
     claimed ~28%-of-FLOPs MXU-underutilization fix (VERDICT r3 item 4) —
     recording it here makes the s2d MFU a driver artifact."""
-    from npairloss_tpu import REFERENCE_CONFIG
-    from npairloss_tpu.models import get_model
-    from npairloss_tpu.train import Solver, SolverConfig
-
     rows = {}
     # Ordered by importance: the soft deadline may skip later rows.
     # The parity-preserving MXU rewrites (s2d stem, fused inception
     # 1x1s, both = "mxu") and the remat row answer PROFILE.md's open
-    # attribution questions with driver-captured numbers.
-    for batch, model_name, key, model_kw in (
-        (120, "googlenet", "120", {}),
-        (120, "googlenet_mxu", "120_mxu", {}),
-        (240, "googlenet", "240", {}),
-        (480, "googlenet", "480", {}),
-        (120, "googlenet_s2d", "120_s2d", {}),
-        (120, "googlenet_fused", "120_fused", {}),
+    # attribution questions with driver-captured numbers.  The vit_b16
+    # rows time BASELINE.json config 5's trunk (real ViT-B/16: patch 16,
+    # hidden 768, depth 12) through the blockwise (stretch-path) engine;
+    # the 256 row probes the largest batch and runs LAST so an OOM
+    # cannot cost any other row.
+    for batch, model_name, key, model_kw, solver_kw in (
+        (120, "googlenet", "120", {}, {}),
+        (120, "googlenet_mxu", "120_mxu", {}, {}),
+        (240, "googlenet", "240", {}, {}),
+        (480, "googlenet", "480", {}, {}),
+        (128, "vit_b16", "vit_b16_128", {}, {"engine": "blockwise"}),
+        (120, "googlenet_s2d", "120_s2d", {}, {}),
+        (120, "googlenet_fused", "120_fused", {}, {}),
         # Remat row: does relieving activation HBM pressure recover the
         # batch-480 MFU decay?  (~25% extra trunk FLOPs for O(block)
         # activation memory; numerically identical.)
-        (480, "googlenet", "480_remat", {"remat": True}),
+        (480, "googlenet", "480_remat", {"remat": True}, {}),
+        (256, "vit_b16", "vit_b16_256", {}, {"engine": "blockwise"}),
     ):
         if deadline is not None and time.time() > deadline:
             _log(f"batch scaling: skipping {key} (soft time budget reached)")
             rows[key] = {"skipped": "soft time budget reached"}
             continue
-        solver = Solver(
-            get_model(model_name, dtype=jnp.bfloat16, **model_kw),
-            REFERENCE_CONFIG,
-            SolverConfig(
-                base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
-                momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
-            ),
-            input_shape=(IMAGE, IMAGE, 3),
-        )
-        rng = np.random.default_rng(0)
-        x = jax.device_put(jnp.asarray(
-            rng.standard_normal((batch, IMAGE, IMAGE, 3)).astype(np.float32)
-        ))
-        lab = jax.device_put(jnp.asarray(
-            np.repeat(np.arange(batch // 2), 2).astype(np.int32)
-        ))
-        _log(f"batch scaling: compiling {key} ({model_name})...")
-        steps = 10
-        dt = _measure(
-            lambda a, b: solver.step(a, b), [x, lab], 1, steps,
-            lambda m: float(np.asarray(m["loss"])), floor,
-        )
-        mfu = None
         try:
-            compiled = solver._step_fn.lower(solver.state, x, lab).compile()
-            step_flops = _cost_flops(compiled)
-            peak = _peak_flops(dev.device_kind)
-            if step_flops and peak:
-                mfu = round((step_flops * steps / dt) / peak, 4)
-        except Exception as e:
-            _log(f"batch {key} mfu estimate failed: {e}")
-        rows[key] = {
-            "emb_per_sec": round(batch * steps / dt, 1),
-            "ms_per_step": round(dt / steps * 1e3, 2),
-            **({"mfu": mfu} if mfu is not None else {}),
-        }
-        _log(f"batch scaling: {key}: {rows[key]}")
+            _batch_scaling_row(
+                jax, jnp, np, dev, floor, rows, batch, model_name, key,
+                model_kw, solver_kw,
+            )
+        except Exception as e:  # e.g. ViT-256 OOM: record, don't void
+            _log(f"batch scaling: {key} FAILED: {e}")
+            rows[key] = {"error": str(e)[:300]}
     return rows
+
+
+def _batch_scaling_row(jax, jnp, np, dev, floor, rows, batch, model_name,
+                       key, model_kw, solver_kw):
+    from npairloss_tpu import REFERENCE_CONFIG
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    solver = Solver(
+        get_model(model_name, dtype=jnp.bfloat16, **model_kw),
+        REFERENCE_CONFIG,
+        SolverConfig(
+            base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
+            momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
+        ),
+        input_shape=(IMAGE, IMAGE, 3),
+        **solver_kw,
+    )
+    rng = np.random.default_rng(0)
+    x = jax.device_put(jnp.asarray(
+        rng.standard_normal((batch, IMAGE, IMAGE, 3)).astype(np.float32)
+    ))
+    lab = jax.device_put(jnp.asarray(
+        np.repeat(np.arange(batch // 2), 2).astype(np.int32)
+    ))
+    _log(f"batch scaling: compiling {key} ({model_name})...")
+    steps = 10
+    dt = _measure(
+        lambda a, b: solver.step(a, b), [x, lab], 1, steps,
+        lambda m: float(np.asarray(m["loss"])), floor,
+    )
+    mfu = None
+    try:
+        compiled = solver._step_fn.lower(solver.state, x, lab).compile()
+        step_flops = _cost_flops(compiled)
+        peak = _peak_flops(dev.device_kind)
+        if step_flops and peak:
+            mfu = round((step_flops * steps / dt) / peak, 4)
+    except Exception as e:
+        _log(f"batch {key} mfu estimate failed: {e}")
+    rows[key] = {
+        "emb_per_sec": round(batch * steps / dt, 1),
+        "ms_per_step": round(dt / steps * 1e3, 2),
+        **({"mfu": mfu} if mfu is not None else {}),
+    }
+    _log(f"batch scaling: {key}: {rows[key]}")
 
 
 def child_smoke(platform: str) -> int:

@@ -76,6 +76,42 @@ def make_dataset(root: str, rng: np.random.Generator):
             )
 
 
+def make_dataset_structural(root: str, rng: np.random.Generator):
+    """Conv-trunk variant of the dataset: the color-blob identities of
+    :func:`make_dataset` are nearly solved by a RANDOM conv init
+    (global pooling of random conv features ~ a color histogram, and
+    the identity IS a color pattern: first zero-shot R@1 0.875 —
+    measured), which would make the rising-curve requirement vacuous.
+
+    Here identity lives in SPATIAL STRUCTURE only: a fixed binary blob
+    mask per class, rendered per-instance with a random hue pair
+    (foreground guaranteed brighter, but both hues re-drawn every
+    instance) — so color statistics carry ~no class signal and the
+    trunk must learn the shape.  Same jitter family as the mlp dataset
+    (noise, brightness, large translation roll)."""
+    from PIL import Image
+
+    for cid in range(IDS):
+        base_rng = np.random.default_rng(2000 + cid)
+        coarse = base_rng.standard_normal((6, 6))
+        up = np.kron(coarse, np.ones((SIDE // 6 + 1, SIDE // 6 + 1)))
+        mask = (up[:SIDE, :SIDE] > 0).astype(np.float64)[..., None]
+        cdir = os.path.join(root, f"id_{cid:03d}")
+        os.makedirs(cdir, exist_ok=True)
+        for k in range(PER_ID):
+            bg = rng.uniform(30, 120, size=3)
+            fg = bg + rng.uniform(60, 110, size=3)  # brighter, random hue
+            inst = mask * fg + (1 - mask) * bg
+            inst = inst + rng.normal(0, 25, size=inst.shape)
+            inst = inst + rng.uniform(-20, 20)
+            dx, dy = rng.integers(-8, 9, size=2)
+            inst = np.roll(inst, (dy, dx), axis=(0, 1))
+            img = np.clip(inst, 0, 255).astype(np.uint8)
+            Image.fromarray(img).save(
+                os.path.join(cdir, f"img_{k:02d}.jpg"), quality=92,
+            )
+
+
 NET_TPL = """\
 name: "MLP_E2E"
 layer {{
@@ -147,7 +183,7 @@ layer {{
 
 SOLVER_TPL = """\
 net: "{ws}/net.prototxt"
-base_lr: 0.03
+base_lr: {base_lr}
 lr_policy: "fixed"
 momentum: 0.9
 weight_decay: 0.0001
@@ -219,27 +255,51 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--workdir", default="/tmp/e2e_jpeg")
     ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument(
+        "--model", default="mlp",
+        help="trunk for the CLI runs; 'googlenet_bn' is the conv-trunk "
+        "variant of the proof (VERDICT r4 missing #3: JPEG pipeline + "
+        "conv trunk + mined loss in ONE artifact)")
+    ap.add_argument(
+        "--base-lr", type=float, default=None,
+        help="solver base_lr (default: 0.03 for mlp, 0.05 for conv "
+        "trunks — the accuracy-baseline conv recipe)")
     ap.add_argument("--r1-bar", type=float, default=0.9,
                     help="train-batch retrieve_top1 the final model must "
                     "reach (seen classes)")
-    ap.add_argument("--unseen-bar", type=float, default=0.7,
+    ap.add_argument("--unseen-bar", type=float, default=None,
                     help="zero-shot bar: TEST retrieve_top1 / full-gallery "
-                    "R@1 over classes never seen in training")
+                    "R@1 over classes never seen in training (default 0.7 "
+                    "for mlp; 0.4 for conv trunks, whose structural "
+                    "dataset is much harder — calibrated zero-shot "
+                    "plateau ~0.5-0.6 with 16-image TEST batches)")
     ap.add_argument(
-        "--artifact",
-        default=os.path.join(REPO, "accuracy", "e2e_real_jpeg.json"),
+        "--artifact", default=None,
+        help="default accuracy/e2e_real_jpeg.json, or "
+        "accuracy/e2e_real_jpeg_<model>.json for non-mlp trunks",
     )
     args = ap.parse_args()
+    if args.base_lr is None:
+        args.base_lr = 0.03 if args.model == "mlp" else 0.05
+    if args.unseen_bar is None:
+        args.unseen_bar = 0.7 if args.model == "mlp" else 0.4
+    if args.artifact is None:
+        suffix = "" if args.model == "mlp" else f"_{args.model}"
+        args.artifact = os.path.join(
+            REPO, "accuracy", f"e2e_real_jpeg{suffix}.json")
 
     ws = os.path.abspath(args.workdir)
     shutil.rmtree(ws, ignore_errors=True)
     os.makedirs(ws, exist_ok=True)
     rng = np.random.default_rng(7)
 
+    structural = args.model != "mlp"
     print(f"[e2e] generating {IDS} ids x "
           f"{PER_ID} JPEGs under {ws}/images "
-          f"({TRAIN_CLASSES} train / {IDS - TRAIN_CLASSES} zero-shot)")
-    make_dataset(os.path.join(ws, "images"), rng)
+          f"({TRAIN_CLASSES} train / {IDS - TRAIN_CLASSES} zero-shot, "
+          f"{'structural' if structural else 'color-blob'} identities)")
+    (make_dataset_structural if structural else make_dataset)(
+        os.path.join(ws, "images"), rng)
 
     # Zero-shot split through the real tool (the reference datasets'
     # protocol: first classes train, remaining classes test).
@@ -260,7 +320,7 @@ def main() -> int:
         f.write(NET_TPL.format(ws=ws, side=SIDE))
     with open(os.path.join(ws, "solver.prototxt"), "w") as f:
         f.write(SOLVER_TPL.format(
-            ws=ws, max_iter=args.steps, display=display,
+            ws=ws, max_iter=args.steps, display=display, base_lr=args.base_lr,
             test_interval=max(args.steps // 4, 1), snapshot=snapshot_at,
         ))
 
@@ -268,7 +328,7 @@ def main() -> int:
     print(f"[e2e] training {args.steps} iters via CLI (--native require)")
     out1 = run_cli(
         ["train", "--solver", os.path.join(ws, "solver.prototxt"),
-         "--model", "mlp", "--native", "require"],
+         "--model", args.model, "--native", "require"],
         os.path.join(ws, "train.log"),
     )
     train_curve, test_curve, _ = parse_curve(out1)
@@ -282,7 +342,7 @@ def main() -> int:
     print(f"[e2e] resuming from {snap} via CLI")
     out2 = run_cli(
         ["train", "--solver", os.path.join(ws, "solver.prototxt"),
-         "--model", "mlp", "--native", "require", "--resume", snap],
+         "--model", args.model, "--native", "require", "--resume", snap],
         os.path.join(ws, "resume.log"),
     )
     r_train, r_test, resumed_from = parse_curve(out2)
@@ -307,7 +367,7 @@ def main() -> int:
         n_test = (IDS - TRAIN_CLASSES) * PER_ID
         out3 = run_cli(
             ["extract", "--solver", os.path.join(ws, "solver.prototxt"),
-             "--model", "mlp", "--native", "require", "--phase", "TEST",
+             "--model", args.model, "--native", "require", "--phase", "TEST",
              "--batches", str(n_test // 16),
              "--resume", final_snap, "--out", os.path.join(ws, "feats")],
             os.path.join(ws, "extract.log"),
@@ -359,10 +419,12 @@ def main() -> int:
             "loader": "native (--native require; C++ runtime, libjpeg)",
             "augmentation": "resize 64 -> random crop 56 + mirror "
                             "(train), center crop (test)",
-            "model": "mlp", "mining": "GLOBAL/HARD margin_diff=-0.05",
+            "model": args.model,
+            "mining": "GLOBAL/HARD margin_diff=-0.05",
         },
-        "command": ("python -m npairloss_tpu train --solver <ws>/"
-                    "solver.prototxt --model mlp --native require"),
+        "command": (f"python -m npairloss_tpu train --solver <ws>/"
+                    f"solver.prototxt --model {args.model} "
+                    "--native require"),
         "train_curve": train_curve,
         "test_curve": test_curve,
         "resume": {

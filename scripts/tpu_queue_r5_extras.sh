@@ -1,0 +1,59 @@
+#!/bin/bash
+# Round-5 follow-on hardware captures.  Runs AFTER tpu_queue_v3.sh
+# completes (it polls the v3 log for the DONE marker) so it can never
+# steal tunnel bandwidth from the primary evidence sweep — concurrent
+# dispatches pollute the timings (docs/DESIGN.md §6).
+#
+#   1. conv-trunk e2e JPEG proof ON THE CHIP (the TPU counterpart of
+#      accuracy/e2e_real_jpeg_googlenet_bn.json): native C++ loader +
+#      on-device augmentation + googlenet_bn + mined loss + snapshot/
+#      resume against the real backend.
+#
+# Run detached:  setsid nohup scripts/tpu_queue_r5_extras.sh &
+# Log: /tmp/tpu_queue_r5_extras.log
+cd "$(dirname "$0")/.."
+exec > /tmp/tpu_queue_r5_extras.log 2>&1
+
+probe() {
+  timeout 100 python -c \
+    'import jax,sys; sys.exit(jax.devices()[0].platform != "tpu")' \
+    >/dev/null 2>&1
+}
+
+wait_tunnel() {
+  for i in $(seq 1 30); do
+    probe && { echo "tunnel up after probe $i ($(date))"; return 0; }
+    echo "probe $i failed ($(date)); sleeping 180s"
+    sleep 180
+  done
+  echo "tunnel still down after 30 probes"
+  return 1
+}
+
+echo "=== $(date) waiting for primary queue (tpu_queue_v3) to finish ==="
+for i in $(seq 1 2880); do  # up to ~48h of polling, zero TPU traffic
+  if grep -q "QUEUE V3 DONE" /tmp/tpu_queue_v3.log 2>/dev/null; then
+    echo "primary queue done ($(date))"
+    break
+  fi
+  sleep 60
+done
+grep -q "QUEUE V3 DONE" /tmp/tpu_queue_v3.log 2>/dev/null || {
+  echo "primary queue never finished; exiting"; exit 1; }
+
+echo "=== $(date) 1/1 conv-trunk e2e JPEG on TPU ==="
+# 4 CLI invocations (train/resume/extract/eval) behind a tunnel where
+# first compiles take minutes: budget well past the script's own
+# per-subprocess 3600s so the outer timeout can't kill it mid-train.
+rc=1
+wait_tunnel && { timeout 7200 env E2E_JAX_PLATFORM=default \
+  python scripts/e2e_real_jpeg.py \
+  --model googlenet_bn --steps 600 --workdir /tmp/e2e_conv_tpu \
+  --artifact accuracy/e2e_real_jpeg_googlenet_bn_tpu.json; rc=$?; }
+echo "conv e2e tpu rc=$rc"
+
+if [ "$rc" = 0 ] && [ -f accuracy/e2e_real_jpeg_googlenet_bn_tpu.json ]; then
+  echo "=== $(date) R5 EXTRAS DONE ==="
+else
+  echo "=== $(date) R5 EXTRAS FAILED (rc=$rc; artifact $( [ -f accuracy/e2e_real_jpeg_googlenet_bn_tpu.json ] && echo present || echo MISSING )) ==="
+fi
