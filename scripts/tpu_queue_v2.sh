@@ -37,7 +37,9 @@ timeout 3600 python scripts/profile_flagship.py --steps 10
 echo "profile rc=$?"
 
 echo "=== $(date) 2/6 bench.py full ==="
-timeout 3000 python bench.py > /tmp/bench_out.json
+# Budget > bench.py's worst case (~3270s: probes 270 + full
+# 2400 + smoke fallbacks 600) — see tpu_queue_v3.sh.
+timeout 4200 python bench.py > /tmp/bench_out.json
 echo "bench rc=$?"
 tail -c 1000 /tmp/bench_out.json
 
