@@ -11,7 +11,7 @@ import json
 import os
 import sys
 
-ROUND = 4
+ROUND = 5
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
