@@ -57,3 +57,64 @@ def test_zero_shot_split(tmp_path):
     te_labels = {int(l.split()[-1]) for l in te.read_text().splitlines()}
     assert tr_labels == {0, 1, 2, 3}
     assert te_labels == {4, 5}
+
+
+def test_e2e_structural_dataset_signal_is_shape_not_color(tmp_path):
+    """The conv-trunk e2e proof (accuracy/e2e_real_jpeg_googlenet_bn.json)
+    rests on make_dataset_structural's contract: identity must live in
+    the SPATIAL mask, not color statistics — otherwise a random conv
+    init nearly solves the task and the rising zero-shot curve is
+    vacuous (measured: 0.875 first-test R@1 on the color-blob set).
+
+    Pinned here: (a) per-image mean color carries ~no class signal
+    (between-class variance of per-class mean colors is small vs the
+    within-class instance variance), and (b) binarized spatial masks
+    agree within a class and differ across classes."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "e2e_real_jpeg", os.path.join(REPO, "scripts", "e2e_real_jpeg.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from PIL import Image
+
+    root = str(tmp_path / "imgs")
+    mod.make_dataset_structural(root, np.random.default_rng(7))
+
+    means = {}   # class -> [per-image mean color]
+    masks = {}   # class -> [binarized luminance mask, roll-normalized]
+    for cid in range(4):
+        cdir = os.path.join(root, f"id_{cid:03d}")
+        means[cid], masks[cid] = [], []
+        for fn in sorted(os.listdir(cdir))[:4]:
+            a = np.asarray(Image.open(os.path.join(cdir, fn)), np.float64)
+            means[cid].append(a.mean(axis=(0, 1)))
+            lum = a.mean(axis=2)
+            m = (lum > np.median(lum)).astype(np.float64)
+            masks[cid].append(m)
+
+    # (a) color: between-class spread of class-mean colors must be small
+    # relative to within-class spread (colors are re-drawn per instance).
+    class_means = np.array([np.mean(means[c], axis=0) for c in means])
+    between = class_means.std(axis=0).mean()
+    within = np.mean([np.std(means[c], axis=0).mean() for c in means])
+    assert between < within, (between, within)
+
+    # (b) shape: the binary mask is the class signal.  Each instance is
+    # rolled independently by +/-8px, so the RELATIVE offset between
+    # two instances spans +/-16px — search that full window.
+    def best_iou(a, b):
+        best = 0.0
+        for dy in range(-16, 17, 2):
+            for dx in range(-16, 17, 2):
+                bb = np.roll(b, (dy, dx), axis=(0, 1))
+                inter = (a * bb).sum()
+                union = ((a + bb) > 0).sum()
+                best = max(best, inter / union)
+        return best
+
+    same = np.mean([best_iou(masks[c][0], masks[c][1]) for c in masks])
+    cross = np.mean([best_iou(masks[a][0], masks[b][0])
+                     for a in masks for b in masks if a < b])
+    assert same > cross + 0.1, (same, cross)
