@@ -37,6 +37,15 @@ driver retries cheap.  MFU comes from XLA's own per-step FLOPs estimate
 measurements (dense vs Pallas-blockwise loss at pool 4096) ride in the
 "extras" field of the same single line.
 
+Wedge containment (2026-08-01: the blockwise_flagship_radix compile
+wedged the tunnel mid-extras, which would have discarded the already-
+measured headline): the full child spills its partial record to
+/tmp/bench_spill.json after the headline and after every extras row,
+marking which row is in flight; if the child dies, the parent salvages
+the spill as a "salvaged": true full record and quarantines the
+in-flight row in bench_cache/quarantine.json (committed) so later runs
+skip it instead of re-wedging the tunnel.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
@@ -48,8 +57,12 @@ import sys
 import time
 
 BASELINE_EMBEDDINGS_PER_SEC = 400.0
-BATCH = 120
-IMAGE = 224
+# Geometry env overrides exist so the full-child orchestration (spill /
+# salvage / quarantine) can be driven end-to-end on CPU at toy scale;
+# driver runs never set them, so recorded artifacts use the reference
+# geometry (batch 120 @ 224, def.prototxt:21-27).
+BATCH = int(os.environ.get("BENCH_BATCH", 120))
+IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".jax_cache")
 # Committed last-known-good hardware payload (refreshed on every
@@ -59,6 +72,19 @@ CACHE_DIR = os.path.join(REPO, ".jax_cache")
 LAST_GOOD_PATH = os.path.join(REPO, "bench_cache", "last_good.json")
 METRIC = "googlenet_npair_train_embeddings_per_sec_per_chip"
 UNIT = "embeddings/sec/chip"
+# Partial-record spill: written by the full child after the headline and
+# after every extras row so a mid-extras tunnel wedge cannot discard
+# what was already measured (parent salvages it on child death).  The
+# parent pins a pid-scoped path into the child's environment so
+# concurrent bench runs on one machine cannot clobber or cross-salvage
+# each other's spills.
+SPILL_PATH = os.environ.get(
+    "BENCH_SPILL_PATH", f"/tmp/bench_spill.{os.getuid()}.json"
+)
+# Rows observed in flight when a child wedged the tunnel.  Committed so
+# the driver's fresh round-end run skips them too — one lost row beats a
+# voided round.  Clear an entry manually to re-try the row.
+QUARANTINE_PATH = os.path.join(REPO, "bench_cache", "quarantine.json")
 
 # Peak-FLOP/s table and cost analysis live in utils.profiling
 # (peak_flops / cost_flops) — one home, shared with the CLI `time`
@@ -238,18 +264,6 @@ def child_full(platform: str, steps: int, warmup: int,
     # records itself as skipped instead of overrunning (the row count
     # grew round 4: sim-cache on/off + s2d + remat).
     deadline = _T0 + 0.75 * soft_budget
-    extras = {}
-    try:
-        extras = _engine_extras(jax, jnp, np, floor, deadline)
-    except Exception as e:
-        _log(f"engine extras failed: {e}")
-    try:
-        extras["batch_scaling"] = _batch_scaling_extras(
-            jax, jnp, np, dev, floor, deadline
-        )
-    except Exception as e:
-        _log(f"batch scaling extras failed: {e}")
-
     record = {
         "metric": "googlenet_npair_train_embeddings_per_sec_per_chip",
         "value": round(emb_per_sec, 2),
@@ -259,18 +273,46 @@ def child_full(platform: str, steps: int, warmup: int,
         "device_kind": dev.device_kind,
         "ms_per_step": round(dt / steps * 1e3, 2),
         "mode": "full",
+        # Geometry is stamped so a BENCH_BATCH/BENCH_IMAGE toy run can
+        # never masquerade as a reference-geometry artifact (and
+        # _save_last_good refuses non-reference geometry outright).
+        "batch": BATCH,
+        "image": IMAGE,
     }
     if mfu is not None:
         record["mfu"] = round(mfu, 4)
     if step_flops is not None:
         record["step_flops"] = step_flops
-    if extras:
-        record["extras"] = extras
+    # The headline is now wedge-proof: every extras row below re-spills
+    # the record, so a mid-row tunnel wedge costs that row, not the run.
+    extras = {}
+    record["extras"] = extras
+
+    def flush(inflight=None):
+        _write_spill(record, inflight)
+
+    flush()
+    try:
+        _engine_extras(jax, jnp, np, floor, deadline, extras, flush)
+    except Exception as e:
+        _log(f"engine extras failed: {e}")
+    try:
+        rows = {}
+        extras["batch_scaling"] = rows
+        _batch_scaling_extras(jax, jnp, np, dev, floor, deadline, rows, flush)
+    except Exception as e:
+        _log(f"batch scaling extras failed: {e}")
+    flush()
+    if not extras.get("batch_scaling"):
+        extras.pop("batch_scaling", None)
+    if not extras:
+        del record["extras"]
     print(json.dumps(record))
     return 0
 
 
-def _engine_extras(jax, jnp, np, floor, deadline=None):
+def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
+                   flush=None):
     """Loss-engine comparison at a large self-pool: dense XLA graph vs the
     Pallas blockwise kernels (compiled by Mosaic when on TPU — this is the
     on-hardware validation of ops/pallas_npair.py) vs the ring engine on a
@@ -308,7 +350,11 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
         an_mining_method=MiningMethod.HARD,
         an_mining_region=MiningRegion.LOCAL,
     )
-    extras = {"pool": n, "steps": steps}
+    if extras is None:
+        extras = {}
+    if flush is None:
+        flush = lambda inflight=None: None  # noqa: E731
+    extras.update({"pool": n, "steps": steps})
 
     def bench_one(name, loss_fn):
         """loss_fn(features, labels) -> scalar loss; timed fwd+bwd."""
@@ -332,12 +378,21 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
             _log(f"extras: skipping {name} (soft time budget reached)")
             extras[name] = {"skipped": "soft time budget reached"}
             return None
+        q = _quarantined(name)
+        if q:
+            _log(f"extras: skipping {name} (quarantined: {q})")
+            extras[name] = {"skipped": f"quarantined: {q}"}
+            return None
         _log(f"extras: compiling {name}...")
+        flush(name)
         try:
-            return _bench_one_timed(name, many)
+            result = _bench_one_timed(name, many)
+            flush()
+            return result
         except Exception as e:  # one engine failing must not void the rest
             _log(f"extras: {name} FAILED: {e}")
             extras[name] = {"error": str(e)[:300]}
+            flush()
             return None
 
     def _bench_one_timed(name, many):
@@ -382,10 +437,20 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
         for i in range(3):
             float(np.asarray(many(sf * (1.0 + i * 1e-3))))
 
-    try:
-        _sacrifice()
-    except Exception as e:
-        _log(f"extras: sacrificial warmup failed (continuing): {e}")
+    # The sacrifice dispatches real device work, so it gets the same
+    # inflight/quarantine containment as a row: if it ever wedges the
+    # tunnel, later runs skip it (first timed row then absorbs the ~40
+    # ms/step phantom cost — priced, not silent) instead of re-wedging.
+    q = _quarantined("warmup_sacrifice")
+    if q:
+        _log(f"extras: skipping sacrificial warmup (quarantined: {q})")
+    else:
+        flush("warmup_sacrifice")
+        try:
+            _sacrifice()
+        except Exception as e:
+            _log(f"extras: sacrificial warmup failed (continuing): {e}")
+        flush()
 
     mesh = data_parallel_mesh(jax.devices()[:1])
 
@@ -480,14 +545,18 @@ def _engine_extras(jax, jnp, np, floor, deadline=None):
     return extras
 
 
-def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None):
+def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None,
+                          rows=None, flush=None):
     """Flagship solver throughput at batch 120/240/480 — does a bigger
     per-chip batch lift emb/s/chip (VERDICT r2 item 4)?  Plus the
     space-to-depth stem variant at batch 120: parity-preserving rewrite
     of the K=147/C_in=3 conv1 (models/layers.conv1_kernel_to_s2d), the
     claimed ~28%-of-FLOPs MXU-underutilization fix (VERDICT r3 item 4) —
     recording it here makes the s2d MFU a driver artifact."""
-    rows = {}
+    if rows is None:
+        rows = {}
+    if flush is None:
+        flush = lambda inflight=None: None  # noqa: E731
     # Ordered by importance: the soft deadline may skip later rows.
     # The parity-preserving MXU rewrites (s2d stem, fused inception
     # 1x1s, both = "mxu") and the remat row answer PROFILE.md's open
@@ -514,6 +583,12 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None):
             _log(f"batch scaling: skipping {key} (soft time budget reached)")
             rows[key] = {"skipped": "soft time budget reached"}
             continue
+        q = _quarantined(key)
+        if q:
+            _log(f"batch scaling: skipping {key} (quarantined: {q})")
+            rows[key] = {"skipped": f"quarantined: {q}"}
+            continue
+        flush(f"batch_scaling/{key}")
         try:
             _batch_scaling_row(
                 jax, jnp, np, dev, floor, rows, batch, model_name, key,
@@ -522,6 +597,7 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None):
         except Exception as e:  # e.g. ViT-256 OOM: record, don't void
             _log(f"batch scaling: {key} FAILED: {e}")
             rows[key] = {"error": str(e)[:300]}
+        flush()
     return rows
 
 
@@ -654,6 +730,140 @@ def _run_child(child_args, timeout: float):
     return _run_child_ex(child_args, timeout)[0]
 
 
+# A row must be in flight at least this long before its death reads as
+# "wedged the backend" rather than "parent budget ran out mid-row": the
+# soft deadline leaves rows up to 25% of the full budget (600 s at the
+# default 2400 s) to finish before the parent's hard kill, and no
+# legitimate row has taken 15 minutes once the headline is compiled —
+# the 2026-08-01 radix wedge sat for 37+ minutes.  Only wedge-shaped
+# deaths quarantine the row; budget-shaped deaths just record it.
+QUARANTINE_MIN_INFLIGHT_SECS = 900.0
+
+
+def _write_spill(record, inflight) -> None:
+    """Child side: persist the partial full-bench record atomically."""
+    try:
+        tmp = SPILL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "inflight": inflight,
+                    "inflight_since": time.time() if inflight else None,
+                    "record": record,
+                },
+                f,
+            )
+        os.replace(tmp, SPILL_PATH)
+    except Exception:  # spilling is protection, never a failure source
+        pass
+
+
+def _clear_spill() -> None:
+    try:
+        os.unlink(SPILL_PATH)
+    except OSError:
+        pass
+
+
+# (path, dict) memo: ~19 extras rows each consult the quarantine; one
+# read per path suffices.  Keyed by path so tests that repoint
+# QUARANTINE_PATH get a fresh load; _quarantine_add mutates the cached
+# dict in place so parent-side additions stay visible.
+_QUAR_CACHE = None
+
+
+def _load_quarantine():
+    global _QUAR_CACHE
+    if _QUAR_CACHE is not None and _QUAR_CACHE[0] == QUARANTINE_PATH:
+        return _QUAR_CACHE[1]
+    try:
+        with open(QUARANTINE_PATH) as f:
+            q = json.load(f)
+    except Exception:
+        q = {}
+    _QUAR_CACHE = (QUARANTINE_PATH, q)
+    return q
+
+
+def _quarantined(name):
+    """Reason string if ``name`` wedged a previous run, else None."""
+    ent = _load_quarantine().get(name)
+    if ent:
+        return ent.get("note", "wedged a previous run")
+    return None
+
+
+def _quarantine_add(row: str, note: str) -> None:
+    import datetime
+
+    global _QUAR_CACHE
+    try:
+        # Fresh read (bypassing the memo) narrows the read-modify-write
+        # window against a concurrent run's addition; tmp+replace keeps
+        # the committed file parseable even if this process dies mid-dump
+        # (a truncated file would silently un-gate every entry).
+        _QUAR_CACHE = None
+        q = _load_quarantine()
+        q[row] = {"date": datetime.date.today().isoformat(), "note": note}
+        os.makedirs(os.path.dirname(QUARANTINE_PATH), exist_ok=True)
+        tmp = QUARANTINE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(q, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, QUARANTINE_PATH)
+        _log(f"quarantined row {row!r}: {note}")
+    except Exception as e:
+        _log(f"quarantine write failed: {e}")
+
+
+def _salvage_from_spill():
+    """Parent side, after a full-child death: recover the partial record.
+
+    Returns a full-mode record (flagged ``salvaged``) if the spill holds
+    a measured headline, else None.  The row in flight at death is
+    recorded in the output and quarantined for later runs."""
+    try:
+        with open(SPILL_PATH) as f:
+            sp = json.load(f)
+    except Exception:
+        return None
+    rec = sp.get("record") or {}
+    if not rec.get("value"):
+        return None
+    rec["salvaged"] = True
+    inflight = sp.get("inflight")
+    if inflight:
+        rec["wedged_row"] = inflight
+        # Batch rows spill as "batch_scaling/<key>" so the error lands
+        # in the namespace their consumers read; quarantine by bare key
+        # (that's what the batch loop checks).
+        home = rec.setdefault("extras", {})
+        row_key = inflight
+        if "/" in inflight:
+            ns, row_key = inflight.split("/", 1)
+            home = home.setdefault(ns, {})
+        home.setdefault(
+            row_key, {"error": "in flight when the child died (wedge?)"}
+        )
+        since = sp.get("inflight_since")
+        stuck = (time.time() - since) if since else None
+        if stuck is not None and stuck >= QUARANTINE_MIN_INFLIGHT_SECS:
+            _quarantine_add(
+                row_key,
+                f"in flight {stuck / 60:.0f} min when the full bench "
+                "child died (wedge-shaped) — skipped to protect later "
+                "runs; clear this entry to re-try",
+            )
+        else:
+            _log(
+                f"row {row_key!r} was in flight only "
+                f"{0 if stuck is None else stuck:.0f}s at child death — "
+                "budget-shaped, not quarantining"
+            )
+    _log(f"salvaged partial full record from spill (inflight={inflight})")
+    return rec
+
+
 def _load_last_good():
     try:
         with open(LAST_GOOD_PATH) as f:
@@ -669,12 +879,33 @@ def _save_last_good(rec) -> None:
     a machine-readable hardware number to report (flagged stale)."""
     import datetime
 
+    today = datetime.date.today().isoformat()
+    if rec.get("batch", 120) != 120 or rec.get("image", 224) != 224:
+        _log(
+            "last-good cache NOT refreshed: non-reference geometry "
+            f"(batch {rec.get('batch')} @ {rec.get('image')})"
+        )
+        return
+    if rec.get("salvaged"):
+        # A salvaged partial must not clobber a complete payload captured
+        # the same day (e.g. an earlier successful run this round); it
+        # SHOULD replace anything older — a fresh headline beats a stale
+        # complete record.
+        lg = _load_last_good()
+        if (
+            lg
+            and not (lg.get("payload") or {}).get("salvaged")
+            and lg.get("date") == today
+        ):
+            _log("last-good cache kept: same-day complete payload beats "
+                 "this salvaged partial")
+            return
     try:
         os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
         with open(LAST_GOOD_PATH, "w") as f:
             json.dump(
                 {
-                    "date": datetime.date.today().isoformat(),
+                    "date": today,
                     "provenance": "bench.py full run (fetch-synced timing)",
                     "payload": rec,
                 },
@@ -812,8 +1043,20 @@ def main(argv=None) -> int:
         ["--child", "smoke", "--platform", "cpu"], args.smoke_timeout,
     ))
 
+    # Pin a pid-scoped spill path into the children's environment so
+    # concurrent bench runs cannot clobber or cross-salvage spills.
+    global SPILL_PATH
+    if "BENCH_SPILL_PATH" not in os.environ:
+        SPILL_PATH = f"/tmp/bench_spill.{os.getpid()}.json"
+        os.environ["BENCH_SPILL_PATH"] = SPILL_PATH
+    _clear_spill()
     for child_args, timeout in attempts:
         rec = _run_child(child_args, timeout)
+        if rec is None and "full" in child_args:
+            # The full child died mid-run (tunnel wedge / OOM / kill):
+            # salvage whatever it spilled — headline + completed extras
+            # beat falling through to a stale degraded record.
+            rec = _salvage_from_spill()
         if rec is not None:
             if rec.get("mode") == "full" and "error" not in rec:
                 # A completed full bench is never "degraded" — but only a
@@ -833,6 +1076,7 @@ def main(argv=None) -> int:
                 lg = _load_last_good()
                 if lg is not None:
                     rec["last_good"] = lg
+            _clear_spill()  # consumed (or superseded) — don't litter /tmp
             print(json.dumps(rec))
             return 0
 
@@ -841,6 +1085,7 @@ def main(argv=None) -> int:
         None,
     )
     rec["error"] = "all bench variants failed or timed out"
+    _clear_spill()
     print(json.dumps(rec))
     return 0
 

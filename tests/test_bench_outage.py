@@ -163,3 +163,96 @@ def test_split_derives_engine_and_carries_peaks():
     assert stretch["peak_bytes_in_use_cached"] == 6 << 30
     assert "flagship_nocache" in stretch["stretch"]
     json.dumps(pallas), json.dumps(stretch)
+
+
+def test_spill_salvage_roundtrip(bench, monkeypatch, tmp_path):
+    """A full child killed mid-extras must be salvageable: headline +
+    completed rows survive, the wedge-shaped in-flight row is
+    quarantined (the 2026-08-01 blockwise_flagship_radix tunnel wedge)."""
+    monkeypatch.setattr(bench, "SPILL_PATH", str(tmp_path / "spill.json"))
+    monkeypatch.setattr(bench, "QUARANTINE_PATH", str(tmp_path / "q.json"))
+    monkeypatch.setattr(bench, "QUARANTINE_MIN_INFLIGHT_SECS", 0.0)
+    assert bench._salvage_from_spill() is None  # no spill -> no salvage
+    rec = {"value": 4000.0, "mode": "full", "platform": "tpu",
+           "extras": {"dense_abs": {"ms_per_step": 60.0}}}
+    bench._write_spill(rec, "wedging_row")
+    out = bench._salvage_from_spill()
+    assert out["salvaged"] is True and out["wedged_row"] == "wedging_row"
+    assert out["extras"]["dense_abs"] == {"ms_per_step": 60.0}
+    assert "error" in out["extras"]["wedging_row"]
+    # the wedged row is quarantined for every later run
+    assert bench._quarantined("wedging_row")
+    json.dumps(out)
+    # a headline-less spill (wedge during warmup) salvages nothing
+    bench._write_spill({"mode": "full"}, "early_row")
+    assert bench._salvage_from_spill() is None
+    bench._clear_spill()
+    assert bench._salvage_from_spill() is None
+
+
+def test_budget_shaped_death_does_not_quarantine(bench, monkeypatch,
+                                                 tmp_path):
+    """A row killed shortly after starting (parent budget ran out, OOM
+    kill, Ctrl-C) is recorded but NOT quarantined — only wedge-shaped
+    deaths (in flight >= QUARANTINE_MIN_INFLIGHT_SECS) lose the row
+    permanently."""
+    monkeypatch.setattr(bench, "SPILL_PATH", str(tmp_path / "spill.json"))
+    monkeypatch.setattr(bench, "QUARANTINE_PATH", str(tmp_path / "q.json"))
+    rec = {"value": 4000.0, "mode": "full", "platform": "tpu"}
+    bench._write_spill(rec, "slow_row")  # inflight_since = now
+    out = bench._salvage_from_spill()
+    assert out["wedged_row"] == "slow_row"
+    assert "error" in out["extras"]["slow_row"]
+    assert bench._quarantined("slow_row") is None  # not wedge-shaped
+
+
+def test_salvage_namespaces_batch_rows(bench, monkeypatch, tmp_path):
+    """A wedge during a batch-scaling row lands the error inside
+    extras['batch_scaling'] (where its consumers read), quarantined by
+    bare key."""
+    monkeypatch.setattr(bench, "SPILL_PATH", str(tmp_path / "spill.json"))
+    monkeypatch.setattr(bench, "QUARANTINE_PATH", str(tmp_path / "q.json"))
+    monkeypatch.setattr(bench, "QUARANTINE_MIN_INFLIGHT_SECS", 0.0)
+    rec = {"value": 4000.0, "mode": "full", "platform": "tpu",
+           "extras": {"batch_scaling": {"120": {"ms_per_step": 29.0}}}}
+    bench._write_spill(rec, "batch_scaling/240")
+    out = bench._salvage_from_spill()
+    assert "error" in out["extras"]["batch_scaling"]["240"]
+    assert out["extras"]["batch_scaling"]["120"] == {"ms_per_step": 29.0}
+    assert "240" not in out["extras"]  # not polluting the top namespace
+    assert bench._quarantined("240")
+
+
+def test_salvaged_partial_never_clobbers_same_day_complete(
+        bench, monkeypatch, tmp_path):
+    """_save_last_good: a salvaged partial must not replace a complete
+    payload captured the same day, but must replace older payloads."""
+    import datetime
+    lg = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(lg))
+    today = datetime.date.today().isoformat()
+    complete = {"value": 4000.0, "mode": "full", "platform": "tpu"}
+    bench._save_last_good(complete)
+    assert json.load(open(lg))["payload"] == complete
+    # same-day salvaged partial: kept out
+    bench._save_last_good({"value": 1.0, "mode": "full", "salvaged": True})
+    assert json.load(open(lg))["payload"] == complete
+    # older complete payload: a fresh salvaged partial replaces it
+    stale = {"date": "2026-07-01", "payload": complete}
+    lg.write_text(json.dumps(stale))
+    salv = {"value": 2.0, "mode": "full", "salvaged": True}
+    bench._save_last_good(salv)
+    assert json.load(open(lg))["payload"] == salv
+    assert json.load(open(lg))["date"] == today
+
+
+def test_committed_quarantine_parses_and_gates(bench):
+    """bench_cache/quarantine.json must always parse to {row: {note}}
+    and every committed entry must gate its row.  (No specific row is
+    pinned: the documented workflow is to clear entries to re-try.)"""
+    q = bench._load_quarantine()
+    assert isinstance(q, dict)
+    for row, ent in q.items():
+        assert isinstance(ent, dict) and ent.get("note")
+        assert bench._quarantined(row)
+    assert bench._quarantined("definitely_not_a_row") is None
