@@ -40,11 +40,11 @@ echo "=== $(date) waiting for tunnel ==="
 wait_tunnel || { echo "GAVE UP"; exit 1; }
 
 echo "=== $(date) 1/6 bench.py full ==="
-# Budget > bench's own worst case (~3270s: probe phase up to 270s
+# Budget > bench's own worst case (~3870s: probe phase up to 270s
 # [120 + 30 retry-wait + 120] plus a 90s CPU probe on the degraded
-# path, full child 2400s, two smoke fallbacks 600s) so the outer
-# timeout can never kill it mid-fallback and lose the degraded JSON
-# (bench.py --full-timeout grew with the round-5 row count).
+# path, full child 3000s [two timed windows per row since the 08:04
+# jitter finding], two smoke fallbacks 600s) so the outer timeout can
+# never kill it mid-fallback and lose the degraded JSON.
 timeout 4200 python bench.py > /tmp/bench_out.json
 echo "bench rc=$?"
 tail -c 1000 /tmp/bench_out.json
