@@ -336,12 +336,20 @@ def run_inline(args):
         float(np.asarray(acc))
         acc, _ = many(carry0, x, jnp.float32(next_timing_salt()))
         float(np.asarray(acc))
-        t0 = time.perf_counter()
-        acc, loss = many(carry0, x, jnp.float32(next_timing_salt()))
-        float(np.asarray(acc))
-        dt = max(time.perf_counter() - t0 - floor, 1e-9) / steps
+        # Two timed windows, min published with both recorded: tunnel
+        # latency jitter is one-sided (bench.py's 08:04 UTC 2026-08-01
+        # dense_abs anomaly) and these rows decide the flagship trunk.
+        dts = []
+        for _ in range(2):
+            salt = jnp.float32(next_timing_salt())
+            t0 = time.perf_counter()
+            acc, loss = many(carry0, x, salt)
+            float(np.asarray(acc))
+            dts.append(max(time.perf_counter() - t0 - floor, 1e-9) / steps)
+        dt = min(dts)
         results[name] = {
             "ms_per_step": round(dt * 1e3, 2),
+            "ms_per_step_windows": [round(d * 1e3, 2) for d in dts],
             "emb_per_sec": round(batch / dt, 1),
         }
         print(f"[profile] {name}: {dt * 1e3:.2f} ms/step",
