@@ -256,3 +256,30 @@ def test_committed_quarantine_parses_and_gates(bench):
         assert isinstance(ent, dict) and ent.get("note")
         assert bench._quarantined(row)
     assert bench._quarantined("definitely_not_a_row") is None
+
+
+def test_measure_windows_min_and_deadline(bench):
+    """_measure returns every timed window (min is published), skips
+    windows past the deadline, and keeps warmup outside the windows."""
+    calls = {"step": 0, "fetch": 0}
+
+    def step():
+        calls["step"] += 1
+        return calls["step"]
+
+    def fetch(_):
+        calls["fetch"] += 1
+
+    dts = bench._measure(step, [], warmup=2, steps=3, fetch=fetch,
+                         floor=0.0, repeats=2)
+    assert len(dts) == 2 and all(d > 0 for d in dts)
+    # 2 warmup calls + 2 windows x 3 steps
+    assert calls["step"] == 2 + 6
+    # one sync fetch per warmup call and per window
+    assert calls["fetch"] == 2 + 2
+    # an already-expired deadline still times the FIRST window (a row
+    # started is a row finished) but skips the second
+    calls["step"] = calls["fetch"] = 0
+    dts = bench._measure(step, [], warmup=0, steps=3, fetch=fetch,
+                         floor=0.0, repeats=2, deadline=0.0)
+    assert len(dts) == 1 and calls["step"] == 3
