@@ -39,10 +39,13 @@ IMAGE = 224
 
 
 # Ordered by evidence value: if the tunnel dies mid-run, the variants
-# that anchor the attribution story have already been captured.
+# that anchor the attribution story have already been captured.  bn runs
+# LAST: its dispatch started the round-4 tunnel wedge, and a re-wedge
+# must not cost the LRN-pricing rows (no_lrn/fp32) that decide the
+# flagship trunk (VERDICT r4 item 2).
 VARIANT_ORDER = [
     "full", "fwd_only", "fwd_bwd", "npair_only", "s2d", "fused", "mxu",
-    "remat", "bn", "no_lrn", "fp32",
+    "remat", "no_lrn", "fp32", "bn",
 ]
 
 ARTIFACT = os.path.join(REPO, "profile", "flagship.json")
@@ -205,11 +208,13 @@ def orchestrate(args) -> int:
         print(f"[profile/orchestrator] {msg}", file=sys.stderr, flush=True)
 
     pending = [n for n in VARIANT_ORDER
-               if "ms_per_step" not in payload["results"].get(n, {})]
+               if "ms_per_step" not in payload["results"].get(n, {})
+               and not payload["results"].get(n, {}).get("wedged")]
     log(f"pending variants: {pending or 'none'}")
+    gate_ok = False  # set when a just-run probe already said "up"
     for name in pending:
         deadline = time.monotonic() + args.recover_wait
-        while not _tpu_ready():
+        while not (gate_ok or _tpu_ready()):
             if time.monotonic() >= deadline:
                 log(f"tunnel did not recover within {args.recover_wait}s; "
                     f"stopping before {name}")
@@ -219,6 +224,7 @@ def orchestrate(args) -> int:
                 return 3
             log("tunnel not ready; sleeping 120s")
             time.sleep(120)
+        gate_ok = False  # one gate only; the next variant re-probes
         cmd = [
             sys.executable, os.path.abspath(__file__),
             "--variant", name, "--steps", str(args.steps),
@@ -241,17 +247,42 @@ def orchestrate(args) -> int:
             payload["results"].update(child["results"])
             log(f"{name}: {child['results'][name]}")
         except subprocess.TimeoutExpired:
-            payload["results"][name] = {
-                "error": f"timeout after {args.variant_timeout}s"}
-            log(f"{name}: TIMED OUT (likely tunnel wedge); artifact keeps "
-                "everything measured so far")
+            entry = {"error": f"timeout after {args.variant_timeout}s"}
+            # Discriminate wedge from slow-compile: if the tunnel no
+            # longer answers after the kill, this variant wedged it — a
+            # resumed run must NOT retry it (a deterministic wedge would
+            # otherwise re-wedge every supervisor attempt).  Three
+            # probes over ~2 min before the permanent marker: a single
+            # failed probe can be transient saturation or the killed
+            # child's dispatch still draining, and a false wedge mark
+            # bans a variant forever; a real wedge lasts hours.
+            for _ in range(3):
+                if _tpu_ready():
+                    gate_ok = True  # reuse: skip the next gate's probe
+                    break
+                time.sleep(45)
+            else:
+                entry["wedged"] = True
+                log(f"{name}: TIMED OUT and the tunnel stayed down "
+                    "(wedge shape); resume will skip this variant")
+            if not entry.get("wedged"):
+                log(f"{name}: TIMED OUT but the tunnel still answers "
+                    "(slow variant); resume may retry it")
+            payload["results"][name] = entry
         except Exception as e:
             payload["results"][name] = {"error": str(e)[:300]}
             log(f"{name}: FAILED: {e}")
         _write_artifacts(payload, artifact)
+    wedged = [n for n in VARIANT_ORDER
+              if payload["results"].get(n, {}).get("wedged")]
     missing = [n for n in VARIANT_ORDER
-               if "ms_per_step" not in payload["results"].get(n, {})]
-    log(f"done; missing: {missing or 'none'}")
+               if "ms_per_step" not in payload["results"].get(n, {})
+               and n not in wedged]
+    # Wedged variants are terminal (only a hand-edit un-bans them), so
+    # they must not keep the exit code at 4 — a supervisor keyed on
+    # rc!=0 would otherwise retry forever with no progress possible.
+    log(f"done; missing: {missing or 'none'}"
+        + (f"; permanently skipped (wedged): {wedged}" if wedged else ""))
     print(json.dumps(payload))
     return 0 if not missing else 4
 

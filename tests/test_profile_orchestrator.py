@@ -166,3 +166,82 @@ def test_orchestrator_tunnel_down_fails_structured(pf, tmp_path,
     assert rc == 3
     rec = json.loads(art.read_text())
     assert any("error" in v for v in rec["results"].values())
+
+
+def test_orchestrator_wedge_shaped_timeout_not_retried(pf, tmp_path,
+                                                       monkeypatch):
+    """A variant timeout with the tunnel dead right after the kill is
+    wedge-shaped: it is recorded with wedged=true and a resumed run must
+    NOT retry it (a deterministic wedge would otherwise re-wedge every
+    supervisor attempt — the round-4 googlenet_bn lesson).  A timeout
+    with the tunnel still answering stays retryable (covered by
+    test_orchestrator_resume_skips_completed)."""
+    state = {"down_probes": 0}
+
+    def fake_ready(timeout=100):
+        if state["down_probes"] > 0:
+            state["down_probes"] -= 1  # all handler re-probes fail...
+            return False
+        return True  # ...then the tunnel "recovers" for the next gate
+
+    def run(cmd, timeout=None, **kw):
+        name = cmd[cmd.index("--variant") + 1]
+        if name == "s2d":
+            state["down_probes"] = 3  # the kill leaves the tunnel dead
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        return _fake_run()(cmd, timeout=timeout, **kw)
+
+    monkeypatch.setattr(pf, "_tpu_ready", fake_ready)
+    monkeypatch.setattr(pf.time, "sleep", lambda s: None)
+    monkeypatch.setattr(subprocess, "run", run)
+    art = tmp_path / "p.json"
+    # Wedged variants are terminal, not retryable: rc reports "nothing
+    # retryable left" (0), so a rc!=0-keyed supervisor cannot spin.
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 0
+    rec = json.loads(art.read_text())
+    assert rec["results"]["s2d"]["wedged"] is True
+
+    # Resume: every OTHER variant is complete; the wedged one is skipped.
+    ran = []
+
+    def spy(cmd, **kw):
+        ran.append(cmd[cmd.index("--variant") + 1])
+        return _fake_run()(cmd, **kw)
+
+    monkeypatch.setattr(subprocess, "run", spy)
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 0
+    assert ran == []  # nothing pending: completed skipped, wedged skipped
+    assert json.loads(art.read_text())["results"]["s2d"]["wedged"] is True
+
+
+def test_orchestrator_transient_probe_failure_stays_retryable(
+        pf, tmp_path, monkeypatch):
+    """A timeout whose post-kill probe fails ONCE then answers is a slow
+    variant on a briefly-saturated tunnel, not a wedge — it must stay
+    retryable."""
+    state = {"down_probes": 0}
+
+    def fake_ready(timeout=100):
+        if state["down_probes"] > 0:
+            state["down_probes"] -= 1
+            return False
+        return True
+
+    def run(cmd, timeout=None, **kw):
+        name = cmd[cmd.index("--variant") + 1]
+        if name == "s2d":
+            state["down_probes"] = 1  # only the first re-probe fails
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        return _fake_run()(cmd, timeout=timeout, **kw)
+
+    monkeypatch.setattr(pf, "_tpu_ready", fake_ready)
+    monkeypatch.setattr(pf.time, "sleep", lambda s: None)
+    monkeypatch.setattr(subprocess, "run", run)
+    art = tmp_path / "p.json"
+    rc = pf.orchestrate(_args(pf, art))
+    assert rc == 4  # retryable work remains
+    rec = json.loads(art.read_text())
+    assert "wedged" not in rec["results"]["s2d"]
+    assert "error" in rec["results"]["s2d"]
