@@ -1,0 +1,122 @@
+"""Print "yes" if wedge-recoverable batch-scaling rows are missing.
+
+Consulted by scripts/tpu_queue_r5_extras.sh before AND after its bench
+re-pass: before to decide whether a re-pass is worth ~70 min of tunnel,
+after to decide whether the re-pass actually recovered the rows
+(bench.py exits 0 even when a row ends as an {'error'}/{'skipped'}
+record, so the exit code proves nothing about row coverage).
+
+Also seeds quarantine entries for the batch-480 rows when 480 is
+unmeasured: the 2026-08-02 16:05 UTC wedge happened during the plain
+480 compile, and bench.py only auto-quarantines a wedged row if the
+child died while it had been in flight >= 15 min — this makes the
+"the re-pass cannot re-wedge on 480" premise true by construction
+rather than hoping the salvage path wrote the entry.  480_remat is
+quarantined alongside it: the remat ablation is only interpretable
+against the plain-480 baseline row, and its equally-large first
+compile would put the higher-value ViT rows at wedge risk for an
+uninterpretable datapoint.
+
+Fail-open: any unexpected condition prints "yes" (the caller treats a
+crash/empty output as "yes" too).
+"""
+
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAST_GOOD = os.path.join(REPO, "bench_cache", "last_good.json")
+QUARANTINE = os.path.join(REPO, "bench_cache", "quarantine.json")
+
+# Rows worth a re-pass, by evidence value: the timed ViT-B/16 rows are
+# VERDICT r4 item 5 with no other coverage; s2d/fused price the MXU
+# stem/branch rewrites PROFILE.md argues from.
+WANT = ["vit_b16_128", "120_s2d", "120_fused", "vit_b16_256"]
+
+
+def _measured(row) -> bool:
+    return isinstance(row, dict) and "emb_per_sec" in row
+
+
+def main() -> None:
+    try:
+        rows = json.load(open(LAST_GOOD))["payload"]["extras"][
+            "batch_scaling"]
+        if not isinstance(rows, dict):
+            rows = {}
+    except Exception:
+        rows = {}  # no usable payload: every wanted row is missing
+    quarantine_ok = True
+    try:
+        quarantine = json.load(open(QUARANTINE))
+        assert isinstance(quarantine, dict)
+    except FileNotFoundError:
+        quarantine = {}
+    except Exception:
+        # Unparseable file: NEVER rewrite it (that would drop existing
+        # entries like the radix wedge row), and NEVER green-light a
+        # dispatch — bench.py's _load_quarantine also reads a corrupt
+        # file as {}, so a re-pass would dispatch the very rows the
+        # quarantine exists to block (the known tunnel-wedgers).
+        quarantine, quarantine_ok = {}, False
+
+    # Seed only on EVIDENCE of the incident: last_good's 480 row holds
+    # the error record bench.py wrote when the 2026-08-02 dispatch
+    # failed.  "480 merely unmeasured" must not seed — that would
+    # re-add entries an operator deliberately cleared for a retry, and
+    # would fire in fresh environments where 480 never wedged.
+    row_480 = rows.get("480")
+    evidence_480 = isinstance(row_480, dict) and "error" in row_480
+    changed = False
+    if quarantine_ok and evidence_480:
+        today = datetime.date.today().isoformat()
+        for key, why in (
+            ("480", "batch-480 first compile wedged the tunnel at "
+             "16:05 UTC 2026-08-02 (client killed mid-dispatch); seeded "
+             "by bench_rows_missing.py so a re-pass cannot re-wedge on "
+             "it even if the salvage-side auto-quarantine never fired"),
+            ("480_remat", "same-size batch-480 compile as the row that "
+             "wedged 2026-08-02, and the remat ablation is only "
+             "interpretable against the plain-480 baseline (also "
+             "quarantined) — not worth putting the ViT rows at risk"),
+        ):
+            if key not in quarantine:
+                quarantine[key] = {"date": today, "note": why}
+                changed = True
+    if changed:
+        try:
+            tmp = QUARANTINE + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(quarantine, f, indent=1)
+                f.write("\n")  # match bench.py _quarantine_add format
+            os.replace(tmp, QUARANTINE)
+        except Exception:
+            pass  # seeding is protection; never block the check
+
+    # --strict (the after-re-pass call): a wanted row only counts as
+    # covered if it was MEASURED.  Quarantine exclusion is correct for
+    # the before-call ("don't re-pass for a row bench.py will skip")
+    # but wrong as a success criterion — a re-pass that wedged and
+    # auto-quarantined a VERDICT row must not read as DONE.
+    strict = "--strict" in sys.argv[1:]
+    if not quarantine_ok and not strict:
+        # Before-call with no quarantine protection: do NOT dispatch.
+        print("no")
+        print("quarantine.json unparseable — refusing to green-light "
+              "a re-pass that could dispatch known tunnel-wedgers; "
+              "fix or delete the file first", file=sys.stderr)
+        return
+    missing = [
+        k for k in WANT
+        if not _measured(rows.get(k))
+        and (strict or k not in quarantine)
+    ]
+    print("yes" if missing else "no")
+    if missing:
+        print(f"missing rows: {missing}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -4,7 +4,13 @@
 # steal tunnel bandwidth from the primary evidence sweep — concurrent
 # dispatches pollute the timings (docs/DESIGN.md §6).
 #
-#   1. conv-trunk e2e JPEG proof ON THE CHIP (the TPU counterpart of
+#   1. bench re-pass IF the first pass lost batch-scaling rows to a
+#      wedge (2026-08-02: the batch-480 compile wedged the tunnel at
+#      16:05 UTC, costing the vit_b16/s2d/fused/remat rows; 480 is
+#      quarantined so the re-pass cannot re-wedge on it).  Runs FIRST:
+#      the timed ViT-B/16 row is a named VERDICT item with no other
+#      coverage, and the conv e2e below needs 2h of healthy tunnel.
+#   2. conv-trunk e2e JPEG proof ON THE CHIP (the TPU counterpart of
 #      accuracy/e2e_real_jpeg_googlenet_bn.json): native C++ loader +
 #      on-device augmentation + googlenet_bn + mined loss + snapshot/
 #      resume against the real backend.
@@ -41,7 +47,36 @@ done
 grep -q "QUEUE V3 DONE" /tmp/tpu_queue_v3.log 2>/dev/null || {
   echo "primary queue never finished; exiting"; exit 1; }
 
-echo "=== $(date) 1/1 conv-trunk e2e JPEG on TPU ==="
+echo "=== $(date) 1/2 bench re-pass for wedge-lost batch rows ==="
+# bench_rows_missing.py also seeds the 480/480_remat quarantine so the
+# re-pass cannot re-wedge on the compile that killed the first pass.
+need_repass=$(python scripts/bench_rows_missing.py)
+echo "bench re-pass needed: ${need_repass:-checker crashed (fail-open)}"
+if [ "$need_repass" != "no" ]; then  # fail-open: crash/empty => re-pass
+  # The re-pass's record wholesale-replaces last_good.json; keep the
+  # first pass's payload so rows it measured can never be lost to a
+  # worse re-pass (evidence prose can cite either, with provenance).
+  # -n: never clobber an existing backup on an operator re-run.
+  if [ -f bench_cache/last_good.json ]; then
+    cp -n bench_cache/last_good.json bench_cache/last_good_pass1.json
+    [ -f bench_cache/last_good_pass1.json ] \
+      || echo "WARNING: pass-1 backup failed; re-pass may clobber rows"
+  fi
+  if wait_tunnel; then
+    timeout 4200 python bench.py > /tmp/bench_out_repass.json
+    echo "bench re-pass rc=$?"
+    tail -c 600 /tmp/bench_out_repass.json 2>/dev/null; echo
+  fi
+fi
+# Coverage, not exit code or dispatch decisions, decides success: the
+# strict check runs UNCONDITIONALLY so DONE means every wanted row is
+# measured — not skipped, errored, quarantined, or given-up-on (a
+# wedge's auto-quarantine must not flip a later run to DONE).
+still=$(python scripts/bench_rows_missing.py --strict)
+echo "wanted rows still missing (strict): ${still:-unknown}"
+if [ "$still" = "no" ]; then repass_ok=1; else repass_ok=0; fi
+
+echo "=== $(date) 2/2 conv-trunk e2e JPEG on TPU ==="
 # 4 CLI invocations (train/resume/extract/eval) behind a tunnel where
 # first compiles take minutes: budget well past the script's own
 # per-subprocess 3600s so the outer timeout can't kill it mid-train.
@@ -52,8 +87,9 @@ wait_tunnel && { timeout 7200 env E2E_JAX_PLATFORM=default \
   --artifact accuracy/e2e_real_jpeg_googlenet_bn_tpu.json; rc=$?; }
 echo "conv e2e tpu rc=$rc"
 
-if [ "$rc" = 0 ] && [ -f accuracy/e2e_real_jpeg_googlenet_bn_tpu.json ]; then
+if [ "$rc" = 0 ] && [ "$repass_ok" = 1 ] \
+  && [ -f accuracy/e2e_real_jpeg_googlenet_bn_tpu.json ]; then
   echo "=== $(date) R5 EXTRAS DONE ==="
 else
-  echo "=== $(date) R5 EXTRAS FAILED (rc=$rc; artifact $( [ -f accuracy/e2e_real_jpeg_googlenet_bn_tpu.json ] && echo present || echo MISSING )) ==="
+  echo "=== $(date) R5 EXTRAS FAILED (e2e rc=$rc; repass_ok=$repass_ok; artifact $( [ -f accuracy/e2e_real_jpeg_googlenet_bn_tpu.json ] && echo present || echo MISSING )) ==="
 fi

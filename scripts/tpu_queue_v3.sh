@@ -40,41 +40,113 @@ echo "=== $(date) waiting for tunnel ==="
 wait_tunnel || { echo "GAVE UP"; exit 1; }
 
 echo "=== $(date) 1/6 bench.py full ==="
-# Budget > bench's own worst case (~3870s: probe phase up to 270s
-# [120 + 30 retry-wait + 120] plus a 90s CPU probe on the degraded
-# path, full child 3000s [two timed windows per row since the 08:04
-# jitter finding], two smoke fallbacks 600s) so the outer timeout can
-# never kill it mid-fallback and lose the degraded JSON.
-timeout 4200 python bench.py > /tmp/bench_out.json
-echo "bench rc=$?"
-tail -c 1000 /tmp/bench_out.json
+# A fresh same-day measured headline in last_good means a re-run would
+# spend ~50 min of tunnel re-measuring what we already captured —
+# while the profile re-measure (the round's #1 evidence item) starves.
+# Skip and let tpu_queue_r5_extras' coverage-gated re-pass pick up any
+# batch rows this pass lost (it runs after this queue completes).
+bench_fresh=$(python - <<'EOF'
+import datetime, json
+try:
+    d = json.load(open("bench_cache/last_good.json"))
+    fresh = (d.get("date") == datetime.date.today().isoformat()
+             and d.get("payload", {}).get("value", 0) > 0
+             and d["payload"].get("platform") == "tpu")
+    print("yes" if fresh else "no")
+except Exception:
+    print("no")
+EOF
+)
+if [ "$bench_fresh" = "yes" ]; then
+  echo "bench SKIPPED: last_good already holds a same-day measured TPU headline"
+else
+  # Budget > bench's own worst case (~3870s: probe phase up to 270s
+  # [120 + 30 retry-wait + 120] plus a 90s CPU probe on the degraded
+  # path, full child 3000s [two timed windows per row since the 08:04
+  # jitter finding], two smoke fallbacks 600s) so the outer timeout can
+  # never kill it mid-fallback and lose the degraded JSON.
+  timeout 4200 python bench.py > /tmp/bench_out.json
+  echo "bench rc=$?"
+  tail -c 1000 /tmp/bench_out.json
+fi
 
+# From here on, a wait_tunnel failure ABORTS the pass (supervisor
+# restarts us) instead of falling through: the old && gating let a
+# dead-tunnel pass crawl through every step's 1.6h probe budget and
+# still print DONE, which stops the supervisor for good with nothing
+# measured.
 echo "=== $(date) 2/6 profile orchestrator (resumable, per-variant) ==="
-wait_tunnel && timeout 4200 python scripts/profile_flagship.py --steps 10
-echo "profile rc=$?"
+wait_tunnel || { echo "GAVE UP (step 2)"; exit 1; }
+timeout 4200 python scripts/profile_flagship.py --steps 10
+profile_rc=$?
+echo "profile rc=$profile_rc"
 
+# Steps 3-6 leave a success sentinel so a supervisor restart (the
+# abort-on-outage semantics above) retries only what hasn't finished,
+# instead of re-burning ~2h of tunnel on already-captured artifacts.
+# Sentinels live in /tmp: a container restart clears them, which only
+# costs a re-measure, never correctness.
 echo "=== $(date) 3/6 tpu_pallas_check (parity + stretch, cached@16k) ==="
-wait_tunnel && timeout 3300 python scripts/tpu_pallas_check.py --pool 4096 \
-  --stretch 32768 --stretch-cached 16384 > /tmp/tpu_check_out.json
-rc=$?
-echo "tpu_pallas_check rc=$rc"
-tail -c 2000 /tmp/tpu_check_out.json
-if [ "$rc" = 0 ]; then python scripts/split_pallas_check.py; fi
+if [ -f /tmp/tpu_q_step3.done ]; then
+  echo "step 3 SKIPPED: done sentinel present"
+else
+  wait_tunnel || { echo "GAVE UP (step 3)"; exit 1; }
+  timeout 3300 python scripts/tpu_pallas_check.py --pool 4096 \
+    --stretch 32768 --stretch-cached 16384 > /tmp/tpu_check_out.json
+  rc=$?
+  echo "tpu_pallas_check rc=$rc"
+  tail -c 2000 /tmp/tpu_check_out.json
+  if [ "$rc" = 0 ]; then
+    python scripts/split_pallas_check.py && touch /tmp/tpu_q_step3.done
+  fi
+fi
 
 echo "=== $(date) 4/6 TPU accuracy smoke (e2e real-JPEG on the chip) ==="
-wait_tunnel && timeout 2400 env E2E_JAX_PLATFORM=default \
-  python scripts/e2e_real_jpeg.py \
-  --steps 200 --workdir /tmp/e2e_jpeg_tpu2 \
-  --artifact accuracy/e2e_real_jpeg_tpu.json
-echo "e2e tpu rc=$?"
+if [ -f /tmp/tpu_q_step4.done ] || [ -f accuracy/e2e_real_jpeg_tpu.json ]
+then
+  echo "step 4 SKIPPED: artifact or sentinel present"
+else
+  wait_tunnel || { echo "GAVE UP (step 4)"; exit 1; }
+  timeout 2400 env E2E_JAX_PLATFORM=default \
+    python scripts/e2e_real_jpeg.py \
+    --steps 200 --workdir /tmp/e2e_jpeg_tpu2 \
+    --artifact accuracy/e2e_real_jpeg_tpu.json
+  rc=$?
+  echo "e2e tpu rc=$rc"
+  [ "$rc" = 0 ] && touch /tmp/tpu_q_step4.done
+fi
 
 echo "=== $(date) 5/6 diag_sim_cache 8192,16384 (safe pools) ==="
-wait_tunnel && timeout 1800 python scripts/diag_sim_cache.py \
-  --pools 8192,16384
-echo "diag safe rc=$?"
+if [ -f /tmp/tpu_q_step5.done ]; then
+  echo "step 5 SKIPPED: done sentinel present"
+else
+  wait_tunnel || { echo "GAVE UP (step 5)"; exit 1; }
+  timeout 1800 python scripts/diag_sim_cache.py \
+    --pools 8192,16384
+  rc=$?
+  echo "diag safe rc=$rc"
+  [ "$rc" = 0 ] && touch /tmp/tpu_q_step5.done
+fi
 
 echo "=== $(date) 6/6 diag_sim_cache 24576 (WEDGE-RISK, runs last) ==="
-wait_tunnel && timeout 1200 python scripts/diag_sim_cache.py --pools 24576
-echo "diag 24576 rc=$?"
+if [ -f /tmp/tpu_q_step6.done ]; then
+  echo "step 6 SKIPPED: done sentinel present"
+else
+  wait_tunnel || { echo "GAVE UP (step 6)"; exit 1; }
+  timeout 1200 python scripts/diag_sim_cache.py --pools 24576
+  rc=$?
+  echo "diag 24576 rc=$rc"
+  [ "$rc" = 0 ] && touch /tmp/tpu_q_step6.done
+fi
 
-echo "=== $(date) QUEUE V3 DONE ==="
+# DONE only when the profile re-measure — the round's #1 evidence item
+# — is complete (rc 0 = every variant measured or terminally wedged).
+# rc 4 means retryable variants remain: exit nonzero so the supervisor
+# relaunches us; bench's freshness skip and steps 3-6's sentinels make
+# the relaunch go straight back to the profile.
+if [ "${profile_rc:-1}" = 0 ]; then
+  echo "=== $(date) QUEUE V3 DONE ==="
+else
+  echo "=== $(date) QUEUE V3 PASS COMPLETE but profile incomplete (rc=${profile_rc:-unset}); supervisor will relaunch ==="
+  exit 1
+fi
