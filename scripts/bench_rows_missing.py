@@ -17,8 +17,13 @@ against the plain-480 baseline row, and its equally-large first
 compile would put the higher-value ViT rows at wedge risk for an
 uninterpretable datapoint.
 
-Fail-open: any unexpected condition prints "yes" (the caller treats a
-crash/empty output as "yes" too).
+Fail-open: an unexpected condition prints "yes" (the caller treats a
+crash/empty output as "yes" too) — with ONE deliberate exception: an
+unparseable quarantine.json prints "no" in the before-call, because
+bench.py would read the same corrupt file as an empty quarantine and a
+green-lit re-pass would dispatch the known tunnel-wedgers the file
+exists to block.  --strict is unaffected (it reports coverage, not
+dispatch decisions).
 """
 
 import datetime

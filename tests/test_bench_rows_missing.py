@@ -1,0 +1,143 @@
+"""Unit tests for scripts/bench_rows_missing.py — the coverage checker
+that gates tpu_queue_r5_extras.sh's bench re-pass.
+
+The checker decides (a) whether a ~70-minute bench re-pass is worth
+dispatching (before-call), (b) whether the run may claim DONE
+(--strict after-call), and (c) seeds the batch-480 quarantine from the
+recorded evidence of the 2026-08-02 incident.  Each behavior guards
+real tunnel time, so each is pinned here.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_rows_missing.py")
+
+
+@pytest.fixture()
+def checker(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_rows_missing", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "LAST_GOOD", str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(mod, "QUARANTINE", str(tmp_path / "quarantine.json"))
+    monkeypatch.setattr(sys, "argv", ["bench_rows_missing.py"])
+    return mod
+
+
+def _write_last_good(mod, rows):
+    with open(mod.LAST_GOOD, "w") as f:
+        json.dump({"payload": {"extras": {"batch_scaling": rows}}}, f)
+
+
+def _run(mod, capsys, *argv):
+    import sys as _sys
+    _sys.argv = ["bench_rows_missing.py", *argv]
+    mod.main()
+    return capsys.readouterr().out.strip().splitlines()[0]
+
+
+MEASURED = {"emb_per_sec": 1000.0, "ms_per_step": 1.0}
+
+
+def test_all_measured_prints_no(checker, capsys):
+    _write_last_good(checker, {k: dict(MEASURED) for k in checker.WANT})
+    assert _run(checker, capsys) == "no"
+
+
+def test_missing_row_prints_yes(checker, capsys):
+    rows = {k: dict(MEASURED) for k in checker.WANT}
+    del rows["vit_b16_128"]
+    _write_last_good(checker, rows)
+    assert _run(checker, capsys) == "yes"
+
+
+def test_error_row_counts_missing(checker, capsys):
+    rows = {k: dict(MEASURED) for k in checker.WANT}
+    rows["120_s2d"] = {"error": "in flight when the child died (wedge?)"}
+    _write_last_good(checker, rows)
+    assert _run(checker, capsys) == "yes"
+
+
+def test_unreadable_last_good_prints_yes(checker, capsys):
+    # No last_good at all: every wanted row is missing -> re-pass.
+    assert _run(checker, capsys) == "yes"
+
+
+def test_quarantined_row_skips_repass_but_fails_strict(checker, capsys):
+    """Before-call: don't dispatch for a row bench.py will skip.
+    After-call (--strict): that row still blocks the DONE marker."""
+    rows = {k: dict(MEASURED) for k in checker.WANT}
+    rows["vit_b16_256"] = {"error": "wedge"}
+    _write_last_good(checker, rows)
+    with open(checker.QUARANTINE, "w") as f:
+        json.dump({"vit_b16_256": {"note": "wedged"}}, f)
+    assert _run(checker, capsys) == "no"
+    assert _run(checker, capsys, "--strict") == "yes"
+
+
+def test_seeds_480_quarantine_only_on_error_evidence(checker, capsys):
+    rows = {k: dict(MEASURED) for k in checker.WANT}
+    rows["480"] = {"error": "UNAVAILABLE: TPU backend setup/compile error"}
+    _write_last_good(checker, rows)
+    _run(checker, capsys)
+    q = json.load(open(checker.QUARANTINE))
+    assert set(q) == {"480", "480_remat"}
+    # Wedge-shaped compiles are environment incidents, not code bugs:
+    # the note must tell the operator how to retry.
+    assert "note" in q["480"] and "date" in q["480"]
+
+
+def test_no_seeding_without_evidence(checker, capsys):
+    """'480 merely unmeasured' must NOT seed: that would re-add entries
+    an operator deliberately cleared for a retry, and would fire in
+    fresh environments where 480 never failed."""
+    rows = {k: dict(MEASURED) for k in checker.WANT}
+    _write_last_good(checker, rows)  # no 480 row at all
+    _run(checker, capsys)
+    assert not os.path.exists(checker.QUARANTINE)
+
+
+def test_measured_480_does_not_seed(checker, capsys):
+    rows = {k: dict(MEASURED) for k in checker.WANT}
+    rows["480"] = dict(MEASURED)
+    _write_last_good(checker, rows)
+    _run(checker, capsys)
+    assert not os.path.exists(checker.QUARANTINE)
+
+
+def test_seeding_is_idempotent_and_preserves_entries(checker, capsys):
+    rows = {k: dict(MEASURED) for k in checker.WANT}
+    rows["480"] = {"error": "UNAVAILABLE"}
+    _write_last_good(checker, rows)
+    with open(checker.QUARANTINE, "w") as f:
+        json.dump({"blockwise_flagship_radix": {"note": "kept"}}, f)
+    _run(checker, capsys)
+    first = json.load(open(checker.QUARANTINE))
+    _run(checker, capsys)
+    second = json.load(open(checker.QUARANTINE))
+    assert first == second
+    assert second["blockwise_flagship_radix"]["note"] == "kept"
+    assert "480" in second and "480_remat" in second
+
+
+def test_corrupt_quarantine_never_rewritten_and_blocks_dispatch(
+        checker, capsys):
+    """A corrupt quarantine file must not be clobbered (that would drop
+    the radix wedge entry) and must not green-light a re-pass —
+    bench.py reads the same corrupt file as {} and would dispatch the
+    known tunnel-wedgers."""
+    rows = {}  # everything missing: normally a clear 'yes'
+    _write_last_good(checker, rows)
+    with open(checker.QUARANTINE, "w") as f:
+        f.write("{not json")
+    assert _run(checker, capsys) == "no"
+    assert open(checker.QUARANTINE).read() == "{not json"
+    # --strict (after-call) still reports coverage honestly.
+    assert _run(checker, capsys, "--strict") == "yes"
