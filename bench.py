@@ -90,12 +90,56 @@ QUARANTINE_PATH = os.path.join(REPO, "bench_cache", "quarantine.json")
 # (peak_flops / cost_flops) — one home, shared with the CLI `time`
 # subcommand.
 
+# Every final parent record also lands here as one JSONL row with the
+# obs envelope (run_id/step/wall_time/phase) — the bench trajectory as a
+# structured sink the BENCH_*.json stdout line is a derived view of.
+TELEMETRY_LOG = os.path.join(REPO, "bench_cache", "bench_history.jsonl")
+
 
 def _log(msg: str) -> None:
     print(f"[bench t={time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
 _T0 = time.time()
+
+
+def _load_obs_sinks():
+    """File-path import of the stdlib-only sinks module.  The parent must
+    NOT import the npairloss_tpu package — its ``__init__`` pulls jax,
+    and a hung backend import would defeat this file's no-jax-in-parent
+    robustness contract (same trick as cli.cmd_bench in reverse)."""
+    import importlib.util
+
+    path = os.path.join(REPO, "npairloss_tpu", "obs", "sinks.py")
+    spec = importlib.util.spec_from_file_location("_npair_obs_sinks", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _emit(rec) -> int:
+    """Publish the parent's ONE JSON line (the historical stdout/BENCH_*
+    format — kept byte-compatible as the derived view) and append the
+    same payload to the committed JSONL history sink."""
+    print(json.dumps(rec))
+    try:
+        sinks = _load_obs_sinks()
+        sink = sinks.JsonlSink(TELEMETRY_LOG)
+        # Envelope stamped LAST so it always wins over record keys (the
+        # same contract RunTelemetry.log pins) — a future bench key named
+        # "step"/"wall_time" must not corrupt the history rows.
+        row = dict(rec)
+        row.update(
+            run_id=f"bench-{int(_T0)}-{os.getpid()}",
+            step=0,
+            wall_time=time.time(),
+            phase="bench",
+        )
+        sink.log(row)
+        sink.close()
+    except Exception as e:  # the sink must never cost the bench line
+        _log(f"bench history sink append failed (non-fatal): {e}")
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -1082,8 +1126,7 @@ def main(argv=None) -> int:
                 platform_status + "; CPU probe ALSO failed", None
             )
             rec["error"] = "no jax backend (TPU or CPU) initialized within timeout"
-            print(json.dumps(rec))
-            return 0
+            return _emit(rec)
     _log(f"probe ok: {probe}")
 
     if platform == "cpu":
@@ -1092,8 +1135,7 @@ def main(argv=None) -> int:
         smoke = _run_child(
             ["--child", "smoke", "--platform", "cpu"], args.smoke_timeout
         )
-        print(json.dumps(_degraded_record(platform_status, smoke)))
-        return 0
+        return _emit(_degraded_record(platform_status, smoke))
 
     attempts = []
     if not args.smoke:
@@ -1144,8 +1186,7 @@ def main(argv=None) -> int:
                 if lg is not None:
                     rec["last_good"] = lg
             _clear_spill()  # consumed (or superseded) — don't litter /tmp
-            print(json.dumps(rec))
-            return 0
+            return _emit(rec)
 
     rec = _degraded_record(
         f"all bench variants failed or timed out (backend probe said {probe})",
@@ -1153,8 +1194,7 @@ def main(argv=None) -> int:
     )
     rec["error"] = "all bench variants failed or timed out"
     _clear_spill()
-    print(json.dumps(rec))
-    return 0
+    return _emit(rec)
 
 
 if __name__ == "__main__":

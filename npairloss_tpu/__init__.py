@@ -11,6 +11,14 @@ native C++ runtime), ``models`` (embedding zoo), ``train`` (solver
 loop), ``utils`` (profiling + numeric debug guards).
 """
 
+import logging as _logging
+
+# Library-logging etiquette: a package must never force output (or emit
+# "No handlers could be found" warnings) in an embedding application
+# that configured logging its own way.  The CLI adds a real handler only
+# when the embedder has not (cli.cmd_train).
+_logging.getLogger("npairloss_tpu").addHandler(_logging.NullHandler())
+
 from npairloss_tpu.ops.npair_loss import (
     REFERENCE_CONFIG,
     MiningMethod,

@@ -265,20 +265,84 @@ def cmd_train(args) -> int:
         )
         return 2
 
-    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    # Configure logging only when the embedder has not.  basicConfig is
+    # already a no-op when the ROOT logger has handlers; the extra check
+    # covers embedders that configured the package logger directly
+    # (handlers beyond our NullHandler) without touching root — adding a
+    # root handler there would double their output.
+    _pkg_handlers = [
+        h for h in logging.getLogger("npairloss_tpu").handlers
+        if not isinstance(h, logging.NullHandler)
+    ]
+    if not logging.getLogger().handlers and not _pkg_handlers:
+        logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    if getattr(args, "debug_checks", False):
+        from npairloss_tpu.utils.debug import enable_debug_checks
+
+        enable_debug_checks(True)
+    if getattr(args, "health_metrics", False):
+        from npairloss_tpu.obs import HealthConfig
+
+        solver.health = HealthConfig()
+
+    telemetry = None
+    tel_dir = getattr(args, "telemetry_dir", None)
+    trace_dir = getattr(args, "trace_dir", None)
     record_fn, log_file = None, None
-    if getattr(args, "log_json", None):
-        import jax
-
-        # Rank-gate: in a multi-process run, N hosts appending to one
-        # shared path would duplicate every event N times.
-        if jax.process_index() == 0:
-            log_file = open(args.log_json, "a", buffering=1)
-
-            def record_fn(rec):
-                log_file.write(json.dumps(rec) + "\n")
-
     try:
+        if tel_dir or trace_dir:
+            import dataclasses
+
+            import jax
+
+            # Rank-gate like --log-json: one telemetry writer per run.
+            if jax.process_index() == 0:
+                from npairloss_tpu.obs import RunTelemetry
+
+                # --telemetry-dir = the full run directory (manifest +
+                # metrics.jsonl + trace.json); --trace-dir alone = span
+                # tracing only (trace.json, no metric rows).  argparse
+                # makes them mutually exclusive.
+                telemetry = RunTelemetry(
+                    tel_dir or trace_dir, metrics=bool(tel_dir)
+                )
+                if tel_dir:
+                    telemetry.write_manifest(
+                        config={
+                            "solver": dataclasses.asdict(solver.cfg),
+                            "loss": dataclasses.asdict(solver.loss_cfg),
+                            "model": args.model or _model_for_net(net_cfg),
+                            "net": args.net,
+                            "engine": solver.engine,
+                            "synthetic": bool(args.synthetic),
+                            "health_metrics":
+                                bool(getattr(args, "health_metrics", False)),
+                        },
+                        mesh=(
+                            {"devices": solver.mesh.size,
+                             "axis": solver.axis}
+                            if solver.mesh is not None else None
+                        ),
+                    )
+                solver.telemetry = telemetry
+
+        if getattr(args, "log_json", None):
+            import jax
+
+            # Rank-gate: in a multi-process run, N hosts appending to one
+            # shared path would duplicate every event N times.
+            if jax.process_index() == 0:
+                from npairloss_tpu.obs import JsonlSink
+
+                # The obs sink IS this format (append JSONL, line
+                # buffered) — one implementation to maintain.  Records
+                # pass through verbatim: --log-json predates the
+                # run-telemetry envelope and its consumers key on the
+                # solver's {"event", "iteration"} fields.
+                log_file = JsonlSink(args.log_json)
+                record_fn = log_file.log
+
         # max_iter override was already baked into solver.cfg by
         # _build_solver; train() falls back to it — one source of truth.
         final = solver.train(
@@ -288,8 +352,22 @@ def cmd_train(args) -> int:
             record_fn=record_fn,
         )
     finally:
+        # Telemetry closes on EVERY exit path so a crashed run still
+        # leaves metrics.jsonl/trace.json on disk (the diagnosable-from-
+        # artifacts contract, docs/OBSERVABILITY.md).  Both closes are
+        # guarded: a disk-full close failure is reported but must
+        # neither skip the other close nor mask the train outcome
+        # propagating past this finally.
         if log_file is not None:
-            log_file.close()
+            try:
+                log_file.close()
+            except Exception as e:
+                log.error("--log-json close failed: %s", e)
+        if telemetry is not None:
+            try:
+                telemetry.close()
+            except Exception as e:
+                log.error("telemetry close failed: %s", e)
     print(json.dumps({k: float(v) for k, v in final.items()}))
     return 0
 
@@ -961,6 +1039,33 @@ def main(argv: Optional[list] = None) -> int:
         "--log-json", dest="log_json", metavar="PATH",
         help="append one JSON record per display/test/snapshot event "
         "(machine-readable counterpart of the Caffe-style text log)",
+    )
+    t_tel = t.add_mutually_exclusive_group()
+    t_tel.add_argument(
+        "--telemetry-dir", dest="telemetry_dir", metavar="DIR",
+        help="full run-telemetry directory: manifest.json (config/topology/"
+        "git-sha snapshot) + metrics.jsonl (one structured row per train "
+        "step and eval) + trace.json (host span timeline, Perfetto-"
+        "viewable) — see docs/OBSERVABILITY.md",
+    )
+    t_tel.add_argument(
+        "--trace-dir", dest="trace_dir", metavar="DIR",
+        help="host-side span tracing only: write DIR/trace.json "
+        "(Chrome-trace JSON) without per-step metric rows (and without "
+        "their per-step host sync); mutually exclusive with "
+        "--telemetry-dir, whose run dir already includes the trace",
+    )
+    t.add_argument(
+        "--health-metrics", dest="health_metrics", action="store_true",
+        help="fold in-graph training-health signals into every step's "
+        "metrics (grad/param/update norms, update/param ratio, embedding "
+        "magnitude, mined-pair hardness) — obs.health.HealthConfig",
+    )
+    t.add_argument(
+        "--debug-checks", dest="debug_checks", action="store_true",
+        help="validate every step's loss/metric scalars are finite on "
+        "host (utils.debug.enable_debug_checks; also settable via "
+        "NPAIRLOSS_DEBUG_CHECKS=1)",
     )
     t.add_argument("--num-processes", type=int, help="total host processes")
     t.add_argument("--process-id", type=int, help="this process's rank")

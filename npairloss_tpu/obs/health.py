@@ -1,0 +1,130 @@
+"""In-graph training-health signals — optional, jit-compatible diagnostics.
+
+The reference monitored training health with an in-training
+Recall@{1,5,10} metric and a feature-magnitude probe
+(GetRetrivePerformance + asum, reference:
+npair_multi_class_loss.cu:173-206, cu:400-401).  This module generalizes
+that idea to the signals large-scale training actually triages with:
+
+  * global gradient norm (exploding/vanishing gradients),
+  * parameter norm and update/param ratio (the "is the lr sane" signal
+    — healthy runs sit around 1e-3),
+  * embedding-magnitude mean/max (the reference's feature monitor: after
+    L2 normalize these pin to 1.0; drift means the normalize layer or
+    its gradient broke),
+  * mined-pair hardness summaries (selected pair counts and the mining
+    thresholds from ``ops.rank_select``-backed RELATIVE mining — a
+    collapsing embedding shows up here before it shows up in loss).
+
+Everything is a fixed-shape fp32 reduction folded into the jitted step's
+metric dict, gated by ``HealthConfig``: with ``health=None`` (the
+Solver default) no op is added and the hot path compiles identical HLO
+to a build without this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Which health signals to fold into the step's metric dict.
+
+    Each enabled signal costs a few whole-tree or whole-batch fp32
+    reductions inside the jitted step — negligible next to the trunk
+    gemms, but not free; the Solver's default (no HealthConfig) adds
+    nothing.
+    """
+
+    grad_norm: bool = True
+    param_norm: bool = True
+    update_ratio: bool = True
+    embedding_magnitude: bool = True
+    pair_hardness: bool = True
+    eps: float = 1e-12
+
+
+def tree_l2_norm(tree: Any) -> jax.Array:
+    """Global L2 norm over every leaf of a pytree, accumulated in fp32
+    (bf16 params/grads would overflow a squared sum in their own dtype)."""
+    sq = jax.tree_util.tree_reduce(
+        lambda acc, x: acc + jnp.sum(jnp.square(x.astype(jnp.float32))),
+        tree,
+        jnp.float32(0.0),
+    )
+    return jnp.sqrt(sq)
+
+
+def update_health(
+    grads: Any, params: Any, updates: Any, cfg: HealthConfig
+) -> Dict[str, jax.Array]:
+    """Optimizer-side signals from one step's (grads, params, updates).
+
+    ``update_ratio`` is ||update|| / ||param|| — the per-step relative
+    parameter motion; lr schedules are sane when this sits near 1e-3
+    and broken when it hits 1e-1 (divergence) or 1e-7 (frozen run).
+    """
+    out: Dict[str, jax.Array] = {}
+    if cfg.grad_norm:
+        out["grad_norm"] = tree_l2_norm(grads)
+    param_norm = None
+    if cfg.param_norm or cfg.update_ratio:
+        param_norm = tree_l2_norm(params)
+    if cfg.param_norm:
+        out["param_norm"] = param_norm
+    if cfg.update_ratio:
+        out["update_norm"] = tree_l2_norm(updates)
+        out["update_ratio"] = out["update_norm"] / (
+            param_norm + jnp.float32(cfg.eps)
+        )
+    return out
+
+
+def embedding_health(features: jax.Array) -> Dict[str, jax.Array]:
+    """Embedding-magnitude mean/max — the reference's feature monitor
+    generalized from asum to row L2 norms (one home:
+    ``ops.metrics.embedding_magnitude``)."""
+    from npairloss_tpu.ops.metrics import embedding_magnitude
+
+    return embedding_magnitude(features)
+
+
+# Mining thresholds use ±inf/±FLT_MAX sentinels for "no candidates /
+# select everything" queries; any |threshold| past this cutoff is a
+# sentinel, not a similarity (post-L2Normalize sims live in [-1, 1]).
+_THRESHOLD_SENTINEL = 1e30
+
+
+def _finite_mean(x: jax.Array) -> jax.Array:
+    """Mean over non-sentinel entries; 0 when every entry is a sentinel
+    (an all-sentinel batch must report a FINITE health row — the health
+    metrics feed assert_all_finite under --debug-checks)."""
+    x = x.astype(jnp.float32)
+    ok = jnp.isfinite(x) & (jnp.abs(x) < _THRESHOLD_SENTINEL)
+    cnt = ok.sum()
+    total = jnp.where(ok, x, 0.0).sum()
+    return jnp.where(cnt > 0, total / jnp.maximum(cnt, 1), 0.0)
+
+
+def pair_hardness_health(aux: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Mined-pair hardness summary from the dense engine's loss aux.
+
+    ``mined_pos/neg_per_query`` are the reference's identNum/diffNum
+    (cu:357/360) averaged over queries; ``ap/an_threshold_mean`` are the
+    mining thresholds (exact rank statistics via ``ops.rank_select`` for
+    RELATIVE_* methods), averaged over the queries that actually had
+    candidates.  Thresholds drifting toward +1 while counts collapse is
+    the classic embedding-collapse signature.
+    """
+    stop = jax.lax.stop_gradient
+    return {
+        "mined_pos_per_query": stop(aux["ident_num"]).mean(),
+        "mined_neg_per_query": stop(aux["diff_num"]).mean(),
+        "ap_threshold_mean": _finite_mean(stop(aux["pos_threshold"])),
+        "an_threshold_mean": _finite_mean(stop(aux["neg_threshold"])),
+    }

@@ -1,0 +1,160 @@
+"""Run manifests — the "what exactly ran?" snapshot written at run start.
+
+TensorFlow's event pipeline and the TPU-v4 scaling analyses both lean on
+one discipline: every run directory carries enough provenance to
+re-derive its numbers (config, topology, code version).  ``RunManifest``
+captures that here: config snapshot, device/mesh topology, package
+version, git sha, host info — written as ``manifest.json`` before the
+first step so even a crashed run is diagnosable from disk.
+
+Stdlib only at import time; jax and the package itself are consulted
+lazily (and only if already imported) so this module stays usable from
+jax-free processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import platform
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+def git_sha(repo_dir: Optional[str] = None) -> Optional[str]:
+    """HEAD sha of the repo containing ``repo_dir`` (default: this
+    package's checkout), or None outside a git checkout / without git.
+
+    With no ``repo_dir``, the sha is recorded only when THIS file is
+    actually tracked by the enclosing repo — a pip-installed package
+    whose site-packages merely sits inside some unrelated git checkout
+    (a dotfiles repo, a project venv) must record None, not that repo's
+    HEAD as bogus code provenance.
+    """
+    anchor = None
+    if repo_dir is None:
+        # Anchor on the package root __init__.py (tracked since the
+        # seed commit) rather than this file, which may be newer than
+        # the checkout's HEAD in mid-development trees.
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        anchor = os.path.join(pkg_dir, "__init__.py")
+        repo_dir = pkg_dir
+    try:
+        if anchor is not None:
+            tracked = subprocess.run(
+                ["git", "-C", repo_dir, "ls-files", "--error-unmatch",
+                 anchor],
+                capture_output=True, timeout=10,
+            )
+            if tracked.returncode != 0:
+                return None
+        out = subprocess.run(
+            ["git", "-C", repo_dir, "rev-parse", "HEAD"],
+            capture_output=True, timeout=10,
+        )
+        if out.returncode == 0:
+            return out.stdout.decode().strip() or None
+    except Exception:
+        pass
+    return None
+
+
+def package_version() -> Optional[str]:
+    """npairloss_tpu.__version__ if the package is importable."""
+    try:
+        import npairloss_tpu
+
+        return npairloss_tpu.__version__
+    except Exception:
+        return None
+
+
+def device_topology() -> Optional[Dict[str, Any]]:
+    """Mesh-relevant device/process topology from jax — but only if jax
+    is ALREADY imported (never force a backend init from telemetry; a
+    hung plugin discovery must not be observability's fault)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return {
+            "default_backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "devices": [
+                {
+                    "id": d.id,
+                    "platform": d.platform,
+                    "device_kind": d.device_kind,
+                    "process_index": d.process_index,
+                }
+                for d in jax.devices()
+            ],
+        }
+    except Exception:
+        return None
+
+
+@dataclasses.dataclass
+class RunManifest:
+    """One run's provenance record.  ``config`` is the caller's config
+    snapshot (solver/loss/model/net — anything JSON-able; non-JSON
+    leaves are stringified on write)."""
+
+    run_id: str
+    created: float = dataclasses.field(default_factory=time.time)
+    config: Optional[Dict[str, Any]] = None
+    topology: Optional[Dict[str, Any]] = None
+    mesh: Optional[Dict[str, Any]] = None
+    package_version: Optional[str] = None
+    git_sha: Optional[str] = None
+    argv: Optional[list] = None
+    host: Optional[Dict[str, Any]] = None
+    extra: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def collect(
+        cls,
+        run_id: str,
+        config: Optional[Dict[str, Any]] = None,
+        mesh: Optional[Dict[str, Any]] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> "RunManifest":
+        """Gather the ambient provenance (version/sha/topology/host)
+        around the caller's config snapshot."""
+        return cls(
+            run_id=run_id,
+            config=config,
+            topology=device_topology(),
+            mesh=mesh,
+            package_version=package_version(),
+            git_sha=git_sha(),
+            argv=list(sys.argv),
+            host={
+                "platform": platform.platform(),
+                "python": platform.python_version(),
+                "pid": os.getpid(),
+            },
+            extra=extra,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def write(self, path: str) -> str:
+        """Write ``manifest.json`` atomically; returns the path."""
+        path = os.path.abspath(path)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, default=str)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path

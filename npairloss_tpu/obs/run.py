@@ -1,0 +1,164 @@
+"""RunTelemetry — one object tying a run directory to the telemetry parts.
+
+A run directory is the on-disk unit of diagnosability:
+
+    <run_dir>/manifest.json   provenance (obs.manifest.RunManifest)
+    <run_dir>/metrics.jsonl   structured metric records (obs.sinks)
+    <run_dir>/trace.json      host span timeline (obs.tracing, Perfetto)
+
+``RunTelemetry`` owns the run_id, stamps every record with the required
+``{run_id, step, wall_time, phase}`` envelope, multiplexes records to a
+JSONL file + in-memory ring buffer (plus any extra sinks), and holds the
+span tracer.  The Solver and the CLI emit through this one pipeline
+instead of bespoke callbacks and hand-rolled JSON.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, Iterator, Optional, Sequence
+
+from npairloss_tpu.obs.manifest import RunManifest
+from npairloss_tpu.obs.sinks import (
+    JsonlSink,
+    MetricLogger,
+    MultiSink,
+    RingBufferSink,
+)
+from npairloss_tpu.obs.tracing import SpanTracer
+
+METRICS_FILENAME = "metrics.jsonl"
+MANIFEST_FILENAME = "manifest.json"
+TRACE_FILENAME = "trace.json"
+
+
+def _default_run_id() -> str:
+    """Sortable, collision-resistant without coordination: UTC timestamp
+    + pid + 2 random bytes (concurrent processes on one host share the
+    second)."""
+    rand = os.urandom(2).hex()
+    return time.strftime("%Y%m%d-%H%M%S", time.gmtime()) + \
+        f"-{os.getpid()}-{rand}"
+
+
+class RunTelemetry:
+    """Lifecycle: construct (creates the run dir and opens sinks) ->
+    ``write_manifest`` -> ``log``/``span`` during the run -> ``close``
+    (flushes sinks, writes trace.json).  Usable as a context manager.
+
+    ``metrics=False`` gives a trace-only instance (the CLI's
+    ``--trace-dir``); ``trace=False`` a metrics-only one.  ``ring``
+    records stay readable via ``.ring.records()`` for live
+    introspection either way.
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        run_id: Optional[str] = None,
+        metrics: bool = True,
+        trace: bool = True,
+        ring_capacity: int = 1024,
+        extra_sinks: Sequence[MetricLogger] = (),
+    ):
+        self.run_dir = os.path.abspath(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.run_id = run_id or _default_run_id()
+        # Consumers (Solver.train) gate their per-step emission on this:
+        # a trace-only instance must not pay the per-step host sync that
+        # materializing metric scalars costs — it would distort the very
+        # host timeline the tracer exists to capture.
+        self.metrics_enabled = bool(metrics)
+        self.ring = RingBufferSink(ring_capacity)
+        children: list = [self.ring]
+        if metrics:
+            children.insert(
+                0, JsonlSink(os.path.join(self.run_dir, METRICS_FILENAME))
+            )
+        children.extend(extra_sinks)
+        self.sink: MetricLogger = MultiSink(children)
+        self.tracer: Optional[SpanTracer] = SpanTracer() if trace else None
+        self.manifest: Optional[RunManifest] = None
+        self._closed = False
+
+    # -- manifest ---------------------------------------------------------
+
+    def write_manifest(
+        self,
+        config: Optional[Dict[str, Any]] = None,
+        mesh: Optional[Dict[str, Any]] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Collect + write ``manifest.json``; call once at run start."""
+        self.manifest = RunManifest.collect(
+            self.run_id, config=config, mesh=mesh, extra=extra
+        )
+        return self.manifest.write(
+            os.path.join(self.run_dir, MANIFEST_FILENAME)
+        )
+
+    # -- metric records ---------------------------------------------------
+
+    def log(
+        self,
+        phase: str,
+        step: int,
+        metrics: Optional[Dict[str, Any]] = None,
+        **extra: Any,
+    ) -> Dict[str, Any]:
+        """Emit one record with the required envelope stamped.  The
+        caller's metric keys must not collide with the envelope (the
+        envelope wins — a metric named "step" would corrupt every
+        downstream consumer)."""
+        record: Dict[str, Any] = {}
+        if metrics:
+            record.update(metrics)
+        record.update(extra)
+        record.update(
+            run_id=self.run_id,
+            step=int(step),
+            wall_time=time.time(),
+            phase=phase,
+        )
+        self.sink.log(record)
+        return record
+
+    # -- spans ------------------------------------------------------------
+
+    def span(self, name: str, **args: Any):
+        """Tracer span, or a no-op context when tracing is disabled —
+        call sites never need to branch."""
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        if self.tracer is not None:
+            self.tracer.instant(name, **args)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def flush(self) -> None:
+        self.sink.flush()
+        if self.tracer is not None:
+            self.tracer.write(os.path.join(self.run_dir, TRACE_FILENAME))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.flush()
+        finally:
+            # Even when a flush/trace write fails (disk full), every
+            # sink still gets its close call (MultiSink isolates
+            # per-child) before the error propagates.
+            self.sink.close()
+
+    def __enter__(self) -> "RunTelemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -64,6 +64,23 @@ def feature_asum(features: jax.Array) -> jax.Array:
     return jnp.abs(features.astype(jnp.float32)).sum() / jnp.float32(n)
 
 
+def embedding_magnitude(features: jax.Array) -> Dict[str, jax.Array]:
+    """Row-L2-norm mean/max — the feature monitor generalized.
+
+    ``feature_asum`` reproduces the reference's exact asum probe
+    (cu:400-401); this is the version worth alarming on: after the
+    L2Normalize layer every row norm is 1.0 by construction, so
+    ``emb_mag_mean`` drifting from 1 (or ``emb_mag_max`` spiking) means
+    the normalize layer or its gradient broke.  Consumed by
+    ``obs.health`` as an optional in-graph health signal.
+    """
+    norms = jnp.linalg.norm(features.astype(jnp.float32), axis=-1)
+    return {
+        "emb_mag_mean": norms.mean(),
+        "emb_mag_max": norms.max(),
+    }
+
+
 def retrieval_metrics(
     aux: Dict[str, jax.Array],
     local_labels: jax.Array,

@@ -17,6 +17,7 @@ parameters replicated, collectives compiled into the step by XLA.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
 import time
@@ -28,6 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from npairloss_tpu.obs.health import (
+    HealthConfig,
+    embedding_health,
+    pair_hardness_health,
+    update_health,
+)
+from npairloss_tpu.obs.run import RunTelemetry
 from npairloss_tpu.ops.metrics import retrieval_metrics
 from npairloss_tpu.utils.debug import assert_all_finite, debug_checks_enabled
 from npairloss_tpu.ops.npair_loss import NPairLossConfig, npair_loss_with_aux
@@ -90,9 +98,31 @@ class Solver:
         matmul_precision: Optional[str] = None,
         param_mults: Optional[tuple] = None,
         loss_weight: float = 1.0,
+        health: Optional[HealthConfig] = None,
+        telemetry: Optional[RunTelemetry] = None,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
+        # Run-telemetry subsystem (docs/OBSERVABILITY.md): ``health``
+        # folds in-graph training-health signals into the step's metric
+        # dict (None = no extra ops, HLO identical to a health-free
+        # build); ``telemetry`` routes per-step records + host spans
+        # through obs.run.RunTelemetry.  Both are plain attributes —
+        # assignable after construction; health changes take effect at
+        # the next (re)compile.
+        self.health = health
+        self.telemetry = telemetry
+        # Batch signatures already dispatched through the jitted step/
+        # eval fns: a NEW signature means jit will trace+compile before
+        # dispatching, so the telemetry span is named */compile and the
+        # stall is a visible event, not a mystery (the dynamic-batch
+        # path recompiles per shape).
+        self._seen_step_shapes: set = set()
+        self._seen_eval_shapes: set = set()
+        # Latched on the first sink-write failure (disk full): telemetry
+        # must never abort training, so further metric emission stops
+        # (spans, which are in-memory, keep recording).
+        self._telemetry_failed = False
         # The loss top's `loss_weight` (reference: cu:435 scales the
         # whole backward by top[0]'s weight; Caffe's objective is the
         # weighted loss).  The shipped template uses 1.
@@ -274,6 +304,11 @@ class Solver:
             jax.lax.stop_gradient(aux), labels, jax.lax.stop_gradient(emb),
             self.top_ks,
         )
+        if self.health is not None and self.health.pair_hardness:
+            # Mined-pair hardness summaries ride the dense engine's loss
+            # aux (the streaming engines never materialize it — their
+            # health coverage is the norm/magnitude signals).
+            metrics.update(pair_hardness_health(aux))
         return loss, metrics
 
     def _sharded_loss(self, emb, labels):
@@ -316,6 +351,12 @@ class Solver:
                     params, state["batch_stats"], inputs, train=True
                 )
                 loss, metrics = self.compute_loss(emb, labels)
+                if self.health is not None and \
+                        self.health.embedding_magnitude:
+                    metrics = {
+                        **metrics,
+                        **embedding_health(jax.lax.stop_gradient(emb)),
+                    }
                 return loss, (metrics, new_bs)
 
             (loss, (metrics, new_bs)), grads = jax.value_and_grad(
@@ -325,6 +366,12 @@ class Solver:
             # own step counter — a single source of truth.
             metrics["lr"] = self.rate_fn(state["opt"].step)
             upd, opt = self.tx.update(grads, state["opt"], state["params"])
+            if self.health is not None:
+                # Optimizer-side health signals (obs.health): whole-tree
+                # fp32 reductions folded into the same jitted graph.
+                metrics.update(
+                    update_health(grads, state["params"], upd, self.health)
+                )
             params = jax.tree_util.tree_map(
                 lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
                 state["params"],
@@ -361,6 +408,32 @@ class Solver:
         else:
             self._step_fn = jax.jit(train_step, donate_argnums=donate)
             self._eval_fn = jax.jit(eval_step)
+        # Fresh jitted fns compile every signature anew — reset the
+        # compile-capture bookkeeping so telemetry reports them as such.
+        self._seen_step_shapes = set()
+        self._seen_eval_shapes = set()
+
+    def _span(self, name: str, **args):
+        """Telemetry span, or a no-op context when none is attached."""
+        if self.telemetry is None:
+            return contextlib.nullcontext()
+        return self.telemetry.span(name, **args)
+
+    def _tel_log(self, phase: str, step: int, metrics, **extra) -> None:
+        """Metric emission that can never abort training: a sink-write
+        failure (disk full/quota) is reported once, then per-step
+        emission latches off for the rest of the run."""
+        tel = self.telemetry
+        if tel is None or not tel.metrics_enabled or self._telemetry_failed:
+            return
+        try:
+            tel.log(phase, step, metrics, **extra)
+        except Exception as e:  # noqa: BLE001 — telemetry is not the run
+            self._telemetry_failed = True
+            log.error(
+                "telemetry metric emission failed (disabling for the "
+                "rest of the run): %s", e,
+            )
 
     # -- public API -------------------------------------------------------
 
@@ -386,7 +459,22 @@ class Solver:
         if self._step_fn is None:
             self._make_step()
         x, lab = self._put_batch(inputs, labels)
-        self.state, metrics = self._step_fn(self.state, x, lab)
+        # First-dispatch compile capture: jit compiles synchronously on a
+        # new argument signature before the async dispatch, so a span
+        # around the call IS the compile time.  A signature seen after
+        # the first one is a RECOMPILE (the dynamic-batch path) — marked
+        # with an instant event so Perfetto shows it at a glance.
+        sig = (tuple(np.shape(x)), tuple(np.shape(lab)))
+        compiling = sig not in self._seen_step_shapes
+        self._seen_step_shapes.add(sig)
+        if self.telemetry is not None and compiling \
+                and len(self._seen_step_shapes) > 1:
+            self.telemetry.instant("step/recompile", batch=int(np.shape(x)[0]))
+        with self._span(
+            "step/compile" if compiling else "step/dispatch",
+            batch=int(np.shape(x)[0]),
+        ):
+            self.state, metrics = self._step_fn(self.state, x, lab)
         if debug_checks_enabled():
             # utils.debug switch: validate every step's scalars on host
             # (SURVEY.md §5.2 — the reference had no numeric checks).
@@ -399,18 +487,30 @@ class Solver:
         """TEST phase: average loss+metrics over ``num_iters`` batches."""
         acc: Dict[str, float] = collections.defaultdict(float)
         n = 0
-        for _ in range(num_iters):
-            inputs, labels = next(batches)
-            if self.state is None:
-                self.init(np.asarray(inputs)[:2])
-            if self._eval_fn is None:
-                self._make_step()
-            x, lab = self._put_batch(inputs, labels)
-            m = self._eval_fn(self.state, x, lab)
-            for k, v in m.items():
-                acc[k] += float(v)
-            n += 1
-        return {k: v / max(n, 1) for k, v in acc.items()}
+        with self._span("eval", num_iters=num_iters):
+            for _ in range(num_iters):
+                inputs, labels = next(batches)
+                if self.state is None:
+                    self.init(np.asarray(inputs)[:2])
+                if self._eval_fn is None:
+                    self._make_step()
+                x, lab = self._put_batch(inputs, labels)
+                sig = (tuple(np.shape(x)), tuple(np.shape(lab)))
+                compiling = sig not in self._seen_eval_shapes
+                self._seen_eval_shapes.add(sig)
+                if compiling:
+                    with self._span("eval/compile",
+                                    batch=int(np.shape(x)[0])):
+                        m = self._eval_fn(self.state, x, lab)
+                else:
+                    m = self._eval_fn(self.state, x, lab)
+                for k, v in m.items():
+                    acc[k] += float(v)
+                n += 1
+        out = {k: v / max(n, 1) for k, v in acc.items()}
+        if n:
+            self._tel_log("eval", self.iteration, out, eval_batches=n)
+        return out
 
     @property
     def iteration(self) -> int:
@@ -468,16 +568,25 @@ class Solver:
             if record_fn is not None:
                 record_fn({"event": "test", "iteration": 0,
                            **{k: float(v) for k, v in m.items()}})
+        tel = self.telemetry
         last = {}
         for it in range(start, num_iters):
-            inputs, labels = next(train_batches)
+            with self._span("data/next_batch"):
+                inputs, labels = next(train_batches)
             # Keep metrics as device scalars so the loop never blocks on a
             # host sync; floats are materialized only at display/test/return
-            # boundaries (JAX async dispatch keeps the TPU pipeline full).
+            # boundaries (JAX async dispatch keeps the TPU pipeline full) —
+            # UNLESS per-step telemetry is attached, whose one-row-per-step
+            # contract requires materializing here (the recorded cost; see
+            # docs/OBSERVABILITY.md).
             metrics = self.step(inputs, labels)
             self._loss_window.append(metrics["loss"])
             last = metrics
             step_num = int(it) + 1
+            if tel is not None and tel.metrics_enabled \
+                    and not self._telemetry_failed:
+                self._tel_log("train", step_num,
+                              {k: float(v) for k, v in metrics.items()})
             if cfg.display and step_num % cfg.display == 0:
                 host = {k: float(v) for k, v in last.items()}
                 avg = float(jnp.stack(list(self._loss_window)).mean())
@@ -507,6 +616,15 @@ class Solver:
             # Async Orbax saves must land before the process can exit, or the
             # final snapshot is left as an .orbax-checkpoint-tmp dir.
             self._checkpointer.wait_until_finished()
+        if tel is not None:
+            # Land metrics.jsonl/trace.json even when the owner forgets
+            # close() — flush is idempotent and the owner may keep
+            # logging.  Guarded like _tel_log: a full disk must not
+            # swallow a completed run's final metrics.
+            try:
+                tel.flush()
+            except Exception as e:  # noqa: BLE001
+                log.error("telemetry flush failed: %s", e)
         return {k: float(v) for k, v in last.items()}
 
     # -- checkpointing (Orbax; Caffe snapshot contract) --------------------
@@ -528,7 +646,8 @@ class Solver:
 
     def save_snapshot(self, step: int) -> str:
         path = self.snapshot_path(step)
-        self._ckpt().save(path, self.state, force=True)
+        with self._span("snapshot", step=step):
+            self._ckpt().save(path, self.state, force=True)
         log.info("snapshot -> %s", path)
         return path
 

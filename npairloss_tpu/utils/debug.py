@@ -18,13 +18,19 @@ guarding is numerics.  This module provides:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any
 
 import jax
 import numpy as np
 from jax.experimental import checkify
 
-_debug_checks = False
+# Process-wide default from the environment so embedded/driver runs can
+# flip the switch without code; the CLI's --debug-checks flag and
+# enable_debug_checks() override it either way.
+_debug_checks = os.environ.get(
+    "NPAIRLOSS_DEBUG_CHECKS", ""
+).lower() in ("1", "true", "yes", "on")
 
 
 def enable_debug_checks(enabled: bool = True) -> None:

@@ -7,6 +7,11 @@ the loss graph carry ``jax.named_scope`` annotations (visible in
 XProf/Perfetto and in HLO op names), ``trace`` captures a device profile
 for TensorBoard/XProf, and ``StepTimer`` gives the wall-clock
 steps/sec / embeddings/sec counters the reference never had.
+
+This module is the DEVICE-side half of the observability story; the
+HOST-side half (span tracing of data/dispatch/eval/snapshot/compile,
+structured metric sinks, health signals) lives in ``npairloss_tpu.obs``
+— see docs/OBSERVABILITY.md for when to reach for which.
 """
 
 from __future__ import annotations
@@ -16,7 +21,7 @@ import contextlib
 import logging
 import os
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 
@@ -220,20 +225,30 @@ class StepTimer:
     first tick only arms the timer.  Remember JAX dispatch is async —
     call ``jax.block_until_ready`` on a step output before the final
     tick, or wrap ticks around blocking points.
+
+    ``emit`` (optional) receives each tick's stats dict — pass e.g.
+    ``lambda s: telemetry.log("throughput", step, s)`` to route the
+    counters through the obs metric pipeline instead of scraping logs.
     """
 
-    def __init__(self, window: int = 50):
+    def __init__(self, window: int = 50,
+                 emit: Optional[Callable[[Dict[str, float]], None]] = None):
         self._durations: collections.deque = collections.deque(maxlen=window)
         self._items: collections.deque = collections.deque(maxlen=window)
         self._last: Optional[float] = None
+        self._emit = emit
 
     def tick(self, items: int = 0) -> Dict[str, float]:
         now = time.perf_counter()
-        if self._last is not None:
+        armed = self._last is not None
+        if armed:
             self._durations.append(now - self._last)
             self._items.append(items)
         self._last = now
-        return self.stats()
+        stats = self.stats()
+        if self._emit is not None and armed:
+            self._emit(stats)
+        return stats
 
     def stats(self) -> Dict[str, float]:
         if not self._durations:

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# The one CI entry point: lint + the ROADMAP.md tier-1 test command.
+#
+#   scripts/ci.sh            # lint, then full tier-1 pytest
+#   scripts/ci.sh --lint-only
+#
+# Keep the pytest invocation in sync with ROADMAP.md "Tier-1 verify" —
+# the driver enforces that exact command; this script exists so humans
+# and hooks run the same thing.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint: no bare print() in library code =="
+python scripts/check_no_print.py
+
+if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+# `|| rc=$?` keeps set -e from aborting on test failures so the
+# DOTS_PASSED diagnostic still prints; the script's exit code is the
+# pytest pipeline's.
+rc=0
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log || rc=$?
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"
