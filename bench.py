@@ -409,6 +409,7 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     from npairloss_tpu import NPairLossConfig, REFERENCE_CONFIG
     from npairloss_tpu.ops.npair_loss import npair_loss
     from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss
+    from npairloss_tpu.parallel._compat import shard_map
     from npairloss_tpu.parallel.mesh import data_parallel_mesh
     from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 
@@ -557,7 +558,7 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
         # top_ks=() keeps the comparison fair: dense/blockwise are timed
         # as loss+grad only, so the ring must not pay for streamed
         # retrieval-metric top-k maintenance the others skip.
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda f_, l_: ring_npair_loss_and_metrics(
                 f_, l_, cfg, "dp", top_ks=(), sim_cache=sim_cache,
                 matmul_precision=matmul_precision,

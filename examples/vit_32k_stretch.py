@@ -102,7 +102,7 @@ def main():
     else:
         from jax.sharding import PartitionSpec as P
 
-        from npairloss_tpu.parallel import data_parallel_mesh
+        from npairloss_tpu.parallel import data_parallel_mesh, shard_map
         from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 
         mesh = data_parallel_mesh(devices)
@@ -115,7 +115,7 @@ def main():
                 )
                 return loss[None]
 
-            losses = jax.shard_map(
+            losses = shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
             )(x, lab)

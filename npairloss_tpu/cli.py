@@ -132,6 +132,12 @@ def _build_solver(args):
         solver_cfg = dataclasses.replace(
             solver_cfg, snapshot_prefix=args.snapshot_prefix
         )
+    if getattr(args, "snapshot_keep", None) is not None:
+        import dataclasses
+
+        solver_cfg = dataclasses.replace(
+            solver_cfg, snapshot_max_keep=args.snapshot_keep
+        )
 
     crop = 0
     # Shape from the TRAIN layer, else the TEST layer (a net may define
@@ -189,7 +195,18 @@ def _build_solver(args):
                      else 1.0),
     )
     if getattr(args, "resume", None):
-        solver.restore_snapshot(args.resume)
+        if args.resume == "auto":
+            # Auto-resume (docs/RESILIENCE.md): newest manifest-valid
+            # snapshot under snapshot_prefix, torn/corrupt ones skipped
+            # with a logged reason; none found = fresh start (the
+            # supervisor-relaunch contract — first launch and relaunch
+            # run the same command line).
+            restored = solver.restore_auto()
+            if restored:
+                log.info("auto-resume: %s (iteration %d)",
+                         restored, solver.iteration)
+        else:
+            solver.restore_snapshot(args.resume)
     elif getattr(args, "weights", None):
         _load_weights_into(solver, args.weights)
     return solver, net_cfg, input_shape
@@ -286,6 +303,31 @@ def cmd_train(args) -> int:
 
         solver.health = HealthConfig()
 
+    from npairloss_tpu.resilience import (
+        EXIT_PREEMPTED,
+        DivergenceConfig,
+        DivergenceError,
+        PreemptionSignal,
+        TrainingPreempted,
+    )
+
+    if getattr(args, "divergence_patience", 0):
+        solver.divergence = DivergenceConfig(
+            patience=args.divergence_patience,
+            action=args.divergence_action,
+            lr_scale=args.divergence_lr_scale,
+            max_rollbacks=args.divergence_max_rollbacks,
+        )
+
+    # Graceful preemption (docs/RESILIENCE.md): SIGTERM/SIGINT finish
+    # the in-flight step, commit an emergency snapshot, flush telemetry,
+    # and exit EXIT_PREEMPTED so a supervisor relaunches with
+    # ``--resume auto``.  install() no-ops off the main thread.
+    preempt = None
+    if not getattr(args, "no_preempt_handler", False):
+        preempt = PreemptionSignal().install()
+        solver.preempt = preempt
+
     telemetry = None
     tel_dir = getattr(args, "telemetry_dir", None)
     trace_dir = getattr(args, "trace_dir", None)
@@ -345,12 +387,21 @@ def cmd_train(args) -> int:
 
         # max_iter override was already baked into solver.cfg by
         # _build_solver; train() falls back to it — one source of truth.
-        final = solver.train(
-            train_iter,
-            test_batches=test_iter,
-            log_fn=lambda s: print(s, flush=True),
-            record_fn=record_fn,
-        )
+        preempted = None
+        try:
+            final = solver.train(
+                train_iter,
+                test_batches=test_iter,
+                log_fn=lambda s: print(s, flush=True),
+                record_fn=record_fn,
+            )
+        except TrainingPreempted as e:
+            # The emergency snapshot already landed (Solver.train commits
+            # it before raising); exit the supervisor-relaunch code.
+            preempted = e
+        except DivergenceError as e:
+            log.error("%s", e)
+            return 1
     finally:
         # Telemetry closes on EVERY exit path so a crashed run still
         # leaves metrics.jsonl/trace.json on disk (the diagnosable-from-
@@ -358,6 +409,8 @@ def cmd_train(args) -> int:
         # guarded: a disk-full close failure is reported but must
         # neither skip the other close nor mask the train outcome
         # propagating past this finally.
+        if preempt is not None:
+            preempt.uninstall()
         if log_file is not None:
             try:
                 log_file.close()
@@ -368,6 +421,14 @@ def cmd_train(args) -> int:
                 telemetry.close()
             except Exception as e:
                 log.error("telemetry close failed: %s", e)
+    if preempted is not None:
+        print(json.dumps({
+            "preempted": True,
+            "iteration": preempted.step,
+            "snapshot": preempted.snapshot_path,
+            "resume": "--resume auto",
+        }))
+        return EXIT_PREEMPTED
     print(json.dumps({k: float(v) for k, v in final.items()}))
     return 0
 
@@ -999,7 +1060,13 @@ def main(argv: Optional[list] = None) -> int:
         "trunks): ~25%% more trunk FLOPs for much lower activation HBM "
         "— lifts the per-chip batch ceiling; numerically identical",
     )
-    t.add_argument("--resume", help="snapshot path to restore")
+    t.add_argument(
+        "--resume",
+        help="snapshot path to restore, or 'auto' to scan snapshot_prefix "
+        "for the newest valid snapshot (torn/corrupt ones skipped with a "
+        "logged reason; none found = fresh start) — the supervisor-"
+        "relaunch contract, docs/RESILIENCE.md",
+    )
     t.add_argument(
         "--weights",
         help="pretrained params (.msgpack from import-caffemodel) to "
@@ -1013,6 +1080,42 @@ def main(argv: Optional[list] = None) -> int:
         "with --weights for the matching .caffemodel parameters",
     )
     t.add_argument("--snapshot_prefix", help="override snapshot prefix")
+    t.add_argument(
+        "--snapshot-keep", dest="snapshot_keep", type=int, metavar="N",
+        help="retention GC: keep only the newest N committed snapshots "
+        "(default: solver snapshot_max_keep; 0 keeps all)",
+    )
+    t.add_argument(
+        "--divergence-patience", dest="divergence_patience", type=int,
+        default=0, metavar="N",
+        help="arm the divergence guard: N consecutive non-finite losses "
+        "trigger --divergence-action (0 = off; costs one host sync per "
+        "step when armed)",
+    )
+    t.add_argument(
+        "--divergence-action", dest="divergence_action",
+        choices=["rollback", "halt"], default="rollback",
+        help="guard action: rollback restores the newest valid snapshot "
+        "(bounded by --divergence-max-rollbacks), halt stops with a "
+        "diagnosis",
+    )
+    t.add_argument(
+        "--divergence-lr-scale", dest="divergence_lr_scale", type=float,
+        default=1.0, metavar="S",
+        help="multiply base_lr by S on each rollback (e.g. 0.5 halves "
+        "the lr so the trajectory doesn't re-diverge)",
+    )
+    t.add_argument(
+        "--divergence-max-rollbacks", dest="divergence_max_rollbacks",
+        type=int, default=2, metavar="N",
+        help="rollbacks allowed before the guard halts anyway",
+    )
+    t.add_argument(
+        "--no-preempt-handler", dest="no_preempt_handler",
+        action="store_true",
+        help="do not install the SIGTERM/SIGINT graceful-preemption "
+        "handler (emergency snapshot + exit 75)",
+    )
     t.add_argument(
         "--synthetic", action="store_true",
         help="train on synthetic identity-balanced clusters instead of the "
@@ -1085,7 +1188,11 @@ def main(argv: Optional[list] = None) -> int:
             default="auto", help="see train --sim-cache",
         )
         sp.add_argument("--bf16", action="store_true")
-        sp.add_argument("--resume", help="snapshot path to restore")
+        sp.add_argument(
+            "--resume",
+            help="snapshot path to restore, or 'auto' for the newest "
+            "valid one under snapshot_prefix (see train --resume)",
+        )
         sp.add_argument("--synthetic", action="store_true")
         sp.add_argument(
             "--native", choices=["auto", "never", "require"],

@@ -5,6 +5,7 @@ from npairloss_tpu.data.dataset import ArrayDataset, ListFileDataset
 from npairloss_tpu.data.loader import (
     MultibatchLoader,
     NativeMultibatchLoader,
+    PrefetchWorkerError,
     multibatch_loader,
 )
 from npairloss_tpu.data.sampler import IdentityBalancedSampler
@@ -20,6 +21,7 @@ __all__ = [
     "ListFileDataset",
     "MultibatchLoader",
     "NativeMultibatchLoader",
+    "PrefetchWorkerError",
     "multibatch_loader",
     "IdentityBalancedSampler",
     "synthetic_identity_batches",

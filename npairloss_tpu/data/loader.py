@@ -11,6 +11,7 @@ waits on input.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import weakref
@@ -23,6 +24,28 @@ from npairloss_tpu.config.schema import DataLayerConfig, TransformerConfig
 from npairloss_tpu.data.dataset import ArrayDataset, ListFileDataset
 from npairloss_tpu.data.sampler import IdentityBalancedSampler
 from npairloss_tpu.data.transforms import augment
+from npairloss_tpu.resilience import failpoints
+
+log = logging.getLogger("npairloss_tpu.data")
+
+
+class PrefetchWorkerError(RuntimeError):
+    """The prefetch worker died more times than the respawn budget
+    allows; carries the failing batch index and respawn count so a
+    pod-scale log names *where* the pipeline died, not just that it
+    did."""
+
+
+class _WorkerFailure:
+    """Queue marker for a worker death: the exception plus the batch
+    index it died on (consumed by ``__next__``, which respawns or
+    raises with context)."""
+
+    __slots__ = ("exc", "batch_index")
+
+    def __init__(self, exc: BaseException, batch_index: int):
+        self.exc = exc
+        self.batch_index = batch_index
 
 
 def _identity_counts(cfg: DataLayerConfig) -> Tuple[int, int]:
@@ -46,6 +69,7 @@ class MultibatchLoader:
         train: bool = True,
         seed: int = 0,
         prefetch: int = 2,
+        max_worker_restarts: int = 3,
     ):
         self.dataset = dataset
         self.cfg = cfg
@@ -63,6 +87,19 @@ class MultibatchLoader:
         self._key = jax.random.PRNGKey(seed)
         self._queue: queue.Queue = queue.Queue(maxsize=max(prefetch, 1))
         self._stop = threading.Event()
+        # Bounded fault tolerance (docs/RESILIENCE.md): a worker death
+        # respawns the thread up to ``max_worker_restarts`` CONSECUTIVE
+        # times before surfacing a PrefetchWorkerError with the batch
+        # context; a successfully delivered batch resets the budget, so
+        # sparse transient errors over a multi-day run never accumulate
+        # into an abort while a deterministic failure still dies after
+        # max_worker_restarts + 1 attempts.
+        self.max_worker_restarts = max_worker_restarts
+        self._respawns = 0
+        self._batch_seq = 0  # written by the (single) worker thread only
+        self._spawn_worker()
+
+    def _spawn_worker(self):
         # The worker holds only a weakref to the loader, so an abandoned
         # loader (no close()) is still garbage-collectable; __del__ then
         # stops the thread.
@@ -76,25 +113,41 @@ class MultibatchLoader:
     # -- host side: sample + decode (see _prefetch_worker) -----------------
 
     def _produce_one(self):
+        failpoints.fire("data.worker")
         idx = next(self.sampler)
         images = self.dataset.load_batch(idx).astype(np.float32)
         labels = self.dataset.labels[idx].astype(np.int32)
+        self._batch_seq += 1
         return images, labels
-
-
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         return self
 
     def __next__(self):
-        if self._stop.is_set():
-            raise StopIteration("loader is closed")
-        item = self._queue.get()
-        if isinstance(item, BaseException):
-            self._stop.set()
-            raise RuntimeError("data prefetch worker failed") from item
-        images, labels = item
-        return _maybe_augment(self, images), labels
+        while True:
+            if self._stop.is_set():
+                raise StopIteration("loader is closed")
+            item = self._queue.get()
+            if isinstance(item, _WorkerFailure):
+                if self._respawns < self.max_worker_restarts:
+                    self._respawns += 1
+                    log.warning(
+                        "data prefetch worker died at batch %d (%s: %s); "
+                        "respawning (%d/%d)",
+                        item.batch_index, type(item.exc).__name__,
+                        item.exc, self._respawns, self.max_worker_restarts,
+                    )
+                    self._spawn_worker()
+                    continue
+                self._stop.set()
+                raise PrefetchWorkerError(
+                    f"data prefetch worker failed at batch "
+                    f"{item.batch_index} after {self._respawns} "
+                    f"respawns: {type(item.exc).__name__}: {item.exc}"
+                ) from item.exc
+            images, labels = item
+            self._respawns = 0  # healthy batch: the budget is per-streak
+            return _maybe_augment(self, images), labels
 
     def close(self):
         self._stop.set()
@@ -142,7 +195,9 @@ def _prefetch_worker(loader_ref, q: queue.Queue, stop: threading.Event):
             item = loader._produce_one()
             fatal = False
         except BaseException as exc:  # surface in __next__, not silently
-            item, fatal = exc, True
+            # Wrapped with the batch index so the consumer can respawn
+            # (bounded) or raise with context instead of a bare error.
+            item, fatal = _WorkerFailure(exc, loader._batch_seq), True
         del loader  # no strong ref while blocking on the queue
         if not put(item) or fatal:
             return

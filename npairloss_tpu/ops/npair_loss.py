@@ -549,7 +549,12 @@ def _forward_core(
                 labels, axis_name, axis=0, tiled=True
             )
         rank = jax.lax.axis_index(axis_name).astype(jnp.int32)
-        num_shards = jax.lax.axis_size(axis_name)
+        # Trace-time import: ops must not import the parallel package at
+        # module level (parallel.mesh imports this module), and the
+        # axis-size API moved across jax releases (parallel/_compat).
+        from npairloss_tpu.parallel._compat import axis_size
+
+        num_shards = axis_size(axis_name)
 
     # Similarity matrix S = F_local @ F_total^T on the MXU (cu:218,
     # dot_normalizer = 1 in forward per cu:216).  HIGHEST (the default —

@@ -1,6 +1,7 @@
 """Distribution: multi-process runtime, device-mesh plumbing +
 ring-blockwise negative pooling."""
 
+from npairloss_tpu.parallel._compat import shard_map
 from npairloss_tpu.parallel.distributed import (
     initialize_distributed,
     process_local_batch,
@@ -25,4 +26,5 @@ __all__ = [
     "sharded_npair_loss_fn",
     "ring_npair_loss_and_metrics",
     "ring_supported",
+    "shard_map",
 ]

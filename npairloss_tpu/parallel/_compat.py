@@ -1,0 +1,63 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map.shard_map``
+to ``jax.shard_map``: new jax releases only ship the top-level name,
+older ones only the experimental module.  Every call site in this repo
+(and its tests/benches) imports the resolved symbol from here so the
+codebase runs on both sides of the move.
+
+All call sites must pass ``mesh=``/``in_specs=``/``out_specs=`` as
+keywords — the positional signatures differ across versions, the
+keyword ones do not.
+
+``axis_size`` is the same story one level down: new jax ships
+``jax.lax.axis_size(name)``; older releases spell the static size
+lookup ``jax.core.axis_frame(name)`` (which returns the int directly).
+This module imports only jax, so anything in the repo may import it
+without cycles.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _resolve_shard_map():
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as experimental
+
+    return experimental
+
+
+def _resolve_axis_size():
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn
+
+    def axis_size(axis_name):
+        return jax.core.axis_frame(axis_name)
+
+    return axis_size
+
+
+def _resolve_pvary():
+    # Replicated->varying cast for shard_map's manual-axes rep tracking:
+    # current jax spells it jax.lax.pvary, one era spelled it
+    # jax.lax.pcast(..., to="varying"), and releases before the varying
+    # type system (<= 0.4.x) need no cast at all — identity.
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None:
+        return fn
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return lambda x, axis_names: pcast(x, axis_names, to="varying")
+    return lambda x, axis_names: x
+
+
+shard_map = _resolve_shard_map()
+axis_size = _resolve_axis_size()
+pvary = _resolve_pvary()
+
+__all__ = ["axis_size", "pvary", "shard_map"]

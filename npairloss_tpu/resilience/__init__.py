@@ -1,0 +1,73 @@
+"""Fault-tolerance subsystem (docs/RESILIENCE.md).
+
+Multi-day metric-learning runs on a pod die to preemptions, transient
+I/O, data-worker crashes, and numeric divergence long before they die
+to bugs.  This package makes the Solver survive all four:
+
+  * ``resilience.snapshot`` — atomic snapshot commit (tmp dir + per-
+    array checksum manifest + fsync + rename), torn-snapshot
+    validation, newest-valid discovery, retention GC;
+  * ``resilience.retrying`` — jittered exponential backoff around
+    snapshot I/O and worker respawn;
+  * ``resilience.preempt`` — SIGTERM/SIGINT -> finish the step,
+    emergency snapshot, exit :data:`EXIT_PREEMPTED` so a supervisor
+    relaunches with ``--resume auto``;
+  * ``resilience.guard`` — N consecutive non-finite losses -> rollback
+    to the last valid snapshot (optionally lr-scaled) or halt;
+  * ``resilience.failpoints`` — named fault-injection points
+    (``NPAIRLOSS_FAILPOINTS`` env or programmatic) that make every
+    behavior above deterministically testable without real faults.
+
+``failpoints``/``retrying`` are jax-free; ``snapshot`` needs jax for
+tree flattening only.  Recovery events (``retry``/``rollback``/
+``preempt``/``resume_skip``) flow through ``obs.run.RunTelemetry``.
+"""
+
+from npairloss_tpu.resilience import failpoints
+from npairloss_tpu.resilience.failpoints import InjectedFault
+from npairloss_tpu.resilience.guard import (
+    DivergenceConfig,
+    DivergenceError,
+    DivergenceGuard,
+)
+from npairloss_tpu.resilience.preempt import (
+    EXIT_PREEMPTED,
+    PreemptionSignal,
+    TrainingPreempted,
+)
+from npairloss_tpu.resilience.retrying import RetryPolicy, call_with_retry
+from npairloss_tpu.resilience.snapshot import (
+    SnapshotError,
+    SnapshotValidationError,
+    commit_snapshot,
+    gc_snapshots,
+    list_snapshots,
+    quarantine_snapshots,
+    read_manifest,
+    state_checksums,
+    validate_snapshot,
+    verify_restored,
+)
+
+__all__ = [
+    "EXIT_PREEMPTED",
+    "DivergenceConfig",
+    "DivergenceError",
+    "DivergenceGuard",
+    "InjectedFault",
+    "PreemptionSignal",
+    "RetryPolicy",
+    "SnapshotError",
+    "SnapshotValidationError",
+    "TrainingPreempted",
+    "call_with_retry",
+    "commit_snapshot",
+    "failpoints",
+    "gc_snapshots",
+    "list_snapshots",
+    "quarantine_snapshots",
+    "read_manifest",
+    "state_checksums",
+    "validate_snapshot",
+    "verify_restored",
+]

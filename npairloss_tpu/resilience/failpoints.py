@@ -1,0 +1,165 @@
+"""Named failpoints — deterministic fault injection for resilience tests.
+
+A failpoint is a named site in the codebase where a fault *may* be
+injected: the call site asks ``should_fire(name)`` (or ``fire(name)``,
+which raises) and the registry answers based on what tests or the
+environment armed.  Production runs pay one dict lookup per site; an
+unarmed registry never fires.
+
+Arming, two ways:
+
+  * programmatic (tests): ``arm(name, times=N)`` / ``disarm(name)``, or
+    the ``armed(name, times=N)`` context manager;
+  * environment (CLI smoke runs): ``NPAIRLOSS_FAILPOINTS`` holds a
+    comma-separated ``name[:count]`` list, e.g.
+    ``NPAIRLOSS_FAILPOINTS="snapshot.save.io:2,data.worker"`` — parsed
+    once at first use.
+
+Failpoints wired into the framework (docs/RESILIENCE.md):
+
+  ==========================  =============================================
+  ``snapshot.save.io``        transient OSError inside the snapshot write
+                              (exercises the retry/backoff path)
+  ``snapshot.restore.io``     transient OSError inside snapshot restore
+  ``snapshot.commit.torn``    commit a snapshot whose manifest checksums
+                              are wrong — a "torn"/corrupt snapshot the
+                              resume validator must detect and skip
+  ``snapshot.commit.crash``   die after the array write but before the
+                              atomic rename (leaves only a tmp dir that
+                              resume must never see)
+  ``data.worker``             crash the data prefetch worker (exercises
+                              bounded respawn)
+  ``step.nan_loss``           replace the step's loss with NaN (exercises
+                              the divergence guard)
+  ==========================  =============================================
+
+``times`` counts fires: an armed point fires its next ``times`` checks
+then disarms itself (``times=None`` fires forever until ``disarm``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+from typing import Callable, Dict, Iterator, Optional
+
+log = logging.getLogger("npairloss_tpu.resilience")
+
+ENV_VAR = "NPAIRLOSS_FAILPOINTS"
+
+
+class InjectedFault(OSError):
+    """The default fault an armed failpoint raises.
+
+    An ``OSError`` so the transient-I/O retry paths treat an injection
+    exactly like the real thing (a full disk, a flaky NFS mount)."""
+
+    def __init__(self, name: str):
+        super().__init__(f"injected fault at failpoint {name!r}")
+        self.failpoint = name
+
+
+class _Failpoint:
+    __slots__ = ("name", "remaining", "exc_factory")
+
+    def __init__(self, name: str, remaining: Optional[int],
+                 exc_factory: Optional[Callable[[], BaseException]]):
+        self.name = name
+        self.remaining = remaining  # None = unlimited
+        self.exc_factory = exc_factory
+
+
+_LOCK = threading.Lock()
+_ARMED: Dict[str, _Failpoint] = {}
+_ENV_LOADED = False
+
+
+def _load_env_locked() -> None:
+    global _ENV_LOADED
+    if _ENV_LOADED:
+        return
+    _ENV_LOADED = True
+    spec = os.environ.get(ENV_VAR, "")
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, count = part.partition(":")
+        try:
+            times = int(count) if count else 1
+        except ValueError:
+            log.warning("%s: bad count in %r — ignored", ENV_VAR, part)
+            continue
+        _ARMED[name] = _Failpoint(name, times, None)
+        log.info("failpoint armed from env: %s (times=%d)", name, times)
+
+
+def arm(name: str, times: Optional[int] = 1,
+        exc: Optional[Callable[[], BaseException]] = None) -> None:
+    """Arm ``name`` to fire its next ``times`` checks (None = forever).
+    ``exc`` overrides the raised exception for ``fire`` sites."""
+    with _LOCK:
+        _load_env_locked()
+        _ARMED[name] = _Failpoint(name, times, exc)
+
+
+def disarm(name: str) -> None:
+    with _LOCK:
+        _ARMED.pop(name, None)
+
+
+def reset() -> None:
+    """Disarm everything and forget the env parse (test isolation)."""
+    global _ENV_LOADED
+    with _LOCK:
+        _ARMED.clear()
+        _ENV_LOADED = False
+
+
+def _take(name: str) -> Optional[_Failpoint]:
+    with _LOCK:
+        _load_env_locked()
+        fp = _ARMED.get(name)
+        if fp is None:
+            return None
+        if fp.remaining is not None:
+            if fp.remaining <= 0:  # armed with times=0: never fires
+                del _ARMED[name]
+                return None
+            fp.remaining -= 1
+            if fp.remaining == 0:
+                del _ARMED[name]
+        return fp
+
+
+def should_fire(name: str) -> bool:
+    """True when ``name`` is armed (consumes one fire).  For call sites
+    that inject by *doing* something (poisoning a value) rather than
+    raising."""
+    fired = _take(name) is not None
+    if fired:
+        log.warning("failpoint fired: %s", name)
+    return fired
+
+
+def fire(name: str) -> None:
+    """Raise the armed fault at ``name``; no-op when unarmed."""
+    fp = _take(name)
+    if fp is None:
+        return
+    log.warning("failpoint fired: %s", name)
+    raise (fp.exc_factory() if fp.exc_factory is not None
+           else InjectedFault(name))
+
+
+@contextlib.contextmanager
+def armed(name: str, times: Optional[int] = 1,
+          exc: Optional[Callable[[], BaseException]] = None) -> Iterator[None]:
+    """Scoped arming — disarms on exit even when the body raises."""
+    arm(name, times=times, exc=exc)
+    try:
+        yield
+    finally:
+        disarm(name)

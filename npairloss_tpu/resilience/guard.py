@@ -1,0 +1,73 @@
+"""Divergence guard: N consecutive non-finite losses -> rollback or halt.
+
+A diverged run on a pod burns accelerator-days producing NaNs; the
+reference had no numeric checks at all (SURVEY.md §5.2).  The guard
+watches the per-step loss on host (the one extra sync it costs is the
+reason it is opt-in) and, once ``patience`` consecutive steps are
+non-finite, either halts with a diagnosis or rolls the Solver back to
+the newest *valid* snapshot — optionally scaling the base lr down so
+the trajectory does not march straight back into the same cliff.
+Rollbacks are bounded (``max_rollbacks``); past the bound the guard
+halts, because an endlessly rolling-back run is an outage that looks
+like progress.
+
+Complements ``obs.health`` (PR 2): health signals *show* the explosion
+coming; the guard *survives* it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+ACTIONS = ("rollback", "halt")
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged and could not (or was configured not to) recover."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergenceConfig:
+    """``patience`` consecutive non-finite losses trip the guard.
+
+    ``action="rollback"`` restores the newest valid snapshot (fresh
+    optimizer trajectory from iteration k) and multiplies ``base_lr``
+    by ``lr_scale``; ``action="halt"`` raises :class:`DivergenceError`
+    immediately — the diagnostic stop for runs where silent recovery
+    would mask a real bug.
+    """
+
+    patience: int = 3
+    action: str = "rollback"
+    lr_scale: float = 1.0
+    max_rollbacks: int = 2
+
+    def __post_init__(self):
+        if self.patience < 1:
+            raise ValueError(f"patience must be >= 1, got {self.patience}")
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"action must be one of {ACTIONS}, got {self.action!r}"
+            )
+        if not (0.0 < self.lr_scale <= 1.0):
+            raise ValueError(
+                f"lr_scale must be in (0, 1], got {self.lr_scale}"
+            )
+
+
+class DivergenceGuard:
+    """Host-side streak tracker; the Solver owns the recovery action."""
+
+    def __init__(self, cfg: DivergenceConfig):
+        self.cfg = cfg
+        self.streak = 0
+        self.rollbacks = 0
+
+    def observe(self, loss: float) -> bool:
+        """Feed one step's loss; True when the guard trips."""
+        if math.isfinite(loss):
+            self.streak = 0
+            return False
+        self.streak += 1
+        return self.streak >= self.cfg.patience

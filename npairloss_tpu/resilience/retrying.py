@@ -1,0 +1,85 @@
+"""Retry with jittered exponential backoff — the one retry primitive.
+
+Snapshot save/restore I/O and prefetch-worker respawn all retry through
+``call_with_retry`` so the schedule (exponential growth, cap, full
+decorrelated jitter) and the logging are defined exactly once.  The
+clock and the randomness are injectable, so tests pin the schedule with
+a fake ``sleep`` and a seeded ``rng`` instead of real waiting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+log = logging.getLogger("npairloss_tpu.resilience")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff: attempt ``k`` (1-based) failing
+    sleeps ``min(base_delay * multiplier**(k-1), max_delay)`` scaled by
+    ``1 ± jitter`` before attempt ``k+1``; after ``max_attempts`` the
+    last error propagates.
+
+    ``retry_on`` bounds what counts as transient — everything else
+    (a shape mismatch, a KeyboardInterrupt) propagates immediately.
+    """
+
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.25
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.jitter < 0 or self.jitter > 1:
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+
+    def delay(self, attempt: int,
+              rng: Optional[random.Random] = None) -> float:
+        """Backoff before the retry that follows failed attempt
+        ``attempt`` (1-based)."""
+        d = min(self.base_delay * self.multiplier ** (attempt - 1),
+                self.max_delay)
+        if self.jitter:
+            u = (rng.random() if rng is not None else random.random())
+            d *= 1.0 + self.jitter * (2.0 * u - 1.0)
+        return max(d, 0.0)
+
+
+def call_with_retry(
+    fn: Callable[[], Any],
+    policy: Optional[RetryPolicy] = None,
+    *,
+    describe: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+    on_retry: Optional[Callable[[int, float, BaseException], None]] = None,
+) -> Any:
+    """Run ``fn`` under ``policy``; returns its result or re-raises the
+    final error.  ``on_retry(attempt, delay_s, exc)`` fires before each
+    backoff sleep (telemetry hook)."""
+    policy = policy if policy is not None else RetryPolicy()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except policy.retry_on as e:
+            if attempt >= policy.max_attempts:
+                raise
+            d = policy.delay(attempt, rng)
+            log.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                describe, attempt, policy.max_attempts, e, d,
+            )
+            if on_retry is not None:
+                on_retry(attempt, d, e)
+            sleep(d)

@@ -1,0 +1,325 @@
+"""Atomic snapshot commit, validation, and retention GC.
+
+The commit protocol (docs/RESILIENCE.md):
+
+  1. write the Orbax checkpoint into ``<final>.tmp-<pid>-<nonce>``
+     (retried under the caller's :class:`~.retrying.RetryPolicy` —
+     transient I/O must not abort a run);
+  2. wait for the async save to land, then write ``manifest.json``
+     inside the tmp dir: format tag, the solver step, and a per-array
+     CRC-32 + shape/dtype record for every leaf of the state tree
+     (written via its own write-fsync-rename so the manifest itself can
+     never be torn);
+  3. fsync and ``os.replace`` the tmp dir onto the final name.
+
+The rename is the commit point: a snapshot either exists at its final
+name complete-with-manifest, or it does not exist at all.  A crash at
+any earlier point leaves only a ``.tmp-`` dir, which the resume scan
+never matches; a snapshot that *is* at its final name but fails
+manifest validation (bit rot, a partial copy, an injected
+``snapshot.commit.torn``) is detected by checksum and skipped.
+
+Validation is two-phase because recomputing checksums requires the
+array bytes: :func:`validate_snapshot` is the cheap structural check
+(manifest present, parses, right format), and :func:`verify_restored`
+compares the restored tree's checksums against the manifest after an
+Orbax restore.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import shutil
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from npairloss_tpu.resilience import failpoints
+from npairloss_tpu.resilience.retrying import RetryPolicy, call_with_retry
+
+log = logging.getLogger("npairloss_tpu.resilience")
+
+MANIFEST_NAME = "manifest.json"
+SNAPSHOT_FORMAT = "npairloss-snapshot-v1"
+TMP_MARKER = ".tmp-"
+QUARANTINE_SUFFIX = ".quarantined"
+# Solver.snapshot_path naming: <prefix>iter_<step>.ckpt
+_STEP_RE = r"iter_(\d+)\.ckpt"
+
+
+class SnapshotError(RuntimeError):
+    """A snapshot could not be committed or restored."""
+
+
+class SnapshotValidationError(SnapshotError):
+    """A snapshot on disk is torn/corrupt (failed manifest validation)."""
+
+
+# -- checksums ------------------------------------------------------------
+
+
+def _leaf_items(tree: Any) -> List[Tuple[str, Any]]:
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves]
+
+
+def state_checksums(tree: Any) -> Dict[str, Dict[str, Any]]:
+    """Per-leaf CRC-32 + shape/dtype over the host bytes of ``tree``.
+
+    CRC-32 (not a cryptographic hash): the threat model is torn writes
+    and bit rot, not tampering, and crc32 streams at memory bandwidth.
+    """
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, leaf in _leaf_items(tree):
+        a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        out[key] = {
+            "crc32": zlib.crc32(a.tobytes()) & 0xFFFFFFFF,
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+        }
+    return out
+
+
+def verify_restored(tree: Any, manifest: Dict[str, Any]) -> None:
+    """Compare a restored state tree against its manifest; raises
+    :class:`SnapshotValidationError` naming the first mismatches."""
+    want = manifest.get("arrays", {})
+    got = state_checksums(tree)
+    if set(want) != set(got):
+        missing = sorted(set(want) - set(got))[:3]
+        extra = sorted(set(got) - set(want))[:3]
+        raise SnapshotValidationError(
+            f"array set mismatch (missing={missing}, unexpected={extra})"
+        )
+    bad = [k for k in want if want[k]["crc32"] != got[k]["crc32"]]
+    if bad:
+        raise SnapshotValidationError(
+            f"checksum mismatch on {len(bad)} array(s), "
+            f"e.g. {sorted(bad)[:3]}"
+        )
+
+
+# -- manifest -------------------------------------------------------------
+
+
+def _fsync_dir(path: str) -> None:
+    # Directory fsync makes the rename durable; best-effort because not
+    # every filesystem supports it (and a lost-on-power-cut snapshot is
+    # exactly what the validator + older snapshots exist to absorb).
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_manifest(snapshot_dir: str, step: int,
+                   checksums: Dict[str, Dict[str, Any]],
+                   extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write ``manifest.json`` into ``snapshot_dir`` atomically
+    (tmp file + fsync + rename)."""
+    manifest = {
+        "format": SNAPSHOT_FORMAT,
+        "step": int(step),
+        "created": time.time(),
+        "arrays": checksums,
+    }
+    if extra:
+        manifest.update(extra)
+    path = os.path.join(snapshot_dir, MANIFEST_NAME)
+    tmp = path + ".part"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(snapshot_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(snapshot_dir, MANIFEST_NAME),
+              encoding="utf-8") as f:
+        return json.load(f)
+
+
+def validate_snapshot(path: str) -> Dict[str, Any]:
+    """Structural validation: committed dir with a parseable manifest of
+    the right format.  Returns the manifest; raises
+    :class:`SnapshotValidationError` with the reason otherwise."""
+    if not os.path.isdir(path):
+        raise SnapshotValidationError(f"not a snapshot directory: {path}")
+    if TMP_MARKER in os.path.basename(path):
+        raise SnapshotValidationError(f"uncommitted tmp snapshot: {path}")
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        raise SnapshotValidationError(
+            "no manifest.json (torn commit, or a pre-resilience snapshot)"
+        )
+    try:
+        manifest = read_manifest(path)
+    except (OSError, ValueError) as e:
+        raise SnapshotValidationError(f"unreadable manifest: {e}") from e
+    if manifest.get("format") != SNAPSHOT_FORMAT:
+        raise SnapshotValidationError(
+            f"unknown manifest format {manifest.get('format')!r}"
+        )
+    if not isinstance(manifest.get("step"), int):
+        raise SnapshotValidationError("manifest carries no integer step")
+    if not isinstance(manifest.get("arrays"), dict):
+        raise SnapshotValidationError("manifest carries no array records")
+    return manifest
+
+
+# -- commit ---------------------------------------------------------------
+
+
+def commit_snapshot(
+    checkpointer,
+    final_path: str,
+    state: Any,
+    step: int,
+    *,
+    policy: Optional[RetryPolicy] = None,
+    on_retry=None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write ``state`` as a committed snapshot at ``final_path``.
+
+    ``checkpointer`` is an Orbax ``StandardCheckpointer`` (or anything
+    with ``save(path, state, force=) -> None`` + ``wait_until_finished``).
+    Returns ``final_path``; on failure nothing exists at ``final_path``
+    (a ``.tmp-`` dir may be left for post-mortem and is ignored by the
+    resume scan; the next commit attempt reuses its own fresh nonce).
+    """
+    final_path = os.path.abspath(final_path)
+    parent = os.path.dirname(final_path)
+    os.makedirs(parent, exist_ok=True)
+    tmp = (f"{final_path}{TMP_MARKER}{os.getpid()}-"
+           f"{os.urandom(2).hex()}")
+
+    def do_save():
+        failpoints.fire("snapshot.save.io")
+        checkpointer.save(tmp, state, force=True)
+        checkpointer.wait_until_finished()
+
+    call_with_retry(
+        do_save, policy, describe=f"snapshot save ({final_path})",
+        on_retry=on_retry,
+    )
+    checks = state_checksums(state)
+    if failpoints.should_fire("snapshot.commit.torn"):
+        # Deterministic "torn snapshot": commit with poisoned
+        # checksums so the resume validator must catch and skip it.
+        for rec in checks.values():
+            rec["crc32"] = (rec["crc32"] + 1) & 0xFFFFFFFF
+    write_manifest(tmp, step, checks, extra=extra)
+    # On any failure up to here the tmp dir never reached its final
+    # name: the run sees the error, the resume scan never sees the dir
+    # (it is left for post-mortem; retention GC sweeps stale ones).
+    failpoints.fire("snapshot.commit.crash")
+    if os.path.isdir(final_path):
+        # Re-committing the same step (emergency snapshot on a cadence
+        # boundary): the rename target must not exist.
+        shutil.rmtree(final_path)
+    os.replace(tmp, final_path)
+    _fsync_dir(parent)
+    return final_path
+
+
+# -- discovery + GC -------------------------------------------------------
+
+
+def list_snapshots(snapshot_prefix: str) -> List[Tuple[int, str]]:
+    """Committed snapshot candidates for a ``snapshot_prefix``, as
+    ``(step, path)`` sorted by step ascending.  Tmp dirs never match."""
+    prefix = os.path.abspath(snapshot_prefix)
+    parent, base = os.path.dirname(prefix), os.path.basename(prefix)
+    pat = re.compile(re.escape(base) + _STEP_RE + r"$")
+    out: List[Tuple[int, str]] = []
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return out
+    for name in entries:
+        m = pat.match(name)
+        path = os.path.join(parent, name)
+        if m and os.path.isdir(path):
+            out.append((int(m.group(1)), path))
+    out.sort()
+    return out
+
+
+def gc_snapshots(snapshot_prefix: str, max_keep: int) -> List[str]:
+    """Retention GC: delete committed snapshots beyond the newest
+    ``max_keep`` (``max_keep <= 0`` keeps every committed snapshot),
+    then ALWAYS sweep stale ``.tmp-`` debris from failed commits and
+    ``.quarantined`` dirs a past rollback deemed poisoned — those are
+    full-checkpoint-sized and reclaimable regardless of the retention
+    setting.  Best-effort: a dir that refuses to delete is logged and
+    left, never fatal.  Safe single-writer assumption: GC runs right
+    after a successful commit in the saving process, so no save is in
+    flight."""
+    deleted: List[str] = []
+    if max_keep > 0:
+        snaps = list_snapshots(snapshot_prefix)
+        for step, path in snaps[:-max_keep] if len(snaps) > max_keep else []:
+            try:
+                shutil.rmtree(path)
+                deleted.append(path)
+                log.info("snapshot GC: removed iter-%d (%s)", step, path)
+            except OSError as e:
+                log.warning("snapshot GC: could not remove %s: %s", path, e)
+    prefix = os.path.abspath(snapshot_prefix)
+    parent, base = os.path.dirname(prefix), os.path.basename(prefix)
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return deleted
+    for name in entries:
+        if name.startswith(base) and (
+            TMP_MARKER in name or name.endswith(QUARANTINE_SUFFIX)
+        ):
+            path = os.path.join(parent, name)
+            try:
+                shutil.rmtree(path)
+                deleted.append(path)
+                log.info("snapshot GC: removed stale %s", path)
+            except OSError as e:
+                log.warning("snapshot GC: could not remove %s: %s", path, e)
+    return deleted
+
+
+def quarantine_snapshots(snapshot_prefix: str, min_step: int) -> List[str]:
+    """Rename committed snapshots with step > ``min_step`` out of the
+    resume scan's namespace (``<dir>.quarantined``) — used by divergence
+    rollback, which has just judged them poisoned: their bytes are
+    checksum-valid, so without the rename a later crash + ``--resume
+    auto`` would restore NaN-era params and dive straight back into
+    divergence.  The rename keeps them on disk for post-mortem; GC
+    reclaims them."""
+    out: List[str] = []
+    for step, path in list_snapshots(snapshot_prefix):
+        if step <= min_step:
+            continue
+        target = path + QUARANTINE_SUFFIX
+        try:
+            if os.path.isdir(target):
+                shutil.rmtree(target)
+            os.rename(path, target)
+            out.append(target)
+            log.warning("quarantined suspect snapshot iter-%d -> %s",
+                        step, target)
+        except OSError as e:
+            log.warning("could not quarantine %s: %s", path, e)
+    return out

@@ -37,6 +37,27 @@ from npairloss_tpu.obs.health import (
 )
 from npairloss_tpu.obs.run import RunTelemetry
 from npairloss_tpu.ops.metrics import retrieval_metrics
+from npairloss_tpu.parallel._compat import shard_map
+from npairloss_tpu.resilience import failpoints
+from npairloss_tpu.resilience.guard import (
+    DivergenceConfig,
+    DivergenceError,
+    DivergenceGuard,
+)
+from npairloss_tpu.resilience.preempt import PreemptionSignal, TrainingPreempted
+from npairloss_tpu.resilience.retrying import RetryPolicy, call_with_retry
+from npairloss_tpu.resilience.snapshot import (
+    SnapshotValidationError,
+    commit_snapshot,
+    gc_snapshots,
+    list_snapshots,
+    quarantine_snapshots,
+    read_manifest,
+    state_checksums,
+    validate_snapshot,
+    verify_restored,
+    write_manifest,
+)
 from npairloss_tpu.utils.debug import assert_all_finite, debug_checks_enabled
 from npairloss_tpu.ops.npair_loss import NPairLossConfig, npair_loss_with_aux
 from npairloss_tpu.train.optim import CaffeSGDState, caffe_sgd, lr_schedule
@@ -66,6 +87,11 @@ class SolverConfig:
     snapshot: int = 5000
     snapshot_prefix: str = "./snap/model_"
     random_seed: int = 0
+    # Retention GC (docs/RESILIENCE.md): committed snapshots beyond the
+    # newest N are deleted after each successful commit; 0 keeps all
+    # (Caffe's behavior — snapshot_max_keep is this framework's own
+    # extension, not a SolverParameter field).
+    snapshot_max_keep: int = 0
 
 
 class Solver:
@@ -100,6 +126,9 @@ class Solver:
         loss_weight: float = 1.0,
         health: Optional[HealthConfig] = None,
         telemetry: Optional[RunTelemetry] = None,
+        divergence: Optional[DivergenceConfig] = None,
+        preempt: Optional[PreemptionSignal] = None,
+        snapshot_retry: Optional[RetryPolicy] = None,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
@@ -112,6 +141,17 @@ class Solver:
         # the next (re)compile.
         self.health = health
         self.telemetry = telemetry
+        # Fault-tolerance subsystem (docs/RESILIENCE.md), all plain
+        # attributes like health/telemetry: ``divergence`` arms the
+        # non-finite-loss guard (costs one host sync per step when set),
+        # ``preempt`` is the SIGTERM/SIGINT stop flag ``train`` polls
+        # once per step, ``snapshot_retry`` bounds the backoff around
+        # snapshot I/O (None = the default 3-attempt policy).
+        self.divergence = divergence
+        self.preempt = preempt
+        self.snapshot_retry = (
+            snapshot_retry if snapshot_retry is not None else RetryPolicy()
+        )
         # Batch signatures already dispatched through the jitted step/
         # eval fns: a NEW signature means jit will trace+compile before
         # dispatching, so the telemetry span is named */compile and the
@@ -334,7 +374,7 @@ class Solver:
             out = {"loss": loss, **metrics}
             return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], out)
 
-        stacked = jax.shard_map(
+        stacked = shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis)),
@@ -434,6 +474,18 @@ class Solver:
                 "telemetry metric emission failed (disabling for the "
                 "rest of the run): %s", e,
             )
+
+    def _tel_event(self, kind: str, step: int, **extra) -> None:
+        """Resilience events (``retry``/``rollback``/``preempt``/
+        ``resume_skip``) through the telemetry pipeline: one metrics row
+        with ``phase="event"`` plus an instant marker on the span
+        timeline — both no-ops without telemetry attached."""
+        tel = self.telemetry
+        if tel is None:
+            return
+        args = {k: v for k, v in extra.items() if v is not None}
+        tel.instant(f"resilience/{kind}", **args)
+        self._tel_log("event", step, {"event": kind, **args})
 
     # -- public API -------------------------------------------------------
 
@@ -570,62 +622,162 @@ class Solver:
                            **{k: float(v) for k, v in m.items()}})
         tel = self.telemetry
         last = {}
-        for it in range(start, num_iters):
-            with self._span("data/next_batch"):
-                inputs, labels = next(train_batches)
-            # Keep metrics as device scalars so the loop never blocks on a
-            # host sync; floats are materialized only at display/test/return
-            # boundaries (JAX async dispatch keeps the TPU pipeline full) —
-            # UNLESS per-step telemetry is attached, whose one-row-per-step
-            # contract requires materializing here (the recorded cost; see
-            # docs/OBSERVABILITY.md).
-            metrics = self.step(inputs, labels)
-            self._loss_window.append(metrics["loss"])
-            last = metrics
-            step_num = int(it) + 1
-            if tel is not None and tel.metrics_enabled \
-                    and not self._telemetry_failed:
-                self._tel_log("train", step_num,
-                              {k: float(v) for k, v in metrics.items()})
-            if cfg.display and step_num % cfg.display == 0:
-                host = {k: float(v) for k, v in last.items()}
-                avg = float(jnp.stack(list(self._loss_window)).mean())
-                log_fn(
-                    f"iter {step_num} lr={host.get('lr', 0):.6g} "
-                    f"loss={avg:.6g} (avg over {len(self._loss_window)}) "
-                    + _fmt({k: v for k, v in host.items() if k not in ('loss', 'lr')})
-                )
-                if record_fn is not None:
-                    record_fn({"event": "display", "iteration": step_num,
-                               "loss_avg": avg, **host})
-            if (
-                test_batches is not None
-                and cfg.test_interval
-                and step_num % cfg.test_interval == 0
-            ):
-                m = self.evaluate(test_batches, cfg.test_iter)
-                log_fn(f"iter {step_num} TEST {_fmt(m)}")
-                if record_fn is not None:
-                    record_fn({"event": "test", "iteration": step_num,
-                               **{k: float(v) for k, v in m.items()}})
-            if cfg.snapshot and step_num % cfg.snapshot == 0:
-                self.save_snapshot(step_num)
-                if record_fn is not None:
-                    record_fn({"event": "snapshot", "iteration": step_num})
-        if self._checkpointer is not None:
-            # Async Orbax saves must land before the process can exit, or the
-            # final snapshot is left as an .orbax-checkpoint-tmp dir.
-            self._checkpointer.wait_until_finished()
-        if tel is not None:
-            # Land metrics.jsonl/trace.json even when the owner forgets
-            # close() — flush is idempotent and the owner may keep
-            # logging.  Guarded like _tel_log: a full disk must not
-            # swallow a completed run's final metrics.
-            try:
-                tel.flush()
-            except Exception as e:  # noqa: BLE001
-                log.error("telemetry flush failed: %s", e)
+        guard = (DivergenceGuard(self.divergence)
+                 if self.divergence is not None else None)
+        try:
+            it = start
+            while it < num_iters:
+                with self._span("data/next_batch"):
+                    inputs, labels = next(train_batches)
+                # Keep metrics as device scalars so the loop never blocks
+                # on a host sync; floats are materialized only at display/
+                # test/return boundaries (JAX async dispatch keeps the TPU
+                # pipeline full) — UNLESS per-step telemetry or the
+                # divergence guard is attached; both require materializing
+                # here (the recorded cost; see docs/OBSERVABILITY.md).
+                metrics = self.step(inputs, labels)
+                step_num = int(it) + 1
+                if failpoints.should_fire("step.nan_loss"):
+                    metrics = dict(metrics)
+                    metrics["loss"] = jnp.float32(float("nan"))
+                self._loss_window.append(metrics["loss"])
+                last = metrics
+                if guard is not None and \
+                        guard.observe(float(metrics["loss"])):
+                    it = self._handle_divergence(
+                        guard, step_num, log_fn, record_fn
+                    )
+                    continue
+                if tel is not None and tel.metrics_enabled \
+                        and not self._telemetry_failed:
+                    self._tel_log("train", step_num,
+                                  {k: float(v) for k, v in metrics.items()})
+                if cfg.display and step_num % cfg.display == 0:
+                    host = {k: float(v) for k, v in last.items()}
+                    avg = float(jnp.stack(list(self._loss_window)).mean())
+                    log_fn(
+                        f"iter {step_num} lr={host.get('lr', 0):.6g} "
+                        f"loss={avg:.6g} (avg over {len(self._loss_window)}) "
+                        + _fmt({k: v for k, v in host.items() if k not in ('loss', 'lr')})
+                    )
+                    if record_fn is not None:
+                        record_fn({"event": "display", "iteration": step_num,
+                                   "loss_avg": avg, **host})
+                if (
+                    test_batches is not None
+                    and cfg.test_interval
+                    and step_num % cfg.test_interval == 0
+                ):
+                    m = self.evaluate(test_batches, cfg.test_iter)
+                    log_fn(f"iter {step_num} TEST {_fmt(m)}")
+                    if record_fn is not None:
+                        record_fn({"event": "test", "iteration": step_num,
+                                   **{k: float(v) for k, v in m.items()}})
+                snapped = None
+                if cfg.snapshot and step_num % cfg.snapshot == 0:
+                    snapped = self.save_snapshot(step_num)
+                    if record_fn is not None:
+                        record_fn({"event": "snapshot",
+                                   "iteration": step_num})
+                if self.preempt is not None and self.preempt.requested:
+                    # Graceful preemption: the in-flight step finished
+                    # above; commit an emergency snapshot (unless the
+                    # cadence just did) and surface a typed stop the CLI
+                    # maps to EXIT_PREEMPTED for the supervisor.
+                    path = snapped or self.save_snapshot(step_num)
+                    log_fn(
+                        f"preempted at iter {step_num}: emergency "
+                        f"snapshot {path}; relaunch with --resume auto"
+                    )
+                    self._tel_event("preempt", step_num,
+                                    snapshot=path,
+                                    signum=self.preempt.signum)
+                    if record_fn is not None:
+                        record_fn({"event": "preempt",
+                                   "iteration": step_num,
+                                   "snapshot": path})
+                    raise TrainingPreempted(
+                        step_num, snapshot_path=path,
+                        signum=self.preempt.signum,
+                    )
+                it = step_num
+        finally:
+            # EVERY exit path — normal completion, preemption, a raised
+            # step error — must land in-flight Orbax work before the
+            # process can exit, or the last snapshot is left as an
+            # .orbax-checkpoint-tmp dir.  Guarded: cleanup must not mask
+            # the in-flight exception.
+            if self._checkpointer is not None:
+                try:
+                    self._checkpointer.wait_until_finished()
+                except Exception as e:  # noqa: BLE001
+                    log.error("checkpointer drain failed: %s", e)
+            if tel is not None:
+                # Land metrics.jsonl/trace.json even when the owner
+                # forgets close() — flush is idempotent and the owner may
+                # keep logging.  Guarded like _tel_log: a full disk must
+                # not swallow a completed run's final metrics.
+                try:
+                    tel.flush()
+                except Exception as e:  # noqa: BLE001
+                    log.error("telemetry flush failed: %s", e)
         return {k: float(v) for k, v in last.items()}
+
+    def _handle_divergence(self, guard, step_num: int, log_fn,
+                           record_fn) -> int:
+        """Guard tripped at ``step_num``: roll back to the newest valid
+        snapshot (optionally lr-scaled) or halt.  Returns the iteration
+        to continue from."""
+        dcfg = self.divergence
+        reason = (f"{guard.streak} consecutive non-finite losses "
+                  f"at iteration {step_num}")
+        if dcfg.action != "rollback" or guard.rollbacks >= dcfg.max_rollbacks:
+            why = (reason if dcfg.action != "rollback"
+                   else f"{reason} (rollback budget "
+                        f"{dcfg.max_rollbacks} exhausted)")
+            self._tel_event("divergence_halt", step_num, reason=why)
+            raise DivergenceError(f"training diverged: {why}")
+        guard.rollbacks += 1
+        # A snapshot taken during the non-finite streak captured poisoned
+        # params — and so may the one right before it: the first NaN loss
+        # at step f implicates the update of step f-1 (finite loss does
+        # not guarantee finite grads).  Only snapshots strictly older
+        # than f-1 are trustworthy rollback targets.
+        max_step = step_num - guard.streak - 1
+        guard.streak = 0
+        restored = self.restore_auto(max_step=max_step)
+        if restored is None:
+            raise DivergenceError(
+                f"training diverged ({reason}) and no valid snapshot "
+                f"at iteration <= {max_step} under "
+                f"{self.cfg.snapshot_prefix!r} to roll back to"
+            )
+        # The excluded snapshots are checksum-valid but NaN-poisoned:
+        # left in place, a later crash + --resume auto would restore
+        # them newest-first and dive straight back into divergence.
+        quarantine_snapshots(self.cfg.snapshot_prefix, max_step)
+        if dcfg.lr_scale != 1.0:
+            # The cfg setter rebuilds schedule + optimizer and drops the
+            # jitted step, so the scaled lr takes effect at recompile.
+            self.cfg = dataclasses.replace(
+                self.cfg, base_lr=self.cfg.base_lr * dcfg.lr_scale
+            )
+        else:
+            # cfg unchanged: clear the NaN-poisoned loss window by hand.
+            self._loss_window.clear()
+        resumed = self.iteration
+        msg = (f"divergence: {reason}; rolled back to iteration {resumed} "
+               f"({restored}), lr={self.cfg.base_lr:.6g} "
+               f"[rollback {guard.rollbacks}/{dcfg.max_rollbacks}]")
+        log.warning(msg)
+        log_fn(msg)
+        self._tel_event("rollback", step_num, to_iteration=resumed,
+                        snapshot=restored, base_lr=float(self.cfg.base_lr),
+                        rollback=guard.rollbacks)
+        if record_fn is not None:
+            record_fn({"event": "rollback", "iteration": step_num,
+                       "to_iteration": resumed, "snapshot": restored})
+        return resumed
 
     # -- checkpointing (Orbax; Caffe snapshot contract) --------------------
 
@@ -645,10 +797,43 @@ class Solver:
         return os.path.abspath(f"{prefix}iter_{step}.ckpt")
 
     def save_snapshot(self, step: int) -> str:
+        """Commit the snapshot for ``step`` atomically (tmp dir +
+        checksum manifest + rename — resilience.snapshot), retrying
+        transient I/O under ``snapshot_retry``, then apply retention GC
+        (``cfg.snapshot_max_keep``).
+
+        Multi-controller runs cannot use the tmp-dir commit (Orbax's
+        ``save`` is a collective every rank must enter with the SAME
+        path, and per-rank tmp dirs would race the rename): they rely
+        on Orbax's own multihost tmp/rename atomicity on the final
+        path, with rank 0 adding the manifest after the save lands — a
+        crash in that window leaves a committed-but-manifest-less dir,
+        which auto-resume conservatively skips.
+        """
         path = self.snapshot_path(step)
+        if jax.process_count() > 1:
+            with self._span("snapshot", step=step):
+                self._ckpt().save(path, self.state, force=True)
+                self._ckpt().wait_until_finished()
+                if jax.process_index() == 0:
+                    write_manifest(path, step, state_checksums(self.state))
+                    gc_snapshots(self.cfg.snapshot_prefix,
+                                 self.cfg.snapshot_max_keep)
+            log.info("snapshot -> %s", path)
+            return path
+
+        def on_retry(attempt, delay, exc):
+            self._tel_event("retry", step, op="snapshot.save",
+                            attempt=attempt, delay_s=round(delay, 3),
+                            error=str(exc))
+
         with self._span("snapshot", step=step):
-            self._ckpt().save(path, self.state, force=True)
+            commit_snapshot(
+                self._ckpt(), path, self.state, step,
+                policy=self.snapshot_retry, on_retry=on_retry,
+            )
         log.info("snapshot -> %s", path)
+        gc_snapshots(self.cfg.snapshot_prefix, self.cfg.snapshot_max_keep)
         return path
 
     def load_params(self, params, batch_stats=None):
@@ -742,11 +927,87 @@ class Solver:
         return int(st["iter"])
 
     def restore_snapshot(self, path: str):
+        """Restore an explicit snapshot path (retrying transient I/O).
+
+        When the snapshot carries a commit manifest, the restored tree
+        is checksum-verified against it — a corrupt snapshot raises
+        ``SnapshotValidationError`` instead of silently resuming from
+        garbage.  Manifest-less dirs (pre-resilience snapshots, raw
+        Orbax trees) restore unverified, preserving the old contract.
+        """
         if self.state is None:
             self.init()
         self._ckpt().wait_until_finished()
-        self.state = self._ckpt().restore(path, self.state)
+
+        def do_restore():
+            failpoints.fire("snapshot.restore.io")
+            return self._ckpt().restore(path, self.state)
+
+        def on_retry(attempt, delay, exc):
+            self._tel_event("retry", 0, op="snapshot.restore",
+                            attempt=attempt, delay_s=round(delay, 3),
+                            error=str(exc))
+
+        state = call_with_retry(
+            do_restore, self.snapshot_retry,
+            describe=f"snapshot restore ({path})", on_retry=on_retry,
+        )
+        try:
+            manifest = read_manifest(path)
+        except FileNotFoundError:
+            # Legacy contract: manifest-less dirs (pre-resilience
+            # snapshots, raw Orbax trees) restore unverified.
+            log.info("restored %s without checksum verification "
+                     "(no commit manifest)", path)
+        except (OSError, ValueError) as e:
+            # A manifest that EXISTS but cannot be read/parsed is
+            # corruption — exactly what verification exists to catch.
+            raise SnapshotValidationError(
+                f"unreadable manifest in {path}: {e}"
+            ) from e
+        else:
+            verify_restored(state, manifest)
+        self.state = state
         return self.state
+
+    def restore_auto(self, max_step: Optional[int] = None) -> Optional[str]:
+        """Scan ``cfg.snapshot_prefix`` and restore the newest *valid*
+        snapshot: manifests are validated newest-first, the restored
+        tree checksum-verified, and torn/corrupt candidates skipped with
+        a logged reason.  ``max_step`` bounds the candidates (divergence
+        rollback must not restore a snapshot captured during the
+        non-finite streak).  Returns the restored path, or None (fresh
+        start) when no valid snapshot exists."""
+        if self.state is None:
+            self.init()
+        self._ckpt().wait_until_finished()
+        prefix = self.cfg.snapshot_prefix
+        for step, path in reversed(list_snapshots(prefix)):
+            if max_step is not None and step > max_step:
+                continue
+            try:
+                manifest = validate_snapshot(path)
+
+                def do_restore(path=path):
+                    failpoints.fire("snapshot.restore.io")
+                    return self._ckpt().restore(path, self.state)
+
+                state = call_with_retry(
+                    do_restore, self.snapshot_retry,
+                    describe=f"snapshot restore ({path})",
+                )
+                verify_restored(state, manifest)
+            except Exception as e:  # noqa: BLE001 — skip, try the next
+                log.warning("resume: skipping snapshot %s: %s", path, e)
+                self._tel_event("resume_skip", step, snapshot=path,
+                                reason=str(e))
+                continue
+            self.state = state
+            log.info("resume: restored %s (iteration %d)", path, step)
+            return path
+        log.info("resume: no valid snapshot under prefix %r — starting "
+                 "fresh", prefix)
+        return None
 
 
 def _fmt(metrics: Dict[str, float]) -> str:

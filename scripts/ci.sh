@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# The one CI entry point: lint + the ROADMAP.md tier-1 test command.
+# The one CI entry point: lint + fault-injection smoke + the ROADMAP.md
+# tier-1 test command.
 #
-#   scripts/ci.sh            # lint, then full tier-1 pytest
+#   scripts/ci.sh            # lint, smoke, then full tier-1 pytest
 #   scripts/ci.sh --lint-only
 #
 # Keep the pytest invocation in sync with ROADMAP.md "Tier-1 verify" —
@@ -17,6 +18,61 @@ python scripts/check_no_print.py
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
+
+echo "== fault-injection smoke (docs/RESILIENCE.md) =="
+# Train with an injected transient snapshot fault (must be retried, not
+# fatal), then SIGTERM a long run mid-train (must exit 75 with a
+# committed emergency snapshot) and relaunch with --resume auto (must
+# restore and finish).  Exercises the whole preemption-safety loop in
+# two real processes, exactly as a supervisor would drive it.
+smoke_dir=$(mktemp -d)
+trap 'rm -rf "$smoke_dir"' EXIT
+cat > "$smoke_dir/solver.prototxt" <<EOF
+net: "examples/tiny_net.prototxt"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+max_iter: 1000000
+display: 0
+test_interval: 0
+test_iter: 0
+snapshot: 2
+snapshot_prefix: "$smoke_dir/m_"
+EOF
+
+NPAIRLOSS_FAILPOINTS="snapshot.save.io:1" JAX_PLATFORMS=cpu \
+    python -m npairloss_tpu train --solver "$smoke_dir/solver.prototxt" \
+    --model mlp --synthetic --resume auto --max_iter 4 \
+    > "$smoke_dir/run1.log" 2>&1 \
+    || { echo "smoke: injected-fault run failed"; cat "$smoke_dir/run1.log"; exit 1; }
+[[ -f "$smoke_dir/m_iter_4.ckpt/manifest.json" ]] \
+    || { echo "smoke: snapshot 4 missing after injected fault"; exit 1; }
+
+JAX_PLATFORMS=cpu python -m npairloss_tpu train \
+    --solver "$smoke_dir/solver.prototxt" --model mlp --synthetic \
+    --resume auto > "$smoke_dir/run2.log" 2>&1 &
+pid=$!
+for _ in $(seq 1 120); do  # wait for a post-resume snapshot, then preempt
+    [[ -f "$smoke_dir/m_iter_6.ckpt/manifest.json" ]] && break
+    # The run dying before its first snapshot is exactly the regression
+    # this smoke exists to catch — surface its log instead of burning
+    # the full wait and failing on the kill below.
+    kill -0 "$pid" 2>/dev/null \
+        || { echo "smoke: resumed run died early"; cat "$smoke_dir/run2.log"; exit 1; }
+    sleep 1
+done
+kill -TERM "$pid" 2>/dev/null || true
+rc=0; wait "$pid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "smoke: expected exit 75 after SIGTERM, got $rc"; cat "$smoke_dir/run2.log"; exit 1; }
+k=$(ls "$smoke_dir" | grep -oE 'm_iter_[0-9]+' | grep -oE '[0-9]+' | sort -n | tail -1)
+JAX_PLATFORMS=cpu python -m npairloss_tpu train \
+    --solver "$smoke_dir/solver.prototxt" --model mlp --synthetic \
+    --resume auto --max_iter "$((k + 2))" > "$smoke_dir/run3.log" 2>&1 \
+    || { echo "smoke: auto-resume relaunch failed"; cat "$smoke_dir/run3.log"; exit 1; }
+grep -q "resuming from iteration" "$smoke_dir/run3.log" \
+    || { echo "smoke: relaunch did not resume"; cat "$smoke_dir/run3.log"; exit 1; }
+echo "fault-injection smoke OK (preempted at iter $k, resumed, finished)"
 
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log

@@ -78,6 +78,7 @@ def main() -> int:
 
     from npairloss_tpu import REFERENCE_CONFIG
     from npairloss_tpu.ops.npair_loss import npair_loss
+    from npairloss_tpu.parallel._compat import shard_map
     from npairloss_tpu.parallel.mesh import data_parallel_mesh
     from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 
@@ -123,7 +124,7 @@ def main() -> int:
         return loss[None], grad
 
     def run(name, shard_fn):
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P("dp"), P("dp")), out_specs=(P("dp"), P("dp")),
         ))

@@ -31,6 +31,7 @@ def main() -> int:
         data_parallel_mesh,
         initialize_distributed,
         process_local_batch,
+        shard_map,
     )
 
     initialize_distributed(f"localhost:{port}", nproc, proc_id)
@@ -69,7 +70,7 @@ def main() -> int:
         return loss[None], aux["total_labels"][None]
 
     loss_stack, total_labels = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard, mesh=mesh,
             in_specs=(P("dp"), P("dp")), out_specs=(P("dp"), P("dp")),
         )
@@ -113,7 +114,7 @@ def main() -> int:
     from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 
     ring_stack = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda ff, ll: ring_npair_loss_and_metrics(
                 ff, ll, REFERENCE_CONFIG, "dp", top_ks=()
             )[0][None],

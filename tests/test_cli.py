@@ -71,6 +71,7 @@ def test_train_blockwise_engine_runs():
     assert rc == 0
 
 
+@pytest.mark.slow
 def test_train_ring_engine_runs_single_device_mesh():
     rc = main([
         "train", "--solver", "examples/tiny_solver.prototxt",
@@ -212,6 +213,7 @@ def test_cli_time_command(capsys):
     assert rec["iterations"] == 2
 
 
+@pytest.mark.slow
 def test_cli_time_forward_only_engines(capsys):
     """--forward-only skips the backward stage; the streaming engines
     must both time through the same entrypoint, and the emitted record

@@ -19,6 +19,7 @@ from npairloss_tpu.parallel import (
     DEFAULT_AXIS,
     data_parallel_mesh,
     shard_batch,
+    shard_map,
     sharded_npair_loss_fn,
 )
 from npairloss_tpu.testing import oracle
@@ -47,6 +48,7 @@ def _global_batch(rng, num_ids=3, imgs_per_id=2, dim=8):
     return feats, labs, np.concatenate(feats), np.concatenate(labs)
 
 
+@pytest.mark.slow
 def test_forward_parity_vs_oracle(mesh, rng):
     feats, labs, gf, gl = _global_batch(rng)
     want = oracle.forward(feats, labs, CFG)
@@ -63,6 +65,7 @@ def test_forward_parity_vs_oracle(mesh, rng):
         )
 
 
+@pytest.mark.slow
 def test_grad_parity_vs_oracle(mesh, rng):
     """Mean-of-rank-losses gradient == per-rank oracle grads / G.
 
@@ -80,7 +83,7 @@ def test_grad_parity_vs_oracle(mesh, rng):
         loss = npair_loss(features, labels, CFG, axis_name=DEFAULT_AXIS)
         return jax.lax.pmean(loss, DEFAULT_AXIS)
 
-    grad_fn = jax.shard_map(
+    grad_fn = shard_map(
         jax.grad(mean_loss),
         mesh=mesh,
         in_specs=(P(DEFAULT_AXIS), P(DEFAULT_AXIS)),
@@ -96,6 +99,7 @@ def test_grad_parity_vs_oracle(mesh, rng):
         )
 
 
+@pytest.mark.slow
 def test_local_mining_sharded_equals_oracle_not_single_device(mesh, rng):
     """G shards != one shard on the concat batch for the *loss* (each rank
     mines per its own query rows), but LOCAL/RAND absolute mining with a
@@ -127,7 +131,7 @@ def test_rank_blocks_ordered_like_mpi_allgather(mesh):
         tl = jax.lax.all_gather(labels, DEFAULT_AXIS, axis=0, tiled=True)
         return tl[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         get_total, mesh=mesh, in_specs=(P(DEFAULT_AXIS), P(DEFAULT_AXIS)),
         out_specs=P(DEFAULT_AXIS),
     )

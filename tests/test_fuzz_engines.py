@@ -30,7 +30,7 @@ from npairloss_tpu.ops.npair_loss import (
     npair_loss_with_aux,
 )
 from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss_with_aux
-from npairloss_tpu.parallel import data_parallel_mesh
+from npairloss_tpu.parallel import data_parallel_mesh, shard_map
 from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 from npairloss_tpu.testing import oracle
 
@@ -117,7 +117,7 @@ def _sharded_value_and_grad(fn, mesh, feats, labs):
 
     def mean_loss(ff, ll):
         return jnp.mean(
-            jax.shard_map(
+            shard_map(
                 lambda a, b: fn(a, b)[None],
                 mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
                 out_specs=P(AXIS),
@@ -130,6 +130,7 @@ def _sharded_value_and_grad(fn, mesh, feats, labs):
 
 
 @pytest.mark.parametrize("trial", range(4))
+@pytest.mark.slow
 def test_fuzz_ring_vs_dense_two_shards(trial):
     rng = np.random.default_rng(77310000 + trial)
     cfg = _random_cfg(rng)

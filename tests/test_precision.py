@@ -20,6 +20,7 @@ from npairloss_tpu.ops.npair_loss import (
     resolve_matmul_precision,
 )
 from npairloss_tpu.ops.pallas_npair import blockwise_npair_loss_with_aux
+from npairloss_tpu.parallel import shard_map
 
 
 def test_resolve_matmul_precision():
@@ -47,6 +48,7 @@ def test_default_precision_engines_agree(rng):
     np.testing.assert_allclose(gb, gd, rtol=1e-2, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_default_precision_ring_agrees(rng):
     from jax.sharding import PartitionSpec as P
 
@@ -65,7 +67,7 @@ def test_default_precision_ring_agrees(rng):
             e, lab, REFERENCE_CONFIG, "dp", top_ks=(),
             matmul_precision="default")[0][None]
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         per_shard, mesh=mesh, in_specs=(P("dp"), P("dp")),
         out_specs=P("dp")))
 
@@ -74,7 +76,7 @@ def test_default_precision_ring_agrees(rng):
             e, lab, REFERENCE_CONFIG, axis_name="dp",
             matmul_precision="default")[0][None]
 
-    dense = jax.jit(jax.shard_map(
+    dense = jax.jit(shard_map(
         dense_shard, mesh=mesh, in_specs=(P("dp"), P("dp")),
         out_specs=P("dp")))
     np.testing.assert_allclose(

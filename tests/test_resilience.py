@@ -1,0 +1,616 @@
+"""Fault-tolerance tests (npairloss_tpu.resilience, docs/RESILIENCE.md),
+driven through named failpoints so every fault is deterministic: atomic
+snapshot commit + torn-snapshot validation, ``--resume auto`` skipping
+corrupt snapshots, SIGTERM -> emergency snapshot -> resume at k+1,
+retry/backoff schedule on a fake clock, divergence rollback, and
+bounded prefetch-worker respawn.  All tier-1 fast (CPU, tiny MLPs)."""
+
+import dataclasses
+import json
+import os
+import random
+import signal
+
+import numpy as np
+import pytest
+
+from npairloss_tpu import NPairLossConfig
+from npairloss_tpu.data import synthetic_identity_batches
+from npairloss_tpu.models import get_model
+from npairloss_tpu.resilience import (
+    DivergenceConfig,
+    DivergenceError,
+    InjectedFault,
+    PreemptionSignal,
+    RetryPolicy,
+    TrainingPreempted,
+    call_with_retry,
+    failpoints,
+    list_snapshots,
+    read_manifest,
+    validate_snapshot,
+)
+from npairloss_tpu.resilience.snapshot import (
+    SnapshotValidationError,
+    TMP_MARKER,
+)
+from npairloss_tpu.train import Solver, SolverConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def _make_solver(tmp_path, snapshot=0, max_keep=0, **kw):
+    cfg = SolverConfig(
+        base_lr=0.5, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
+        display=0, test_interval=0, average_loss=10,
+        snapshot=snapshot, snapshot_prefix=str(tmp_path / "snap" / "m_"),
+        snapshot_max_keep=max_keep,
+    )
+    solver = Solver(
+        get_model("mlp", hidden=(32,), embedding_dim=16),
+        NPairLossConfig(), cfg, input_shape=(16,),
+        snapshot_retry=RetryPolicy(base_delay=0.001, jitter=0.0),
+        **kw,
+    )
+    return solver, synthetic_identity_batches(8, 8, 2, (16,), noise=0.5)
+
+
+# -- retry/backoff schedule (fake clock) ---------------------------------
+
+
+def test_retry_backoff_schedule_fake_clock():
+    sleeps, events = [], []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise OSError(f"transient {calls['n']}")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=1.0, max_delay=3.0,
+                         multiplier=2.0, jitter=0.0)
+    out = call_with_retry(
+        flaky, policy, sleep=sleeps.append,
+        on_retry=lambda a, d, e: events.append((a, d, str(e))),
+    )
+    assert out == "ok" and calls["n"] == 4
+    # Exponential growth capped at max_delay: 1, 2, min(4, 3) = 3.
+    assert sleeps == [1.0, 2.0, 3.0]
+    assert [a for a, _, _ in events] == [1, 2, 3]
+
+
+def test_retry_jitter_bounded_and_seeded():
+    policy = RetryPolicy(max_attempts=2, base_delay=1.0, jitter=0.5)
+    rng = random.Random(0)
+    delays = [policy.delay(1, rng) for _ in range(100)]
+    assert all(0.5 <= d <= 1.5 for d in delays)
+    assert delays == [policy.delay(1, random.Random(0))
+                      for _ in range(1)] + delays[1:]  # seeded = reproducible
+
+
+def test_retry_exhausts_and_raises():
+    sleeps = []
+    with pytest.raises(OSError, match="always"):
+        call_with_retry(
+            lambda: (_ for _ in ()).throw(OSError("always")),
+            RetryPolicy(max_attempts=3, base_delay=0.1, jitter=0.0),
+            sleep=sleeps.append,
+        )
+    assert len(sleeps) == 2  # 3 attempts = 2 backoffs
+
+
+def test_retry_does_not_catch_non_transient():
+    with pytest.raises(ValueError):
+        call_with_retry(
+            lambda: (_ for _ in ()).throw(ValueError("logic bug")),
+            RetryPolicy(max_attempts=5, base_delay=0.1),
+            sleep=lambda d: pytest.fail("must not retry a ValueError"),
+        )
+
+
+# -- failpoints ----------------------------------------------------------
+
+
+def test_failpoint_counts_and_context():
+    assert not failpoints.should_fire("x")  # unarmed
+    with failpoints.armed("x", times=2):
+        assert failpoints.should_fire("x")
+        assert failpoints.should_fire("x")
+        assert not failpoints.should_fire("x")  # exhausted
+    failpoints.arm("y", times=1)
+    with pytest.raises(InjectedFault, match="failpoint 'y'"):
+        failpoints.fire("y")
+    failpoints.fire("y")  # disarmed after the count: no-op
+
+
+def test_failpoints_env_parsing(monkeypatch):
+    failpoints.reset()
+    monkeypatch.setenv(failpoints.ENV_VAR, "a.b:2, c ,bad:oops")
+    assert failpoints.should_fire("a.b")
+    assert failpoints.should_fire("a.b")
+    assert not failpoints.should_fire("a.b")
+    assert failpoints.should_fire("c")  # bare name = once
+    assert not failpoints.should_fire("bad")  # unparseable count ignored
+    failpoints.reset()
+
+
+# -- atomic snapshot commit + validation ---------------------------------
+
+
+def test_atomic_commit_writes_manifest_and_no_tmp(tmp_path):
+    solver, batches = _make_solver(tmp_path)
+    x, lab = next(batches)
+    solver.step(x, lab)
+    path = solver.save_snapshot(1)
+    manifest = validate_snapshot(path)
+    assert manifest["step"] == 1
+    assert manifest["arrays"]  # one record per state leaf
+    rec = next(iter(manifest["arrays"].values()))
+    assert set(rec) == {"crc32", "shape", "dtype"}
+    # The commit renamed the tmp dir away — nothing uncommitted remains.
+    assert not [n for n in os.listdir(tmp_path / "snap") if TMP_MARKER in n]
+
+
+@pytest.mark.slow
+def test_commit_crash_before_rename_is_invisible_to_resume(tmp_path):
+    solver, batches = _make_solver(tmp_path)
+    x, lab = next(batches)
+    solver.step(x, lab)
+    failpoints.arm("snapshot.commit.crash", times=1)
+    with pytest.raises(InjectedFault):
+        solver.save_snapshot(1)
+    # Arrays hit disk but the rename never happened: no committed
+    # snapshot exists, only a tmp dir the resume scan must ignore.
+    assert not os.path.exists(solver.snapshot_path(1))
+    assert [n for n in os.listdir(tmp_path / "snap") if TMP_MARKER in n]
+    assert list_snapshots(solver.cfg.snapshot_prefix) == []
+    solver2, _ = _make_solver(tmp_path)
+    assert solver2.restore_auto() is None  # fresh start, no crash
+
+
+@pytest.mark.slow
+def test_transient_save_error_is_retried(tmp_path, caplog):
+    solver, batches = _make_solver(tmp_path, snapshot=2)
+    failpoints.arm("snapshot.save.io", times=1)
+    with caplog.at_level("WARNING", logger="npairloss_tpu.resilience"):
+        solver.train(batches, num_iters=3)
+    # The injected fault was retried, the run completed, the snapshot
+    # is valid.
+    assert any("retrying" in r.message for r in caplog.records)
+    assert validate_snapshot(solver.snapshot_path(2))["step"] == 2
+
+
+def test_resume_auto_skips_torn_snapshot_with_reason(tmp_path, caplog):
+    solver, batches = _make_solver(tmp_path)
+    for k in (1, 2):
+        x, lab = next(batches)
+        solver.step(x, lab)
+        solver.save_snapshot(k)
+    # Corrupt the NEWEST snapshot's checksums (the injected torn commit
+    # path produces exactly this shape of damage).
+    newest = solver.snapshot_path(2)
+    manifest = read_manifest(newest)
+    next(iter(manifest["arrays"].values()))["crc32"] ^= 1
+    with open(os.path.join(newest, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+
+    solver2, _ = _make_solver(tmp_path)
+    with caplog.at_level("WARNING", logger="npairloss_tpu.solver"):
+        restored = solver2.restore_auto()
+    assert restored == solver.snapshot_path(1)
+    assert solver2.iteration == 1
+    skip = [r for r in caplog.records if "skipping snapshot" in r.message]
+    assert skip and "checksum mismatch" in skip[0].message
+
+
+@pytest.mark.slow
+def test_injected_torn_commit_is_caught_by_validator(tmp_path):
+    solver, batches = _make_solver(tmp_path)
+    x, lab = next(batches)
+    solver.step(x, lab)
+    failpoints.arm("snapshot.commit.torn", times=1)
+    path = solver.save_snapshot(1)
+    # Structurally fine...
+    validate_snapshot(path)
+    # ...but the deep (restore-time) check must reject it.
+    solver2, _ = _make_solver(tmp_path)
+    with pytest.raises(SnapshotValidationError, match="checksum"):
+        solver2.restore_snapshot(path)
+    assert solver2.restore_auto() is None
+
+
+@pytest.mark.slow
+def test_manifest_less_snapshot_skipped_on_auto_but_loads_explicitly(
+        tmp_path, caplog):
+    """Pre-resilience snapshots (no manifest) are skipped by the
+    validated auto scan but still restorable by explicit path — the
+    migration contract."""
+    solver, batches = _make_solver(tmp_path)
+    x, lab = next(batches)
+    solver.step(x, lab)
+    path = solver.save_snapshot(1)
+    os.remove(os.path.join(path, "manifest.json"))
+    solver2, _ = _make_solver(tmp_path)
+    with caplog.at_level("WARNING", logger="npairloss_tpu.solver"):
+        assert solver2.restore_auto() is None
+    assert any("no manifest" in r.message for r in caplog.records)
+    solver3, _ = _make_solver(tmp_path)
+    solver3.restore_snapshot(path)
+    assert solver3.iteration == 1
+
+
+@pytest.mark.slow
+def test_explicit_restore_rejects_corrupt_manifest(tmp_path):
+    """A manifest that EXISTS but is unparseable is corruption, not a
+    legacy snapshot — explicit restore must refuse, not silently skip
+    verification."""
+    solver, batches = _make_solver(tmp_path)
+    x, lab = next(batches)
+    solver.step(x, lab)
+    path = solver.save_snapshot(1)
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        f.write('{"format": "npairloss-snapsho')  # truncated mid-write
+    solver2, _ = _make_solver(tmp_path)
+    with pytest.raises(SnapshotValidationError, match="unreadable manifest"):
+        solver2.restore_snapshot(path)
+
+
+@pytest.mark.slow
+def test_snapshot_retention_gc(tmp_path):
+    solver, batches = _make_solver(tmp_path, snapshot=1, max_keep=2)
+    solver.train(batches, num_iters=5)
+    snaps = list_snapshots(solver.cfg.snapshot_prefix)
+    assert [s for s, _ in snaps] == [4, 5]
+    for _, p in snaps:
+        validate_snapshot(p)
+
+
+# -- graceful preemption -------------------------------------------------
+
+
+class _SignalAt:
+    """Batch iterator that SIGTERMs this process while producing batch
+    ``fire_at`` — the in-process counterpart of `kill -TERM $pid` during
+    a smoke train (the handler runs in the main thread before the next
+    preemption poll)."""
+
+    def __init__(self, batches, fire_at: int):
+        self.batches = batches
+        self.fire_at = fire_at
+        self.count = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.count += 1
+        if self.count == self.fire_at:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return next(self.batches)
+
+
+def test_sigterm_emergency_snapshot_then_resume_at_k_plus_1(tmp_path):
+    # Uninterrupted reference run: 6 iters, same seeds.
+    ref, ref_batches = _make_solver(tmp_path / "ref")
+    ref_final = ref.train(ref_batches, num_iters=6)
+
+    solver, batches = _make_solver(tmp_path)
+    with PreemptionSignal() as sig:
+        solver.preempt = sig
+        with pytest.raises(TrainingPreempted) as ei:
+            solver.train(_SignalAt(batches, 4), num_iters=6)
+    k = ei.value.step
+    assert k == 4
+    # The emergency snapshot is committed and manifest-valid at k.
+    assert validate_snapshot(ei.value.snapshot_path)["step"] == k
+
+    # Relaunch with --resume auto semantics: restore, continue at k+1.
+    solver2, batches2 = _make_solver(tmp_path)
+    assert solver2.restore_auto() == ei.value.snapshot_path
+    assert solver2.iteration == k
+    logs = []
+    final = solver2.train(batches2, num_iters=6, log_fn=logs.append)
+    assert any("resuming from iteration 4" in line for line in logs)
+    assert solver2.iteration == 6
+    # Metric keys byte-identical to the uninterrupted run's.
+    assert sorted(final) == sorted(ref_final)
+
+
+def test_preemption_signal_programmatic_and_exit_code():
+    from npairloss_tpu.resilience import EXIT_PREEMPTED
+
+    assert EXIT_PREEMPTED == 75  # the documented supervisor contract
+    sig = PreemptionSignal()
+    assert not sig.requested
+    sig.request(signal.SIGTERM)
+    assert sig.requested and sig.signum == signal.SIGTERM
+
+
+# -- divergence guard ----------------------------------------------------
+
+
+def test_divergence_rollback_restores_and_scales_lr(tmp_path):
+    solver, batches = _make_solver(
+        tmp_path, snapshot=2,
+        divergence=DivergenceConfig(patience=2, action="rollback",
+                                    lr_scale=0.5, max_rollbacks=1),
+    )
+    # Snapshots land at 2 and 4; NaNs at steps 5 and 6 trip the guard.
+    # The rollback window excludes snapshot@4 (the step-4 update is
+    # implicated by the first NaN at 5), so the target is 2.
+    def arm_after(batches):
+        for i, b in enumerate(batches):
+            if i == 4:
+                failpoints.arm("step.nan_loss", times=2)
+            yield b
+
+    logs = []
+    final = solver.train(arm_after(batches), num_iters=8, log_fn=logs.append)
+    assert any("rolled back to iteration 2" in line for line in logs)
+    assert solver.iteration == 8  # recovered and finished
+    assert solver.cfg.base_lr == pytest.approx(0.25)  # 0.5 * lr_scale
+    assert np.isfinite(final["loss"])
+
+
+@pytest.mark.slow
+def test_divergence_rollback_skips_snapshots_inside_nan_streak(tmp_path):
+    """A snapshot committed while the loss was already non-finite (or by
+    the update that produced the first NaN) is a poisoned rollback
+    target — the guard must restore an older one even though the newer
+    ones are checksum-valid."""
+    solver, batches = _make_solver(
+        tmp_path, snapshot=1,
+        divergence=DivergenceConfig(patience=3, action="rollback",
+                                    max_rollbacks=1),
+    )
+    def arm_after(batches):
+        for i, b in enumerate(batches):
+            if i == 2:
+                failpoints.arm("step.nan_loss", times=3)
+            yield b
+
+    logs = []
+    solver.train(arm_after(batches), num_iters=6, log_fn=logs.append)
+    # NaNs at 3,4,5; snapshots 3 and 4 were committed mid-streak and 2
+    # is implicated by the first NaN — rollback landed on 1, and the
+    # suspect snapshots were quarantined out of the resume namespace
+    # (then swept by GC as retraining re-committed those steps).
+    assert any("rolled back to iteration 1" in line for line in logs)
+    assert solver.iteration == 6
+    assert [s for s, _ in list_snapshots(solver.cfg.snapshot_prefix)] == \
+        [1, 2, 3, 4, 5, 6]  # all re-committed post-rollback
+
+
+@pytest.mark.slow
+def test_quarantine_hides_suspect_snapshots_and_gc_sweeps(tmp_path):
+    """Quarantined snapshots leave the resume namespace immediately (a
+    later --resume auto must not restore NaN-era params) and are
+    reclaimed by GC regardless of the retention setting."""
+    from npairloss_tpu.resilience import gc_snapshots, quarantine_snapshots
+    from npairloss_tpu.resilience.snapshot import QUARANTINE_SUFFIX
+
+    solver, batches = _make_solver(tmp_path, snapshot=1)
+    solver.train(batches, num_iters=3)
+    prefix = solver.cfg.snapshot_prefix
+    assert [s for s, _ in list_snapshots(prefix)] == [1, 2, 3]
+    moved = quarantine_snapshots(prefix, min_step=1)
+    assert len(moved) == 2 and all(
+        p.endswith(QUARANTINE_SUFFIX) for p in moved)
+    assert [s for s, _ in list_snapshots(prefix)] == [1]
+    solver2, _ = _make_solver(tmp_path)
+    assert solver2.restore_auto() == solver.snapshot_path(1)
+    # GC sweeps quarantined dirs even with max_keep=0 (keep-all).
+    swept = gc_snapshots(prefix, 0)
+    assert sorted(swept) == sorted(moved)
+    assert not [n for n in os.listdir(tmp_path / "snap")
+                if n.endswith(QUARANTINE_SUFFIX)]
+
+
+def test_divergence_halt_raises(tmp_path):
+    solver, batches = _make_solver(
+        tmp_path,
+        divergence=DivergenceConfig(patience=2, action="halt"),
+    )
+    failpoints.arm("step.nan_loss", times=2)
+    with pytest.raises(DivergenceError, match="2 consecutive non-finite"):
+        solver.train(batches, num_iters=6)
+
+
+@pytest.mark.slow
+def test_divergence_rollback_budget_exhausted_halts(tmp_path):
+    solver, batches = _make_solver(
+        tmp_path, snapshot=1,
+        divergence=DivergenceConfig(patience=1, action="rollback",
+                                    max_rollbacks=1),
+    )
+    def arm_after(batches):
+        for i, b in enumerate(batches):
+            if i == 2:
+                failpoints.arm("step.nan_loss", times=None)  # forever
+            yield b
+
+    with pytest.raises(DivergenceError, match="budget"):
+        solver.train(arm_after(batches), num_iters=6)
+
+
+@pytest.mark.slow
+def test_divergence_without_snapshot_halts_with_reason(tmp_path):
+    solver, batches = _make_solver(
+        tmp_path,
+        divergence=DivergenceConfig(patience=1, action="rollback"),
+    )
+    failpoints.arm("step.nan_loss", times=1)
+    with pytest.raises(DivergenceError, match="no valid snapshot"):
+        solver.train(batches, num_iters=4)
+
+
+def test_divergence_config_validation():
+    with pytest.raises(ValueError):
+        DivergenceConfig(patience=0)
+    with pytest.raises(ValueError):
+        DivergenceConfig(action="panic")
+    with pytest.raises(ValueError):
+        DivergenceConfig(lr_scale=0.0)
+
+
+# -- prefetch-worker respawn ---------------------------------------------
+
+
+def _tiny_loader(max_worker_restarts=3):
+    from npairloss_tpu.config.schema import DataLayerConfig
+    from npairloss_tpu.data import ArrayDataset, MultibatchLoader
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((32, 4, 4, 3)).astype(np.float32)
+    labels = np.repeat(np.arange(8), 4)
+    cfg = DataLayerConfig(identity_num_per_batch=4, img_num_per_identity=2)
+    return MultibatchLoader(
+        ArrayDataset(images, labels), cfg,
+        max_worker_restarts=max_worker_restarts,
+    )
+
+
+def test_worker_crash_respawns_within_budget(caplog):
+    failpoints.arm("data.worker", times=2)
+    with _tiny_loader() as loader:
+        with caplog.at_level("WARNING", logger="npairloss_tpu.data"):
+            for _ in range(4):
+                images, labels = next(loader)
+        assert images.shape == (8, 4, 4, 3)
+        # The budget bounds CONSECUTIVE failures: a delivered batch
+        # resets it, so sparse transient errors over a long run never
+        # accumulate into an abort.
+        assert loader._respawns == 0
+    respawn = [r for r in caplog.records if "respawning" in r.message]
+    assert len(respawn) == 2 and "died at batch 0" in respawn[0].message
+
+
+def test_worker_crash_beyond_budget_raises_with_context():
+    from npairloss_tpu.data import PrefetchWorkerError
+
+    failpoints.arm("data.worker", times=None)
+    with _tiny_loader(max_worker_restarts=1) as loader:
+        with pytest.raises(PrefetchWorkerError,
+                           match=r"batch 0 after 1 respawns.*InjectedFault"):
+            next(loader)
+
+
+# -- solver exit paths ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_checkpointer_drained_on_exception_exit(tmp_path, monkeypatch):
+    """wait_until_finished must run on the exception exit path too —
+    the in-flight Orbax save lands even when a later step raises."""
+    solver, batches = _make_solver(
+        tmp_path,
+        divergence=DivergenceConfig(patience=1, action="halt"),
+    )
+    drained = []
+
+    def fail_after(batches):
+        for i, b in enumerate(batches):
+            if i == 1:
+                failpoints.arm("step.nan_loss", times=1)
+            yield b
+
+    solver.init(np.zeros((2, 16), np.float32))
+    ckpt = solver._ckpt()
+    orig = ckpt.wait_until_finished
+    monkeypatch.setattr(
+        ckpt, "wait_until_finished",
+        lambda: (drained.append(True), orig())[1],
+    )
+    with pytest.raises(DivergenceError):
+        solver.train(fail_after(batches), num_iters=6)
+    assert drained  # the finally block drained the checkpointer
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_solver(tmp_path, max_iter=4, snapshot=2):
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        'net: "examples/tiny_net.prototxt"\nbase_lr: 0.05\n'
+        'lr_policy: "fixed"\nmomentum: 0.9\n'
+        f'max_iter: {max_iter}\ndisplay: 0\ntest_interval: 0\n'
+        f'test_iter: 0\nsnapshot: {snapshot}\n'
+        f'snapshot_prefix: "{tmp_path}/m_"\n'
+    )
+    return str(solver)
+
+
+@pytest.mark.slow
+def test_cli_resume_auto_fresh_then_restore(tmp_path, monkeypatch):
+    """The supervisor contract: the SAME command line works for the
+    first launch (fresh start) and the relaunch (restore + continue),
+    with an injected transient save fault retried along the way."""
+    from npairloss_tpu.cli import main
+
+    monkeypatch.chdir(_REPO)
+    solver = _write_solver(tmp_path, max_iter=4, snapshot=2)
+    failpoints.arm("snapshot.save.io", times=1)
+    rc = main(["train", "--solver", solver, "--model", "mlp",
+               "--synthetic", "--resume", "auto"])
+    assert rc == 0
+    snaps = list_snapshots(f"{tmp_path}/m_")
+    assert [s for s, _ in snaps] == [2, 4]
+    # Relaunch, same flags + a higher target: restores 4, runs to 6.
+    rc = main(["train", "--solver", solver, "--model", "mlp",
+               "--synthetic", "--resume", "auto", "--max_iter", "6"])
+    assert rc == 0
+    assert [s for s, _ in list_snapshots(f"{tmp_path}/m_")] == [2, 4, 6]
+
+
+@pytest.mark.slow
+def test_cli_snapshot_keep_and_divergence_flags(tmp_path, monkeypatch):
+    from npairloss_tpu.cli import main
+
+    monkeypatch.chdir(_REPO)
+    solver = _write_solver(tmp_path, max_iter=6, snapshot=2)
+    rc = main(["train", "--solver", solver, "--model", "mlp",
+               "--synthetic", "--snapshot-keep", "2"])
+    assert rc == 0
+    assert [s for s, _ in list_snapshots(f"{tmp_path}/m_")] == [4, 6]
+    # Divergence halt surfaces as a clean error exit, not a traceback.
+    failpoints.arm("step.nan_loss", times=2)
+    rc = main(["train", "--solver", solver, "--model", "mlp",
+               "--synthetic", "--max_iter", "8",
+               "--divergence-patience", "2",
+               "--divergence-action", "halt"])
+    assert rc == 1
+
+
+@pytest.mark.slow
+def test_telemetry_events_emitted_for_retry_and_rollback(tmp_path):
+    from npairloss_tpu.obs import RunTelemetry
+
+    tel = RunTelemetry(str(tmp_path / "run"), trace=False)
+    solver, batches = _make_solver(
+        tmp_path, snapshot=2,
+        divergence=DivergenceConfig(patience=1, action="rollback",
+                                    max_rollbacks=1),
+        telemetry=tel,
+    )
+    def arm_after(batches):
+        for i, b in enumerate(batches):
+            if i == 3:
+                failpoints.arm("step.nan_loss", times=1)
+                failpoints.arm("snapshot.save.io", times=1)
+            yield b
+
+    solver.train(arm_after(batches), num_iters=6)
+    tel.close()
+    events = [r for r in tel.ring.records() if r.get("phase") == "event"]
+    kinds = [r["event"] for r in events]
+    assert "retry" in kinds      # the injected save fault was retried
+    assert "rollback" in kinds   # the NaN step rolled back
+    rb = next(r for r in events if r["event"] == "rollback")
+    assert rb["to_iteration"] == 2

@@ -17,7 +17,7 @@ from npairloss_tpu.ops.npair_loss import (
     NPairLossConfig,
     npair_loss_with_aux,
 )
-from npairloss_tpu.parallel import data_parallel_mesh, ring_supported
+from npairloss_tpu.parallel import data_parallel_mesh, ring_supported, shard_map
 from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 
 from conftest import make_identity_batch
@@ -52,13 +52,13 @@ def _dense_fns(mesh, cfg, top_ks=(1, 5, 10)):
         return g
 
     value_sh = jax.jit(
-        jax.shard_map(
+        shard_map(
             value, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
             out_specs=(P(AXIS), P(AXIS)),
         )
     )
     grad_sh = jax.jit(
-        jax.shard_map(
+        shard_map(
             grad, mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS)
         )
     )
@@ -78,13 +78,13 @@ def _ring_fns(mesh, cfg, top_ks=(1, 5, 10)):
         return g
 
     value_sh = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
             out_specs=(P(AXIS), P(AXIS)),
         )
     )
     grad_sh = jax.jit(
-        jax.shard_map(
+        shard_map(
             grad, mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS)
         )
     )
@@ -111,6 +111,7 @@ ABS_CONFIGS = [
 
 
 @pytest.mark.parametrize("cfg_idx", range(len(ABS_CONFIGS)))
+@pytest.mark.slow
 def test_ring_matches_dense_loss_and_metrics(rng, cfg_idx):
     cfg = ABS_CONFIGS[cfg_idx]
     mesh = _mesh()
@@ -129,6 +130,7 @@ def test_ring_matches_dense_loss_and_metrics(rng, cfg_idx):
 
 
 @pytest.mark.parametrize("grad_mode", ["reference", "true"])
+@pytest.mark.slow
 def test_ring_matches_dense_grad(rng, grad_mode):
     import dataclasses
 
@@ -170,6 +172,7 @@ REL_CONFIGS = [
 
 
 @pytest.mark.parametrize("cfg_idx", range(len(REL_CONFIGS)))
+@pytest.mark.slow
 def test_ring_relative_matches_dense(rng, cfg_idx):
     """RELATIVE_* thresholds via streamed radix selection must equal the
     dense path's host-sort semantics exactly — loss, metrics and grads."""
@@ -197,6 +200,7 @@ def test_ring_relative_matches_dense(rng, cfg_idx):
 
 
 @pytest.mark.parametrize("num_ids,imgs", [(9, 8), (9, 16)])
+@pytest.mark.slow
 def test_ring_pos_topk_fallback_boundary(rng, num_ids, imgs):
     """The ring's sparse-positive fast path guards on a pmax-agreed
     cnt_s <= K: 8 imgs per identity (cnt_s=7) fits the 8-slot buffer,
@@ -231,6 +235,7 @@ def test_ring_pos_topk_fallback_boundary(rng, num_ids, imgs):
         rtol=3e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_ring_sim_cache_bit_identical(rng):
     """The per-shard similarity cache (parallel.ring sim_cache) replays
     exactly the tiles the recompute path produces, so cached and
@@ -254,11 +259,11 @@ def test_ring_sim_cache_bit_identical(rng):
                 lambda x: jnp.asarray(x)[None], m
             )
 
-        value = jax.jit(jax.shard_map(
+        value = jax.jit(shard_map(
             per_shard, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
             out_specs=(P(AXIS), P(AXIS)),
         ))
-        grad = jax.jit(jax.shard_map(
+        grad = jax.jit(shard_map(
             lambda f_, l_, cache=cache: jax.grad(
                 lambda x: ring_npair_loss_and_metrics(
                     x, l_, REFERENCE_CONFIG, AXIS, (1,), sim_cache=cache
@@ -277,6 +282,7 @@ def test_ring_sim_cache_bit_identical(rng):
         assert np.array_equal(np.asarray(m_on[k]), np.asarray(m_off[k])), k
 
 
+@pytest.mark.slow
 def test_ring_relative_clamp_quirk(rng):
     """A negative-valued relative threshold clamps to -FLT_MAX (cu:288
     etc.); scaled-down features make every similarity negative-capable."""
@@ -297,6 +303,7 @@ def test_ring_relative_clamp_quirk(rng):
     )
 
 
+@pytest.mark.slow
 def test_ring_ident_counts_match_dense(rng):
     """Selected-pair counts stream correctly (identNum/diffNum parity)."""
     cfg = NPairLossConfig(
@@ -311,7 +318,7 @@ def test_ring_ident_counts_match_dense(rng):
         return aux["ident_num"].sum()[None], aux["diff_num"].sum()[None]
 
     dc = jax.jit(
-        jax.shard_map(
+        shard_map(
             dense_counts, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
             out_specs=(P(AXIS), P(AXIS)),
         )
@@ -323,6 +330,7 @@ def test_ring_ident_counts_match_dense(rng):
     np.testing.assert_allclose(np.asarray(rm["diff_num"]), np.asarray(dd))
 
 
+@pytest.mark.slow
 def test_ring_all_same_label_is_zero_loss(rng):
     """No negatives anywhere -> D=0 -> log(I/I)=0 (zero-guard parity)."""
     mesh = _mesh()
@@ -338,6 +346,7 @@ def test_ring_all_same_label_is_zero_loss(rng):
     assert np.isfinite(grads).all()
 
 
+@pytest.mark.slow
 def test_solver_ring_step_trains(rng):
     """Full jitted training step with ring pooling over the 8-device mesh."""
     import jax.numpy as jnp
@@ -367,6 +376,7 @@ def test_solver_ring_step_trains(rng):
     assert min(losses[-4:]) <= max(losses[:4])
 
 
+@pytest.mark.slow
 def test_solver_ring_reference_config_trains(rng):
     """The flagship GLOBAL/RELATIVE_HARD config runs end-to-end in ring
     mode (previously dense-only)."""

@@ -154,6 +154,7 @@ def test_snapshot_roundtrip(tmp_path):
     assert np.isfinite(m["loss"])
 
 
+@pytest.mark.slow
 def test_training_learns_sharded_mesh():
     """Full solver step over the virtual 8-device mesh: sharded batch,
     all_gather negative pool, replicated params."""
