@@ -64,7 +64,12 @@ BASELINE_EMBEDDINGS_PER_SEC = 400.0
 BATCH = int(os.environ.get("BENCH_BATCH", 120))
 IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
 REPO = os.path.dirname(os.path.abspath(__file__))
-CACHE_DIR = os.path.join(REPO, ".jax_cache")
+# Persistent XLA compilation cache, COMMITTED under bench_cache/ so
+# tunnel windows spend their minutes measuring instead of recompiling:
+# any process (bench children, CLI runs with --compile-cache, the
+# Solver.warmup AOT path) that compiled a program saves every later
+# process the compile (docs/PIPELINE.md).
+CACHE_DIR = os.path.join(REPO, "bench_cache", "xla_cache")
 # Committed last-known-good hardware payload (refreshed on every
 # successful full TPU run).  When the tunnel is down the degraded record
 # carries this payload with "stale": true instead of zeroing the round
@@ -156,9 +161,12 @@ def _child_setup(platform: str):
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
     try:
-        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # One home for the cache knobs (pipeline.enable_compile_cache) —
+        # the same helper the CLI's --compile-cache uses, so bench and
+        # training runs share bench_cache/xla_cache/ entries.
+        from npairloss_tpu.pipeline import enable_compile_cache
+
+        enable_compile_cache(CACHE_DIR)
     except Exception as e:  # cache is an optimization, never a requirement
         _log(f"compilation cache unavailable: {e}")
     _log("importing backend...")
@@ -262,7 +270,18 @@ def _measure(step, args_list, warmup: int, steps: int, fetch, floor=0.0,
 
 
 def child_full(platform: str, steps: int, warmup: int,
-               soft_budget: float = 900.0) -> int:
+               soft_budget: float = 900.0, rows: str = None) -> int:
+    # --rows (ADVICE #2): a selective re-pass measures ONLY the named
+    # rows ("headline", engine-extras names, batch_scaling keys) instead
+    # of re-running the ~20-row sweep — a re-pass for wedge-lost tail
+    # rows no longer spends ~70 min of tunnel re-measuring what the
+    # first pass already captured.  The emitted record carries
+    # rows_filter, and _save_last_good MERGES it into the existing
+    # payload instead of replacing it.
+    selected = None
+    if rows:
+        selected = {r.strip() for r in rows.split(",") if r.strip()}
+        _log(f"selective re-measure (--rows): {sorted(selected)}")
     jax, dev = _child_setup(platform)
     import jax.numpy as jnp
     import numpy as np
@@ -271,58 +290,72 @@ def child_full(platform: str, steps: int, warmup: int,
     from npairloss_tpu.models import get_model
     from npairloss_tpu.train import Solver, SolverConfig
 
-    _log(f"building flagship solver (GoogLeNet bf16, batch {BATCH})")
-    solver = Solver(
-        get_model("googlenet", dtype=jnp.bfloat16),
-        REFERENCE_CONFIG,
-        SolverConfig(
-            base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
-            momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
-        ),
-        input_shape=(IMAGE, IMAGE, 3),
-    )
-    from npairloss_tpu.utils.profiling import next_timing_salt
-
-    rng = np.random.default_rng(0)
-    images = rng.standard_normal((BATCH, IMAGE, IMAGE, 3)).astype(np.float32)
-    labels = np.repeat(np.arange(BATCH // 2), 2).astype(np.int32)
-    # Per-run input salt: the tunnel memo is keyed on argument VALUES
-    # (even across processes — utils/profiling.py), and the seeded rng
-    # would otherwise make a supervisor-retried run re-dispatch the
-    # previous run's exact value sequence and time memo hits.
-    x = jax.device_put(jnp.asarray(images + next_timing_salt() * 1e-6))
-    lab = jax.device_put(jnp.asarray(labels))
-
     floor = _fetch_floor(jax)
-    _log("compiling + warming up (first TPU compile can take minutes)...")
-    # Successive solver.step calls chain through the optimizer state, so
-    # each dispatch is a distinct computation (no memo-cache hazard).
-    dts = _measure(
-        lambda a, b: solver.step(a, b),
-        [x, lab],
-        warmup,
-        steps,
-        lambda m: float(np.asarray(m["loss"])),
-        floor,
-    )
-    dt = min(dts)
-    emb_per_sec = BATCH * steps / dt
-    _log(f"flagship: {emb_per_sec:.1f} emb/s ({dt / steps * 1e3:.1f} ms/step)")
+    measure_headline = selected is None or "headline" in selected
+    reused = None
+    if not measure_headline:
+        reused = (_load_last_good() or {}).get("payload") or None
+        if not (reused and reused.get("value")):
+            _log("--rows without 'headline' but no last-good payload to "
+                 "reuse — measuring the headline anyway")
+            measure_headline, reused = True, None
 
-    # MFU from XLA's own FLOPs estimate of the jitted train step.
-    mfu = None
-    step_flops = None
-    try:
-        compiled = solver._step_fn.lower(
-            solver.state, x, lab
-        ).compile()
-        step_flops = _cost_flops(compiled)
-        peak = _peak_flops(dev.device_kind)
-        if step_flops and peak:
-            mfu = (step_flops * steps / dt) / peak
-            _log(f"mfu={mfu:.3f} (step_flops={step_flops:.3e}, peak={peak:.0e})")
-    except Exception as e:
-        _log(f"mfu estimate failed: {e}")
+    if measure_headline:
+        _log(f"building flagship solver (GoogLeNet bf16, batch {BATCH})")
+        solver = Solver(
+            get_model("googlenet", dtype=jnp.bfloat16),
+            REFERENCE_CONFIG,
+            SolverConfig(
+                base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
+                momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
+            ),
+            input_shape=(IMAGE, IMAGE, 3),
+        )
+        from npairloss_tpu.utils.profiling import next_timing_salt
+
+        rng = np.random.default_rng(0)
+        images = rng.standard_normal(
+            (BATCH, IMAGE, IMAGE, 3)).astype(np.float32)
+        labels = np.repeat(np.arange(BATCH // 2), 2).astype(np.int32)
+        # Per-run input salt: the tunnel memo is keyed on argument VALUES
+        # (even across processes — utils/profiling.py), and the seeded rng
+        # would otherwise make a supervisor-retried run re-dispatch the
+        # previous run's exact value sequence and time memo hits.
+        x = jax.device_put(jnp.asarray(images + next_timing_salt() * 1e-6))
+        lab = jax.device_put(jnp.asarray(labels))
+
+        _log("compiling + warming up (first TPU compile can take minutes)...")
+        # Successive solver.step calls chain through the optimizer state,
+        # so each dispatch is a distinct computation (no memo-cache
+        # hazard).
+        dts = _measure(
+            lambda a, b: solver.step(a, b),
+            [x, lab],
+            warmup,
+            steps,
+            lambda m: float(np.asarray(m["loss"])),
+            floor,
+        )
+        dt = min(dts)
+        emb_per_sec = BATCH * steps / dt
+        _log(f"flagship: {emb_per_sec:.1f} emb/s "
+             f"({dt / steps * 1e3:.1f} ms/step)")
+
+        # MFU from XLA's own FLOPs estimate of the jitted train step.
+        mfu = None
+        step_flops = None
+        try:
+            compiled = solver._step_fn.lower(
+                solver.state, x, lab
+            ).compile()
+            step_flops = _cost_flops(compiled)
+            peak = _peak_flops(dev.device_kind)
+            if step_flops and peak:
+                mfu = (step_flops * steps / dt) / peak
+                _log(f"mfu={mfu:.3f} (step_flops={step_flops:.3e}, "
+                     f"peak={peak:.0e})")
+        except Exception as e:
+            _log(f"mfu estimate failed: {e}")
 
     # Extras must never cost the headline: the parent kills this child at
     # --full-timeout, so every extra row checks a soft deadline and
@@ -331,13 +364,9 @@ def child_full(platform: str, steps: int, warmup: int,
     deadline = _T0 + 0.75 * soft_budget
     record = {
         "metric": "googlenet_npair_train_embeddings_per_sec_per_chip",
-        "value": round(emb_per_sec, 2),
         "unit": "embeddings/sec/chip",
-        "vs_baseline": round(emb_per_sec / BASELINE_EMBEDDINGS_PER_SEC, 3),
         "platform": dev.platform,
         "device_kind": dev.device_kind,
-        "ms_per_step": round(dt / steps * 1e3, 2),
-        "ms_per_step_windows": [round(d / steps * 1e3, 2) for d in dts],
         # Stamped up front so even a wedge-salvaged spill record carries
         # the floor the run was measured against.
         "fetch_floor_ms": round(floor * 1e3, 1),
@@ -348,10 +377,33 @@ def child_full(platform: str, steps: int, warmup: int,
         "batch": BATCH,
         "image": IMAGE,
     }
-    if mfu is not None:
-        record["mfu"] = round(mfu, 4)
-    if step_flops is not None:
-        record["step_flops"] = step_flops
+    if measure_headline:
+        record.update(
+            value=round(emb_per_sec, 2),
+            vs_baseline=round(emb_per_sec / BASELINE_EMBEDDINGS_PER_SEC, 3),
+            ms_per_step=round(dt / steps * 1e3, 2),
+            ms_per_step_windows=[round(d / steps * 1e3, 2) for d in dts],
+        )
+        if mfu is not None:
+            record["mfu"] = round(mfu, 4)
+        if step_flops is not None:
+            record["step_flops"] = step_flops
+    else:
+        # Headline carried over from last_good (flagged): a rows-only
+        # record must still print the driver-contract keys, but its
+        # headline is REUSED evidence, not a fresh measurement — the
+        # merge in _save_last_good never lets it clobber a measured one.
+        record.update(
+            value=float(reused.get("value", 0.0)),
+            vs_baseline=float(reused.get("vs_baseline", 0.0)),
+            headline_reused=True,
+        )
+        for k in ("ms_per_step", "ms_per_step_windows", "mfu",
+                  "step_flops"):
+            if k in reused:
+                record[k] = reused[k]
+    if selected is not None:
+        record["rows_filter"] = sorted(selected)
     # The headline is now wedge-proof: every extras row below re-spills
     # the record, so a mid-row tunnel wedge costs that row, not the run.
     extras = {}
@@ -362,13 +414,15 @@ def child_full(platform: str, steps: int, warmup: int,
 
     flush()
     try:
-        _engine_extras(jax, jnp, np, floor, deadline, extras, flush)
+        _engine_extras(jax, jnp, np, floor, deadline, extras, flush,
+                       selected)
     except Exception as e:
         _log(f"engine extras failed: {e}")
     try:
-        rows = {}
-        extras["batch_scaling"] = rows
-        _batch_scaling_extras(jax, jnp, np, dev, floor, deadline, rows, flush)
+        rows_out = {}
+        extras["batch_scaling"] = rows_out
+        _batch_scaling_extras(jax, jnp, np, dev, floor, deadline, rows_out,
+                              flush, selected)
     except Exception as e:
         _log(f"batch scaling extras failed: {e}")
     # Floor drift diagnostic: a row whose ms_per_step disagrees wildly
@@ -392,8 +446,19 @@ def child_full(platform: str, steps: int, warmup: int,
     return 0
 
 
+# Engine-extras row names — the vocabulary --rows selects from (plus
+# "headline" and the batch_scaling keys in _batch_scaling_extras).
+ENGINE_ROWS = (
+    "dense_abs", "blockwise_abs", "dense_flagship", "blockwise_flagship",
+    "blockwise_flagship_nocache", "blockwise_flagship_radix",
+    "blockwise_flagship_bf16matmul", "dense_flagship_bf16matmul",
+    "ring_abs", "ring_flagship", "ring_flagship_nocache",
+    "ring_flagship_bf16matmul",
+)
+
+
 def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
-                   flush=None):
+                   flush=None, selected=None):
     """Loss-engine comparison at a large self-pool: dense XLA graph vs the
     Pallas blockwise kernels (compiled by Mosaic when on TPU — this is the
     on-hardware validation of ops/pallas_npair.py) vs the ring engine on a
@@ -404,6 +469,24 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     identical), synced by a single host fetch — robust against the
     non-blocking/memoizing tunnel backend (see ``_fetch_floor``).
     """
+    n, d = 4096, 512
+    steps = 10
+    if extras is None:
+        extras = {}
+    if flush is None:
+        flush = lambda inflight=None: None  # noqa: E731
+    extras.update({"pool": n, "steps": steps})
+    if selected is not None and not (set(ENGINE_ROWS) & selected):
+        # A batch-only --rows re-pass: every engine row is unselected,
+        # so skip the whole section BEFORE the n x d pool is built and
+        # device_put through the tunnel — that transfer is exactly the
+        # budget a selective re-pass exists to save.
+        _log("extras: no engine row selected (--rows); section skipped")
+        for name in ENGINE_ROWS:
+            extras[name] = {"skipped": "not selected (--rows)"}
+        flush()
+        return
+
     from jax.sharding import PartitionSpec as P
 
     from npairloss_tpu import NPairLossConfig, REFERENCE_CONFIG
@@ -413,8 +496,6 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     from npairloss_tpu.parallel.mesh import data_parallel_mesh
     from npairloss_tpu.parallel.ring import ring_npair_loss_and_metrics
 
-    n, d = 4096, 512
-    steps = 10
     rng = np.random.default_rng(1)
     f = rng.standard_normal((n, d)).astype(np.float32)
     f /= np.linalg.norm(f, axis=1, keepdims=True)
@@ -432,14 +513,16 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
         an_mining_method=MiningMethod.HARD,
         an_mining_region=MiningRegion.LOCAL,
     )
-    if extras is None:
-        extras = {}
-    if flush is None:
-        flush = lambda inflight=None: None  # noqa: E731
-    extras.update({"pool": n, "steps": steps})
 
     def bench_one(name, loss_fn):
         """loss_fn(features, labels) -> scalar loss; timed fwd+bwd."""
+        # One-source-of-truth guard: a row missing from ENGINE_ROWS
+        # would dodge --rows selection AND silently skip the
+        # sacrificial warmup, corrupting its own measurement.
+        assert name in ENGINE_ROWS, f"{name} missing from ENGINE_ROWS"
+        if selected is not None and name not in selected:
+            extras[name] = {"skipped": "not selected (--rows)"}
+            return None
         vg = jax.value_and_grad(loss_fn)
 
         @jax.jit
@@ -541,6 +624,7 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     # inflight/quarantine containment as a row: if it ever wedges the
     # tunnel, later runs skip it (first timed row then absorbs the ~40
     # ms/step phantom cost — priced, not silent) instead of re-wedging.
+    # (A --rows pass that measures no engine row already returned above.)
     q = _quarantined("warmup_sacrifice")
     if q:
         _log(f"extras: skipping sacrificial warmup (quarantined: {q})")
@@ -645,8 +729,41 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     return extras
 
 
+# Batch-scaling sweep: (batch, model_name, row_key, model_kw, solver_kw).
+# Ordered by importance: the soft deadline may skip later rows.  The
+# parity-preserving MXU rewrites (s2d stem, fused inception 1x1s, both =
+# "mxu") and the remat row answer PROFILE.md's open attribution questions
+# with driver-captured numbers.  The vit_b16 rows time BASELINE.json
+# config 5's trunk (real ViT-B/16: patch 16, hidden 768, depth 12)
+# through the blockwise (stretch-path) engine; the 256 row probes the
+# largest batch and runs LAST so an OOM cannot cost any other row.  The
+# row_key column is the other half of the --rows vocabulary (with
+# "headline" and ENGINE_ROWS).
+BATCH_SCALING_SPECS = (
+    (120, "googlenet", "120", {}, {}),
+    (120, "googlenet_mxu", "120_mxu", {}, {}),
+    (240, "googlenet", "240", {}, {}),
+    (480, "googlenet", "480", {}, {}),
+    (128, "vit_b16", "vit_b16_128", {}, {"engine": "blockwise"}),
+    (120, "googlenet_s2d", "120_s2d", {}, {}),
+    (120, "googlenet_fused", "120_fused", {}, {}),
+    # Remat row: does relieving activation HBM pressure recover the
+    # batch-480 MFU decay?  (~25% extra trunk FLOPs for O(block)
+    # activation memory; numerically identical.)
+    (480, "googlenet", "480_remat", {"remat": True}, {}),
+    (256, "vit_b16", "vit_b16_256", {}, {"engine": "blockwise"}),
+)
+
+
+def known_row_names():
+    """The full --rows vocabulary; a name outside it is a typo."""
+    return {"headline"} | set(ENGINE_ROWS) | {
+        spec[2] for spec in BATCH_SCALING_SPECS
+    }
+
+
 def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None,
-                          rows=None, flush=None):
+                          rows=None, flush=None, selected=None):
     """Flagship solver throughput at batch 120/240/480 — does a bigger
     per-chip batch lift emb/s/chip (VERDICT r2 item 4)?  Plus the
     space-to-depth stem variant at batch 120: parity-preserving rewrite
@@ -657,28 +774,10 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None,
         rows = {}
     if flush is None:
         flush = lambda inflight=None: None  # noqa: E731
-    # Ordered by importance: the soft deadline may skip later rows.
-    # The parity-preserving MXU rewrites (s2d stem, fused inception
-    # 1x1s, both = "mxu") and the remat row answer PROFILE.md's open
-    # attribution questions with driver-captured numbers.  The vit_b16
-    # rows time BASELINE.json config 5's trunk (real ViT-B/16: patch 16,
-    # hidden 768, depth 12) through the blockwise (stretch-path) engine;
-    # the 256 row probes the largest batch and runs LAST so an OOM
-    # cannot cost any other row.
-    for batch, model_name, key, model_kw, solver_kw in (
-        (120, "googlenet", "120", {}, {}),
-        (120, "googlenet_mxu", "120_mxu", {}, {}),
-        (240, "googlenet", "240", {}, {}),
-        (480, "googlenet", "480", {}, {}),
-        (128, "vit_b16", "vit_b16_128", {}, {"engine": "blockwise"}),
-        (120, "googlenet_s2d", "120_s2d", {}, {}),
-        (120, "googlenet_fused", "120_fused", {}, {}),
-        # Remat row: does relieving activation HBM pressure recover the
-        # batch-480 MFU decay?  (~25% extra trunk FLOPs for O(block)
-        # activation memory; numerically identical.)
-        (480, "googlenet", "480_remat", {"remat": True}, {}),
-        (256, "vit_b16", "vit_b16_256", {}, {"engine": "blockwise"}),
-    ):
+    for batch, model_name, key, model_kw, solver_kw in BATCH_SCALING_SPECS:
+        if selected is not None and key not in selected:
+            rows[key] = {"skipped": "not selected (--rows)"}
+            continue
         if deadline is not None and time.time() > deadline:
             _log(f"batch scaling: skipping {key} (soft time budget reached)")
             rows[key] = {"skipped": "soft time budget reached"}
@@ -982,11 +1081,85 @@ def _load_last_good():
         return None
 
 
+def _headline_measured(rec) -> bool:
+    return bool(rec.get("value")) and not rec.get("headline_reused")
+
+
+def _measured_row_names(rec):
+    """Names of FRESHLY MEASURED rows in a full-mode record: "headline",
+    engine-extras names, and "batch_scaling/<key>"s.  Skip/error markers
+    and a reused headline do not count."""
+    names = set()
+    if _headline_measured(rec):
+        names.add("headline")
+    extras = rec.get("extras") or {}
+    for k, v in extras.items():
+        if k == "batch_scaling":
+            for bk, bv in (v or {}).items():
+                if isinstance(bv, dict) and "emb_per_sec" in bv:
+                    names.add(f"batch_scaling/{bk}")
+        elif isinstance(v, dict) and "emb_per_sec" in v:
+            names.add(k)
+    return names
+
+
+def _merge_rows(base, donor, prefer=frozenset()):
+    """A deep-copied ``base`` with ``donor``'s measured rows folded in
+    wherever ``base`` lacks a measured row (headline included) — the
+    merge direction for ADVICE #1: recovered rows are never lost, and a
+    sparser record never clobbers a richer one wholesale.
+
+    ``prefer`` names rows (the ``_measured_row_names`` vocabulary) whose
+    freshly measured donor value REPLACES the base's even when the base
+    already has one — the ``--rows`` re-pass direction: a row the
+    operator explicitly re-measured must win over the stale value it
+    was dispatched to replace."""
+    import copy
+
+    out = copy.deepcopy(base)
+    if _headline_measured(donor) and (
+        "headline" in prefer or not _headline_measured(out)
+    ):
+        for k in ("value", "vs_baseline", "ms_per_step",
+                  "ms_per_step_windows", "mfu", "step_flops",
+                  "fetch_floor_ms", "device_kind", "platform"):
+            if k in donor:
+                out[k] = copy.deepcopy(donor[k])
+        out.pop("headline_reused", None)
+    be = out.setdefault("extras", {})
+    for k, v in (donor.get("extras") or {}).items():
+        if k == "batch_scaling":
+            bbs = be.setdefault("batch_scaling", {})
+            for bk, bv in (v or {}).items():
+                cur = bbs.get(bk)
+                if isinstance(bv, dict) and "emb_per_sec" in bv and (
+                    f"batch_scaling/{bk}" in prefer
+                    or not (isinstance(cur, dict) and "emb_per_sec" in cur)
+                ):
+                    bbs[bk] = copy.deepcopy(bv)
+        elif isinstance(v, dict):
+            cur = be.get(k)
+            if "emb_per_sec" in v and (
+                k in prefer
+                or not (isinstance(cur, dict) and "emb_per_sec" in cur)
+            ):
+                be[k] = copy.deepcopy(v)
+        elif k not in be:  # scalar context keys (pool/steps/deltas)
+            be[k] = v
+    return out
+
+
 def _save_last_good(rec) -> None:
     """Persist a successful full TPU payload as the last-known-good cache.
 
     The file is committed to the repo so a future outage round still has
-    a machine-readable hardware number to report (flagged stale)."""
+    a machine-readable hardware number to report (flagged stale).
+
+    Partial records never clobber measured evidence (ADVICE #1/#2):
+    a ``--rows`` selective re-pass is MERGED into the existing payload,
+    and a same-day salvaged partial either defers to a complete payload
+    (as before) or is merged with the other salvage so the union of
+    measured rows survives, with the richer record as the base."""
     import datetime
 
     today = datetime.date.today().isoformat()
@@ -996,26 +1169,56 @@ def _save_last_good(rec) -> None:
             f"(batch {rec.get('batch')} @ {rec.get('image')})"
         )
         return
-    if rec.get("salvaged"):
-        # A salvaged partial must not clobber a complete payload captured
-        # the same day (e.g. an earlier successful run this round); it
-        # SHOULD replace anything older — a fresh headline beats a stale
-        # complete record.
-        lg = _load_last_good()
-        if (
-            lg
-            and not (lg.get("payload") or {}).get("salvaged")
-            and lg.get("date") == today
-        ):
+    lg = _load_last_good()
+    payload = (lg or {}).get("payload") or {}
+    date_out = today
+    if rec.get("rows_filter"):
+        if payload:
+            if not _headline_measured(rec) and lg and lg.get("date"):
+                # The top-level date drives the "same-day complete
+                # payload beats salvaged partial" rule: a rows merge
+                # that did NOT re-measure the headline must keep the
+                # base's date, or old headline evidence masquerades as
+                # today's and outranks a genuinely fresh salvage.
+                date_out = lg["date"]
+            # prefer = what this re-pass actually measured (skip/error
+            # markers and a reused headline never override the base).
+            merged = _merge_rows(payload, rec,
+                                 prefer=_measured_row_names(rec))
+            merged["rows_updated"] = {
+                "date": today, "rows": rec["rows_filter"],
+            }
+            rec = merged
+            _log("last-good cache: merged --rows re-pass into the "
+                 "existing payload")
+    elif rec.get("salvaged") and lg and lg.get("date") == today:
+        if not payload.get("salvaged"):
+            # A salvaged partial must not clobber a complete payload
+            # captured the same day (e.g. an earlier successful run this
+            # round); it SHOULD replace anything older — a fresh headline
+            # beats a stale complete record.
             _log("last-good cache kept: same-day complete payload beats "
                  "this salvaged partial")
             return
+        ours, theirs = _measured_row_names(rec), _measured_row_names(payload)
+        if len(ours) >= len(theirs):
+            rec = _merge_rows(rec, payload)
+        else:
+            # Strictly fewer measured rows: the existing salvage stays
+            # the base; this run's recovered rows are folded in rather
+            # than lost (the 2026-08-02 re-pass clobber, ADVICE #1).
+            rec = _merge_rows(payload, rec)
+            _log(
+                "last-good cache: same-day salvage has fewer measured "
+                f"rows ({len(ours)} < {len(theirs)}); merged into the "
+                "richer existing payload instead of replacing it"
+            )
     try:
         os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
         with open(LAST_GOOD_PATH, "w") as f:
             json.dump(
                 {
-                    "date": today,
+                    "date": date_out,
                     "provenance": "bench.py full run (fetch-synced timing)",
                     "payload": rec,
                 },
@@ -1073,17 +1276,37 @@ def main(argv=None) -> int:
     # record only if not even the headline was measured.
     ap.add_argument("--full-timeout", type=float, default=3000.0)
     ap.add_argument("--smoke-timeout", type=float, default=300.0)
+    ap.add_argument(
+        "--rows", default=None, metavar="NAME,...",
+        help="selective re-measure: only these rows ('headline', "
+        "engine-extras names like blockwise_flagship, batch_scaling "
+        "keys like vit_b16_128); everything else is marked skipped and "
+        "the result MERGES into bench_cache/last_good.json instead of "
+        "replacing it (re-pass recipe, ADVICE #2)",
+    )
     # child modes (internal)
     ap.add_argument("--child", choices=["probe", "full", "smoke"])
     ap.add_argument("--platform", default="default")
     ap.add_argument("--soft-budget", type=float, default=900.0)
     args = ap.parse_args(argv)
 
+    # Validate --rows BEFORE dispatching: a typo'd row name matches
+    # nothing downstream, so the re-pass would burn a tunnel-window
+    # child measuring zero rows while still stamping merge provenance.
+    if args.rows:
+        unknown = {r.strip() for r in args.rows.split(",") if r.strip()}
+        unknown -= known_row_names()
+        if unknown:
+            ap.error(
+                f"--rows: unknown row name(s) {sorted(unknown)}; "
+                f"known: {sorted(known_row_names())}"
+            )
+
     if args.child == "probe":
         return child_probe(args.platform)
     if args.child == "full":
         return child_full(args.platform, args.steps, args.warmup,
-                          args.soft_budget)
+                          args.soft_budget, rows=args.rows)
     if args.child == "smoke":
         return child_smoke(args.platform)
 
@@ -1140,12 +1363,13 @@ def main(argv=None) -> int:
 
     attempts = []
     if not args.smoke:
-        attempts.append((
-            ["--child", "full", "--platform", platform,
-             "--steps", str(args.steps), "--warmup", str(args.warmup),
-             "--soft-budget", str(args.full_timeout)],
-            args.full_timeout,
-        ))
+        full_args = ["--child", "full", "--platform", platform,
+                     "--steps", str(args.steps),
+                     "--warmup", str(args.warmup),
+                     "--soft-budget", str(args.full_timeout)]
+        if args.rows:
+            full_args += ["--rows", args.rows]
+        attempts.append((full_args, args.full_timeout))
     attempts.append((
         ["--child", "smoke", "--platform", platform], args.smoke_timeout,
     ))

@@ -138,6 +138,27 @@ def _build_solver(args):
         solver_cfg = dataclasses.replace(
             solver_cfg, snapshot_max_keep=args.snapshot_keep
         )
+    if getattr(args, "pipeline", False):
+        import dataclasses
+
+        solver_cfg = dataclasses.replace(
+            solver_cfg,
+            pipeline=True,
+            pipeline_depth=getattr(args, "pipeline_depth", 2) or 2,
+            pipeline_window=getattr(args, "pipeline_window", 0) or 0,
+        )
+    if getattr(args, "compile_cache", None):
+        import dataclasses
+
+        solver_cfg = dataclasses.replace(
+            solver_cfg, compile_cache=args.compile_cache
+        )
+        # Enable NOW, before any jit below compiles (snapshot restore,
+        # weight conversion) — the cache must cover every program this
+        # process builds, not just the train step.
+        from npairloss_tpu.pipeline import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
 
     crop = 0
     # Shape from the TRAIN layer, else the TEST layer (a net may define
@@ -1109,6 +1130,35 @@ def main(argv: Optional[list] = None) -> int:
         "--divergence-max-rollbacks", dest="divergence_max_rollbacks",
         type=int, default=2, metavar="N",
         help="rollbacks allowed before the guard halts anyway",
+    )
+    t.add_argument(
+        "--pipeline", action="store_true",
+        help="sync-free stepping (docs/PIPELINE.md): device-resident "
+        "double-buffered batch prefetch, per-step scalars accumulated "
+        "in a device-side ring and read back only at display/test/"
+        "snapshot window boundaries, dispatch depth bounded — the "
+        "device never waits on the host in steady state; parity-pinned "
+        "bit-identical to the default loop",
+    )
+    t.add_argument(
+        "--pipeline-depth", dest="pipeline_depth", type=int, default=2,
+        metavar="K",
+        help="prefetch depth AND max in-flight dispatched steps "
+        "(default 2 — double buffering)",
+    )
+    t.add_argument(
+        "--pipeline-window", dest="pipeline_window", type=int, default=0,
+        metavar="W",
+        help="cap on steps between host syncs (0 = auto: the smallest "
+        "active display/test/snapshot cadence, else 64); bounds the "
+        "divergence guard's detection staleness",
+    )
+    t.add_argument(
+        "--compile-cache", dest="compile_cache", metavar="DIR",
+        help="persistent XLA compilation cache directory: programs "
+        "compiled by ANY process land here, so reruns and sibling "
+        "processes deserialize instead of recompiling (the batch-480 "
+        "flagship compile ran 25 minutes — pay it once)",
     )
     t.add_argument(
         "--no-preempt-handler", dest="no_preempt_handler",

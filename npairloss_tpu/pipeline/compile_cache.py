@@ -1,0 +1,102 @@
+"""Persistent XLA compilation cache — compile once per program, ever.
+
+The batch-480 flagship compile ran 25 minutes and wedged the 2026-08-02
+tunnel window (PROFILE.md); nothing about that compile was specific to
+the process that paid for it.  This module points JAX's persistent
+compilation cache at a directory (``--compile-cache DIR`` /
+``SolverConfig.compile_cache``), with the thresholds zeroed so every
+program is cached — a second process lowering the same step hits the
+cache and its ``step/compile`` span collapses from minutes to the
+deserialization cost.
+
+The cache is an optimization, never a requirement: any config failure
+(older jax without a knob, read-only dir) is logged and ignored.  One
+home for the knob-twiddling — ``bench.py`` children, the Solver, and
+the CLI all route through :func:`enable_compile_cache`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("npairloss_tpu.pipeline")
+
+_ENABLED_DIR: Optional[str] = None
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The directory the cache was enabled at this process, or None."""
+    return _ENABLED_DIR
+
+
+def enable_compile_cache(cache_dir: str) -> Optional[str]:
+    """Enable the persistent compilation cache at ``cache_dir``.
+
+    Process-global (jax config) and idempotent; returns the absolute
+    path on success, None when the jax build has no cache support.
+    Thresholds are zeroed (min compile time / min entry size) because a
+    tunneled backend makes even small recompiles expensive.
+    """
+    global _ENABLED_DIR
+    import jax
+
+    path = os.path.abspath(cache_dir)
+    if _ENABLED_DIR == path:
+        return path
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as e:  # cache is an optimization, never a requirement
+        log.warning("compilation cache unavailable at %s: %s", cache_dir, e)
+        return None
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception as e:  # older jax: threshold knob absent
+            log.info("compilation cache knob %s unavailable: %s", knob, e)
+    try:
+        # jax initializes the cache object LAZILY AND ONCE: a process
+        # that dispatched anything before this call (the usual case — a
+        # Solver construction stages a few constants) latched the cache
+        # as "no dir configured, disabled" and would ignore the config
+        # update forever.  reset_cache() returns it to pristine so the
+        # next compile re-reads the config.  Internal API, so a failure
+        # degrades to "cache maybe inactive", never an error.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # pragma: no cover - jax-internals drift
+        log.info("compilation cache re-initialization unavailable: %s", e)
+    _ENABLED_DIR = path
+    log.info("persistent compilation cache: %s", path)
+    return path
+
+
+def disable_compile_cache() -> None:
+    """Turn the persistent cache back off (tests / embedders).
+
+    Sharp edge worth knowing (pinned by tests/test_pipeline.py): an
+    executable DESERIALIZED from the cache enforces its input-output
+    aliasing exactly as serialized — including donations a fresh compile
+    on this backend would have pruned as unusable (CPU).  Code holding
+    zero-copy ``np.asarray`` views of donated buffers across steps sees
+    them mutate under a cache hit where it happened not to without the
+    cache.  The framework never holds such views (checksums and metric
+    reads copy immediately); external callers should copy too.
+    """
+    global _ENABLED_DIR
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # pragma: no cover - jax-internals drift
+        log.info("compilation cache disable failed: %s", e)
+    _ENABLED_DIR = None

@@ -1,0 +1,146 @@
+"""Counting ``device_put``/``device_get`` shim — the no-mid-window proof.
+
+``jax.transfer_guard`` does not intercept transfers on the CPU backend
+(host-platform arrays are zero-copy), so the CI assertion "the pipelined
+loop issues no host transfers between window boundaries" cannot lean on
+it.  :class:`HostSyncMonitor` is the counting-shim alternative the
+acceptance contract names: it patches the public ``jax.device_put`` /
+``jax.device_get`` entry points (the ones every transfer in THIS
+codebase's pipelined path goes through — the prefetcher stages with an
+explicit ``device_put``, the window read is an explicit ``device_get``)
+and records each call with its thread and whether it happened inside an
+``allowed()`` region (a window boundary).
+
+Strict mode turns the record into an enforcement: a transfer on the
+guarded (train-loop) thread outside an allowed region raises
+:class:`SyncGuardViolation`.  The staging thread is exempt by design —
+moving the put OFF the step loop's thread is the whole point.
+
+Activation: tests attach a monitor via ``Solver.sync_monitor``; the CI
+smoke sets ``NPAIRLOSS_PIPELINE_SYNC_GUARD=strict`` (or ``count``) and
+the Solver picks it up via :func:`monitor_from_env`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+ENV_VAR = "NPAIRLOSS_PIPELINE_SYNC_GUARD"
+
+
+class SyncGuardViolation(RuntimeError):
+    """A host transfer happened mid-window on the guarded thread."""
+
+
+class HostSyncMonitor:
+    """Context manager; patch scope = its ``with`` block.
+
+    The thread that ENTERS the monitor is the guarded one.  Interceptions
+    aggregate into integer counters (:meth:`counts`) so a multi-day run
+    under ``count`` mode holds O(1) memory; only forbidden calls keep a
+    per-event ``{"op", "thread", "guarded_thread", "allowed"}`` record
+    (:meth:`violations`) — those are the forensic payload, and there are
+    at most a handful before someone notices.
+    """
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self._counts: Dict[str, int] = {
+            "put": 0, "get": 0, "put_guarded": 0, "get_guarded": 0,
+        }
+        self._violations: List[Dict[str, Any]] = []
+        self._local = threading.local()
+        self._guard_thread: Optional[int] = None
+        self._orig_put = None
+        self._orig_get = None
+        self._lock = threading.Lock()
+
+    # -- region control (the Solver marks window boundaries) ---------------
+
+    @contextlib.contextmanager
+    def allowed(self):
+        """Mark a region (window boundary / setup) where host syncs on
+        the guarded thread are legitimate."""
+        prev = getattr(self._local, "allowed", False)
+        self._local.allowed = True
+        try:
+            yield
+        finally:
+            self._local.allowed = prev
+
+    # -- interception ------------------------------------------------------
+
+    def _record(self, op: str) -> None:
+        thread = threading.get_ident()
+        on_guard = thread == self._guard_thread
+        allowed = (not on_guard) or getattr(self._local, "allowed", False)
+        with self._lock:
+            self._counts[op] += 1
+            if on_guard:
+                self._counts[op + "_guarded"] += 1
+            if not allowed:
+                self._violations.append({
+                    "op": op,
+                    "thread": thread,
+                    "guarded_thread": on_guard,
+                    "allowed": allowed,
+                })
+        if self.strict and not allowed:
+            raise SyncGuardViolation(
+                f"mid-window host sync: jax.{op} on the step-loop thread "
+                "outside a window boundary (the sync-free contract, "
+                "docs/PIPELINE.md)"
+            )
+
+    def violations(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._violations)
+
+    def counts(self) -> Dict[str, int]:
+        """{"put": n, "get": m, "put_guarded": ..., "get_guarded": ...}"""
+        with self._lock:
+            return dict(self._counts)
+
+    def __enter__(self) -> "HostSyncMonitor":
+        import jax
+
+        self._guard_thread = threading.get_ident()
+        orig_put = self._orig_put = jax.device_put
+        orig_get = self._orig_get = jax.device_get
+        monitor = self
+
+        # Bind the originals into the closures (not monitor._orig_put at
+        # call time): __exit__ on the loop thread nulls the attributes
+        # while the staging thread may still be inside a wrapper.
+        def put(*args, **kwargs):
+            monitor._record("put")
+            return orig_put(*args, **kwargs)
+
+        def get(*args, **kwargs):
+            monitor._record("get")
+            return orig_get(*args, **kwargs)
+
+        jax.device_put = put
+        jax.device_get = get
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        if self._orig_put is not None:
+            jax.device_put = self._orig_put
+        if self._orig_get is not None:
+            jax.device_get = self._orig_get
+        self._orig_put = self._orig_get = None
+
+
+def monitor_from_env() -> Optional[HostSyncMonitor]:
+    """Monitor per ``NPAIRLOSS_PIPELINE_SYNC_GUARD``: ``strict`` raises
+    on violations, ``count``/``1`` records only, unset/``0`` -> None."""
+    mode = os.environ.get(ENV_VAR, "").strip().lower()
+    if mode in ("", "0", "off"):
+        return None
+    return HostSyncMonitor(strict=(mode == "strict"))

@@ -1,0 +1,104 @@
+"""Device-side metric ring for the sync-free step loop.
+
+In the synchronous loop every consumer of a step scalar (telemetry, the
+loss window, the divergence guard) materializes it on the host — one
+sync per step.  :class:`MetricWindow` moves the accumulation into the
+jitted step: each step's metric scalars are scattered into a
+``[capacity, num_metrics]`` f32 ring riding the step's carry, alongside
+an in-graph consecutive-non-finite-loss counter, and the host reads the
+whole window back in ONE ``device_get`` at display/eval/snapshot
+boundaries (``step/window_sync``).
+
+The metric KEY ORDER is pinned to the jit output dict's own iteration
+order (pytree dicts flatten key-sorted), so per-step records
+reconstructed by :meth:`read` carry byte-identical key streams to the
+synchronous loop's — the parity contract tests/test_pipeline.py pins.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+
+class MetricWindow:
+    """``keys`` must be the sorted metric names of the step's output
+    dict (the order a jitted dict output iterates in); ``capacity`` is
+    the max steps between host reads — memory cost is
+    ``capacity * len(keys)`` f32, trivial at any real cadence."""
+
+    def __init__(self, keys: Sequence[str], capacity: int):
+        if capacity < 1:
+            raise ValueError(f"window capacity must be >= 1, got {capacity}")
+        if "loss" not in keys:
+            raise ValueError("metric keys must include 'loss' (the "
+                             "non-finite counter watches it)")
+        self.keys = tuple(keys)
+        self.capacity = int(capacity)
+        self._loss_idx = self.keys.index("loss")
+
+    # -- device side (called inside the jitted step) -----------------------
+
+    def init_ring(self) -> Dict[str, Any]:
+        """Fresh ring state (call under jit or let jax stage it)."""
+        import jax.numpy as jnp
+
+        return {
+            "buf": jnp.zeros((self.capacity, len(self.keys)), jnp.float32),
+            "pos": jnp.zeros((), jnp.int32),
+            # Consecutive-non-finite-loss streak, carried ACROSS windows
+            # (a streak spanning a boundary must not reset), plus the
+            # window's max — the guard's cheap trip signal.
+            "streak": jnp.zeros((), jnp.int32),
+            "max_streak": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, ring: Dict[str, Any],
+               metrics: Dict[str, Any]) -> Dict[str, Any]:
+        """One step's scalars into the ring; traced into the step."""
+        import jax
+        import jax.numpy as jnp
+
+        vals = jnp.stack(
+            [jnp.asarray(metrics[k]).astype(jnp.float32) for k in self.keys]
+        )
+        buf = jax.lax.dynamic_update_index_in_dim(
+            ring["buf"], vals, ring["pos"], axis=0
+        )
+        finite = jnp.isfinite(vals[self._loss_idx])
+        streak = jnp.where(finite, 0, ring["streak"] + 1).astype(jnp.int32)
+        return {
+            "buf": buf,
+            "pos": ring["pos"] + 1,
+            "streak": streak,
+            "max_streak": jnp.maximum(ring["max_streak"], streak),
+        }
+
+    def reset(self, ring: Dict[str, Any]) -> Dict[str, Any]:
+        """Rewind the write position for the next window (device-side —
+        jit this with donation so a reset moves no bytes).  The streak
+        survives; ``max_streak`` restarts as the streak in flight."""
+        import jax.numpy as jnp
+
+        return {
+            "buf": jnp.zeros_like(ring["buf"]),
+            "pos": jnp.zeros_like(ring["pos"]),
+            "streak": ring["streak"],
+            "max_streak": jnp.asarray(ring["streak"], jnp.int32),
+        }
+
+    # -- host side ---------------------------------------------------------
+
+    def read(self, ring_host: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Per-step metric dicts from a ``device_get`` of the ring, in
+        step order, values as ``np.float32`` scalars — key order is
+        exactly ``self.keys`` (the sync loop's key stream)."""
+        n = int(ring_host["pos"])
+        if n > self.capacity:
+            raise ValueError(
+                f"ring overflowed: {n} writes into capacity "
+                f"{self.capacity} — a window boundary was missed"
+            )
+        buf = np.asarray(ring_host["buf"])[:n]
+        return [dict(zip(self.keys, row)) for row in buf]

@@ -29,8 +29,13 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               resume must never see)
   ``data.worker``             crash the data prefetch worker (exercises
                               bounded respawn)
+  ``pipeline.stage``          crash the pipelined loop's device staging
+                              thread (exercises clean prefetcher drain +
+                              resume, docs/PIPELINE.md)
   ``step.nan_loss``           replace the step's loss with NaN (exercises
-                              the divergence guard)
+                              the divergence guard; in the pipelined loop
+                              the poison lands in the metric window at
+                              the next boundary read)
   ==========================  =============================================
 
 ``times`` counts fires: an armed point fires its next ``times`` checks

@@ -13,6 +13,12 @@ like progress.
 
 Complements ``obs.health`` (PR 2): health signals *show* the explosion
 coming; the guard *survives* it.
+
+Pipelined mode (``SolverConfig.pipeline``, docs/PIPELINE.md) removes
+the per-step sync: the jitted step carries an in-graph consecutive-
+non-finite counter, and the host replays the window's losses through
+``observe`` only at window-boundary reads — same trip step, same
+rollback, detected up to one window late (bounded staleness).
 """
 
 from __future__ import annotations

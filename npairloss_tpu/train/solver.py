@@ -21,6 +21,7 @@ import contextlib
 import dataclasses
 import logging
 import time
+import warnings
 from functools import partial
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
 
@@ -92,6 +93,22 @@ class SolverConfig:
     # (Caffe's behavior — snapshot_max_keep is this framework's own
     # extension, not a SolverParameter field).
     snapshot_max_keep: int = 0
+    # Sync-free stepping (docs/PIPELINE.md) — framework extensions, not
+    # SolverParameter fields.  ``pipeline`` routes ``train`` through the
+    # async loop: device-resident prefetch, per-step scalars accumulated
+    # in a device-side ring read back only at display/test/snapshot
+    # window boundaries, dispatch depth bounded by ``pipeline_depth``.
+    # Default OFF; the pipelined loop is parity-pinned bit-identical to
+    # the synchronous one (tests/test_pipeline.py).  ``pipeline_window``
+    # caps the steps between host syncs (0 = auto: the smallest active
+    # cadence, else 64) — it bounds the divergence guard's staleness.
+    pipeline: bool = False
+    pipeline_depth: int = 2
+    pipeline_window: int = 0
+    # Persistent XLA compilation cache directory ("" = off): no process
+    # recompiles a program another process already compiled (CLI
+    # ``--compile-cache``; pipeline.enable_compile_cache).
+    compile_cache: str = ""
 
 
 class Solver:
@@ -215,6 +232,16 @@ class Solver:
         self.state: Optional[Dict[str, Any]] = None
         self._step_fn = None
         self._eval_fn = None
+        # Pipelined-loop state (docs/PIPELINE.md): the ring-carrying
+        # jitted step, its device-side reset, and the window's key/
+        # capacity bookkeeping — rebuilt whenever cfg changes, like
+        # _step_fn.  ``sync_monitor`` is a test/CI hook: an attached
+        # pipeline.HostSyncMonitor counts (or, strict, forbids) host
+        # transfers outside window boundaries.
+        self._pipe_step_fn = None
+        self._ring_reset_fn = None
+        self._metric_window = None
+        self.sync_monitor = None
         self._checkpointer = None
         # A fresh config per solver: SolverConfig is mutable, so a shared
         # default instance would leak cfg edits across solvers.
@@ -245,6 +272,9 @@ class Solver:
         )
         self._step_fn = None  # recompile with the new schedule
         self._eval_fn = None
+        self._pipe_step_fn = None
+        self._ring_reset_fn = None
+        self._metric_window = None
 
     # -- state ------------------------------------------------------------
 
@@ -384,7 +414,7 @@ class Solver:
         metrics = {k: v.mean() for k, v in stacked.items() if k != "loss"}
         return loss, metrics
 
-    def _make_step(self):
+    def _train_step_body(self):
         def train_step(state, inputs, labels):
             def loss_fn(params):
                 emb, new_bs = self.apply_model(
@@ -425,6 +455,9 @@ class Solver:
             metrics["loss"] = loss
             return new_state, metrics
 
+        return train_step
+
+    def _eval_step_body(self):
         def eval_step(state, inputs, labels):
             emb, _ = self.apply_model(
                 state["params"], state["batch_stats"], inputs, train=False
@@ -433,6 +466,11 @@ class Solver:
             metrics["loss"] = loss
             return metrics
 
+        return eval_step
+
+    def _make_step(self):
+        train_step = self._train_step_body()
+        eval_step = self._eval_step_body()
         donate = (0,)
         if self.mesh is not None:
             data_sharding = NamedSharding(self.mesh, P(self.axis))
@@ -452,6 +490,133 @@ class Solver:
         # compile-capture bookkeeping so telemetry reports them as such.
         self._seen_step_shapes = set()
         self._seen_eval_shapes = set()
+
+    # -- pipelined step (docs/PIPELINE.md) ---------------------------------
+
+    def _pipeline_window_capacity(self, test_active: bool) -> int:
+        """Steps between host syncs: the smallest active cadence (a
+        window read happens AT every display/test/snapshot step, so the
+        ring never needs to span more than the smallest gap), capped by
+        ``cfg.pipeline_window``; 64 when no cadence is active."""
+        cfg = self.cfg
+        cads = [c for c in (
+            cfg.display,
+            cfg.test_interval if test_active else 0,
+            cfg.snapshot,
+        ) if c]
+        cap = min(cads) if cads else 0
+        user = int(cfg.pipeline_window or 0)
+        if user:
+            cap = min(cap, user) if cap else user
+        return max(int(cap) if cap else 64, 1)
+
+    def _make_pipelined_step(self, x, lab, capacity: int):
+        """Build the ring-carrying jitted step: the SAME train_step body
+        as the synchronous path (parity by construction) plus the
+        MetricWindow scatter and the in-graph non-finite streak counter.
+        Donation covers state AND the ring AND the batch args — the
+        prefetcher guarantees batch buffers are fresh per step, so the
+        jitted step can reuse them in place (the sync path cannot make
+        that promise: callers like bench.py redispatch one buffer)."""
+        from npairloss_tpu.pipeline import MetricWindow
+
+        train_step = self._train_step_body()
+        _, metrics_shape = jax.eval_shape(train_step, self.state, x, lab)
+        # Pytree dicts flatten key-sorted, so sorted() IS the jitted
+        # output dict's iteration order — the key-stream parity anchor.
+        window = MetricWindow(sorted(metrics_shape), capacity)
+
+        def pipelined_step(state, ring, inputs, labels):
+            new_state, metrics = train_step(state, inputs, labels)
+            new_ring = window.update(ring, metrics)
+            # ``tick`` is the dispatch controller's completion token:
+            # the host holds it across dispatches, so it needs its OWN
+            # buffer.  An identity (pos + 0) is folded by XLA and would
+            # alias pos — the next step's ring donation then conflicts
+            # with the held token on backends that honor donation
+            # (TPU).  pos + 1 is a distinct value, hence a distinct
+            # buffer, on every backend.
+            tick = new_ring["pos"] + jnp.int32(1)
+            return new_state, new_ring, tick
+
+        donate = (0, 1, 2, 3)
+        if self.mesh is not None:
+            data_sharding = NamedSharding(self.mesh, P(self.axis))
+            replicated = NamedSharding(self.mesh, P())
+            self._pipe_step_fn = jax.jit(
+                pipelined_step,
+                donate_argnums=donate,
+                in_shardings=(None, replicated, data_sharding, data_sharding),
+            )
+        else:
+            self._pipe_step_fn = jax.jit(pipelined_step,
+                                         donate_argnums=donate)
+        self._ring_reset_fn = jax.jit(window.reset, donate_argnums=(0,))
+        self._metric_window = window
+        # A rebuilt pipelined step is a NEW program (same policy as
+        # _make_step): without this reset, the real compile after a
+        # rollback's set_config would be mislabeled step/dispatch and
+        # skip the expected-donation-warning filter.
+        self._seen_step_shapes = set()
+
+    def _init_ring(self):
+        ring = self._metric_window.init_ring()
+        if self.mesh is not None:
+            ring = jax.device_put(ring, NamedSharding(self.mesh, P()))
+        return ring
+
+    def _stage_batch(self, inputs, labels):
+        """Device placement for the prefetcher's STAGING THREAD: an
+        explicit ``jax.device_put`` with the step's input sharding (so
+        the batch arrives resident and the put is visible to the
+        syncguard counting shim).  Dtypes are canonicalized to match
+        ``_put_batch``'s jnp.asarray semantics — the pipelined and
+        synchronous paths must compile identical signatures."""
+        if self.mesh is not None and jax.process_count() > 1:
+            from npairloss_tpu.parallel.distributed import process_local_batch
+
+            return process_local_batch(
+                self.mesh, (np.asarray(inputs), np.asarray(labels)), self.axis
+            )
+        inputs = np.asarray(inputs)
+        labels = np.asarray(labels)
+        if inputs.dtype == np.float64:
+            inputs = inputs.astype(np.float32)
+        if labels.dtype == np.int64:
+            labels = labels.astype(np.int32)
+        if self.mesh is not None:
+            sharding = NamedSharding(self.mesh, P(self.axis))
+            return jax.device_put((inputs, labels), sharding)
+        return jax.device_put((inputs, labels))
+
+    def warmup(self, batch_size: int) -> float:
+        """AOT-compile the train step for ``batch_size`` without
+        dispatching it (``.lower().compile()`` on shape structs — no
+        data, no state mutation); returns the compile seconds.
+
+        With ``cfg.compile_cache`` set this populates the persistent
+        compilation cache, so the first REAL dispatch (and every other
+        process compiling the same program) pays deserialization
+        instead of a multi-minute XLA compile — run it before a tunnel
+        window spends its minutes measuring."""
+        import time as _time
+
+        if self.cfg.compile_cache:
+            from npairloss_tpu.pipeline import enable_compile_cache
+
+            enable_compile_cache(self.cfg.compile_cache)
+        if self.state is None:
+            self.init()
+        if self._step_fn is None:
+            self._make_step()
+        x_sds = jax.ShapeDtypeStruct(
+            (int(batch_size), *self.input_shape), jnp.float32
+        )
+        lab_sds = jax.ShapeDtypeStruct((int(batch_size),), jnp.int32)
+        t0 = _time.perf_counter()
+        with self._span("step/compile", batch=int(batch_size), aot=True):
+            self._step_fn.lower(self.state, x_sds, lab_sds).compile()
+        return _time.perf_counter() - t0
 
     def _span(self, name: str, **args):
         """Telemetry span, or a no-op context when none is attached."""
@@ -600,26 +765,16 @@ class Solver:
         """
         cfg = self.cfg
         num_iters = num_iters if num_iters is not None else cfg.max_iter
-        start = self.iteration
-        if start:
-            log_fn(f"resuming from iteration {start}")
-            if start >= num_iters:
-                log_fn(
-                    f"nothing to do: restored iteration {start} >= "
-                    f"target {num_iters} (num_iters is the TOTAL "
-                    "max_iter target, not an increment)"
-                )
-        if (
-            start == 0
-            and cfg.test_initialization
-            and test_batches is not None
-            and cfg.test_iter > 0
-        ):
-            m = self.evaluate(test_batches, cfg.test_iter)
-            log_fn(f"iter 0 TEST {_fmt(m)}")
-            if record_fn is not None:
-                record_fn({"event": "test", "iteration": 0,
-                           **{k: float(v) for k, v in m.items()}})
+        if cfg.compile_cache:
+            from npairloss_tpu.pipeline import enable_compile_cache
+
+            enable_compile_cache(cfg.compile_cache)
+        if cfg.pipeline:
+            return self._train_pipelined(
+                train_batches, num_iters, test_batches, log_fn, record_fn
+            )
+        start = self._train_prologue(num_iters, test_batches, log_fn,
+                                     record_fn)
         tel = self.telemetry
         last = {}
         guard = (DivergenceGuard(self.divergence)
@@ -648,80 +803,348 @@ class Solver:
                         guard, step_num, log_fn, record_fn
                     )
                     continue
-                if tel is not None and tel.metrics_enabled \
-                        and not self._telemetry_failed:
-                    self._tel_log("train", step_num,
-                                  {k: float(v) for k, v in metrics.items()})
-                if cfg.display and step_num % cfg.display == 0:
-                    host = {k: float(v) for k, v in last.items()}
-                    avg = float(jnp.stack(list(self._loss_window)).mean())
-                    log_fn(
-                        f"iter {step_num} lr={host.get('lr', 0):.6g} "
-                        f"loss={avg:.6g} (avg over {len(self._loss_window)}) "
-                        + _fmt({k: v for k, v in host.items() if k not in ('loss', 'lr')})
-                    )
-                    if record_fn is not None:
-                        record_fn({"event": "display", "iteration": step_num,
-                                   "loss_avg": avg, **host})
-                if (
-                    test_batches is not None
-                    and cfg.test_interval
-                    and step_num % cfg.test_interval == 0
-                ):
-                    m = self.evaluate(test_batches, cfg.test_iter)
-                    log_fn(f"iter {step_num} TEST {_fmt(m)}")
-                    if record_fn is not None:
-                        record_fn({"event": "test", "iteration": step_num,
-                                   **{k: float(v) for k, v in m.items()}})
-                snapped = None
-                if cfg.snapshot and step_num % cfg.snapshot == 0:
-                    snapped = self.save_snapshot(step_num)
-                    if record_fn is not None:
-                        record_fn({"event": "snapshot",
-                                   "iteration": step_num})
-                if self.preempt is not None and self.preempt.requested:
-                    # Graceful preemption: the in-flight step finished
-                    # above; commit an emergency snapshot (unless the
-                    # cadence just did) and surface a typed stop the CLI
-                    # maps to EXIT_PREEMPTED for the supervisor.
-                    path = snapped or self.save_snapshot(step_num)
-                    log_fn(
-                        f"preempted at iter {step_num}: emergency "
-                        f"snapshot {path}; relaunch with --resume auto"
-                    )
-                    self._tel_event("preempt", step_num,
-                                    snapshot=path,
-                                    signum=self.preempt.signum)
-                    if record_fn is not None:
-                        record_fn({"event": "preempt",
-                                   "iteration": step_num,
-                                   "snapshot": path})
-                    raise TrainingPreempted(
-                        step_num, snapshot_path=path,
-                        signum=self.preempt.signum,
-                    )
+                self._emit_step_row(step_num, metrics, log_fn, record_fn)
+                self._boundary_actions(step_num, test_batches, log_fn,
+                                       record_fn)
                 it = step_num
         finally:
-            # EVERY exit path — normal completion, preemption, a raised
-            # step error — must land in-flight Orbax work before the
-            # process can exit, or the last snapshot is left as an
-            # .orbax-checkpoint-tmp dir.  Guarded: cleanup must not mask
-            # the in-flight exception.
-            if self._checkpointer is not None:
-                try:
-                    self._checkpointer.wait_until_finished()
-                except Exception as e:  # noqa: BLE001
-                    log.error("checkpointer drain failed: %s", e)
-            if tel is not None:
-                # Land metrics.jsonl/trace.json even when the owner
-                # forgets close() — flush is idempotent and the owner may
-                # keep logging.  Guarded like _tel_log: a full disk must
-                # not swallow a completed run's final metrics.
-                try:
-                    tel.flush()
-                except Exception as e:  # noqa: BLE001
-                    log.error("telemetry flush failed: %s", e)
+            self._train_epilogue()
         return {k: float(v) for k, v in last.items()}
+
+    def _train_prologue(self, num_iters, test_batches, log_fn,
+                        record_fn) -> int:
+        """Shared entry of both train loops: resume logging + the
+        iteration-0 TEST pass.  Returns the start iteration."""
+        cfg = self.cfg
+        start = self.iteration
+        if start:
+            log_fn(f"resuming from iteration {start}")
+            if start >= num_iters:
+                log_fn(
+                    f"nothing to do: restored iteration {start} >= "
+                    f"target {num_iters} (num_iters is the TOTAL "
+                    "max_iter target, not an increment)"
+                )
+        if (
+            start == 0
+            and cfg.test_initialization
+            and test_batches is not None
+            and cfg.test_iter > 0
+        ):
+            m = self.evaluate(test_batches, cfg.test_iter)
+            log_fn(f"iter 0 TEST {_fmt(m)}")
+            if record_fn is not None:
+                record_fn({"event": "test", "iteration": 0,
+                           **{k: float(v) for k, v in m.items()}})
+        return start
+
+    def _emit_step_row(self, step_num: int, row, log_fn=None,
+                       record_fn=None) -> None:
+        """Post-guard per-step emission — telemetry row + display line —
+        shared by the sync loop, the pipelined window replay, and the
+        pending-window flush, so the byte-identical-stream parity
+        contract (docs/PIPELINE.md) holds by construction instead of by
+        keeping three copies in lockstep.  ``log_fn=None`` (flush path)
+        skips display; a pending tail can never contain a display step
+        anyway (boundary steps always flush in-loop)."""
+        cfg = self.cfg
+        tel = self.telemetry
+        if tel is not None and tel.metrics_enabled \
+                and not self._telemetry_failed:
+            self._tel_log("train", step_num,
+                          {k: float(v) for k, v in row.items()})
+        if log_fn is not None and cfg.display \
+                and step_num % cfg.display == 0:
+            host = {k: float(v) for k, v in row.items()}
+            avg = float(jnp.stack(list(self._loss_window)).mean())
+            log_fn(
+                f"iter {step_num} lr={host.get('lr', 0):.6g} "
+                f"loss={avg:.6g} (avg over {len(self._loss_window)}) "
+                + _fmt({k: v for k, v in host.items()
+                        if k not in ('loss', 'lr')})
+            )
+            if record_fn is not None:
+                record_fn({"event": "display", "iteration": step_num,
+                           "loss_avg": avg, **host})
+
+    def _boundary_actions(self, step_num: int, test_batches, log_fn,
+                          record_fn) -> None:
+        """The test/snapshot/preempt cadence block shared by both train
+        loops (the pipelined loop runs it only at window boundaries —
+        which is no restriction, since those cadences force a boundary).
+        Raises :class:`TrainingPreempted` on a requested preemption:
+        the in-flight step finished above; commit an emergency snapshot
+        (unless the cadence just did) and surface a typed stop the CLI
+        maps to EXIT_PREEMPTED for the supervisor."""
+        cfg = self.cfg
+        if (
+            test_batches is not None
+            and cfg.test_interval
+            and step_num % cfg.test_interval == 0
+        ):
+            m = self.evaluate(test_batches, cfg.test_iter)
+            log_fn(f"iter {step_num} TEST {_fmt(m)}")
+            if record_fn is not None:
+                record_fn({"event": "test", "iteration": step_num,
+                           **{k: float(v) for k, v in m.items()}})
+        snapped = None
+        if cfg.snapshot and step_num % cfg.snapshot == 0:
+            snapped = self.save_snapshot(step_num)
+            if record_fn is not None:
+                record_fn({"event": "snapshot",
+                           "iteration": step_num})
+        if self.preempt is not None and self.preempt.requested:
+            path = snapped or self.save_snapshot(step_num)
+            log_fn(
+                f"preempted at iter {step_num}: emergency "
+                f"snapshot {path}; relaunch with --resume auto"
+            )
+            self._tel_event("preempt", step_num,
+                            snapshot=path,
+                            signum=self.preempt.signum)
+            if record_fn is not None:
+                record_fn({"event": "preempt",
+                           "iteration": step_num,
+                           "snapshot": path})
+            raise TrainingPreempted(
+                step_num, snapshot_path=path,
+                signum=self.preempt.signum,
+            )
+
+    def _train_epilogue(self) -> None:
+        """Shared exit of both train loops — EVERY exit path (normal
+        completion, preemption, a raised step error) must land in-flight
+        Orbax work before the process can exit, or the last snapshot is
+        left as an .orbax-checkpoint-tmp dir.  Guarded: cleanup must not
+        mask the in-flight exception."""
+        if self._checkpointer is not None:
+            try:
+                self._checkpointer.wait_until_finished()
+            except Exception as e:  # noqa: BLE001
+                log.error("checkpointer drain failed: %s", e)
+        if self.telemetry is not None:
+            # Land metrics.jsonl/trace.json even when the owner forgets
+            # close() — flush is idempotent and the owner may keep
+            # logging.  Guarded like _tel_log: a full disk must not
+            # swallow a completed run's final metrics.
+            try:
+                self.telemetry.flush()
+            except Exception as e:  # noqa: BLE001
+                log.error("telemetry flush failed: %s", e)
+
+    def _train_pipelined(self, train_batches, num_iters, test_batches,
+                         log_fn, record_fn) -> Dict[str, float]:
+        """The sync-free counterpart of the loop above (docs/PIPELINE.md).
+
+        Steady state does NO host transfers: batches arrive device-
+        resident from the prefetcher's staging thread, the jitted step
+        scatters its scalars into a device-side ring, and the host reads
+        the whole window back in one ``device_get`` only at display/
+        test/snapshot boundaries (``step/window_sync`` span).  Per-step
+        records (telemetry rows, the loss window, display lines, the
+        divergence guard's observations) are reconstructed from the ring
+        at the boundary with IDENTICAL keys/values to the synchronous
+        loop — only their wall-clock emission time is deferred (bounded
+        staleness: at most ``_pipeline_window_capacity()`` steps).
+        Dispatch depth is bounded by ``cfg.pipeline_depth`` so async
+        dispatch cannot queue unboundedly against a wedging backend.
+        """
+        from npairloss_tpu.pipeline import (
+            DevicePrefetcher,
+            DispatchController,
+            monitor_from_env,
+        )
+
+        cfg = self.cfg
+        if self.state is None:
+            self.init()
+        if self._eval_fn is None:
+            # Build the sync/eval fns up front: a lazy _make_step inside
+            # a mid-run evaluate() would reset the compile-capture
+            # bookkeeping and mislabel the next dispatch as a compile.
+            self._make_step()
+        start = self._train_prologue(num_iters, test_batches, log_fn,
+                                     record_fn)
+        tel = self.telemetry
+        guard = (DivergenceGuard(self.divergence)
+                 if self.divergence is not None else None)
+        mon = (self.sync_monitor if self.sync_monitor is not None
+               else monitor_from_env())
+
+        def allowed():
+            return (mon.allowed() if mon is not None
+                    else contextlib.nullcontext())
+
+        depth = max(int(cfg.pipeline_depth), 1)
+        window_cap = self._pipeline_window_capacity(test_batches is not None)
+        controller = DispatchController(depth)
+        prefetcher = DevicePrefetcher(
+            train_batches, self._stage_batch, depth=depth, span=self._span
+        )
+        last: Dict[str, Any] = {}
+        ring = None
+        it = start
+        window_start = it + 1
+        poisoned: list = []  # step.nan_loss fires, host-side
+        try:
+            with warnings.catch_warnings(), \
+                    (mon if mon is not None else contextlib.nullcontext()):
+                # Batch-arg donation is best-effort: backends that
+                # cannot alias the batch buffers (CPU) fall back to
+                # copies, and XLA's per-compile warning about it is
+                # expected, not a bug.  ONE filter for the whole loop
+                # (a per-step catch_warnings would copy global filter
+                # state on the hot path), covering sharding-keyed
+                # recompiles the shape heuristic cannot predict.
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable",
+                )
+                while it < num_iters:
+                    with self._span("data/next_batch", staged=True):
+                        x, lab = prefetcher.get()
+                    if self._pipe_step_fn is None:
+                        with allowed():
+                            self._make_pipelined_step(x, lab, window_cap)
+                    if ring is None:
+                        with allowed():
+                            ring = self._init_ring()
+                    controller.reserve()
+                    sig = (tuple(np.shape(x)), tuple(np.shape(lab)))
+                    compiling = sig not in self._seen_step_shapes
+                    self._seen_step_shapes.add(sig)
+                    if tel is not None and compiling \
+                            and len(self._seen_step_shapes) > 1:
+                        tel.instant("step/recompile",
+                                    batch=int(np.shape(x)[0]))
+                    cache_size = getattr(self._pipe_step_fn,
+                                         "_cache_size", lambda: None)
+                    n_before = cache_size()
+                    with self._span(
+                        "step/compile" if compiling else "step/dispatch",
+                        batch=int(np.shape(x)[0]), pipeline=True,
+                    ):
+                        self.state, ring, tick = self._pipe_step_fn(
+                            self.state, ring, x, lab
+                        )
+                    if (tel is not None and not compiling
+                            and n_before is not None
+                            and cache_size() != n_before):
+                        # The executable cache grew under an already-
+                        # seen shape: a sharding/aval-keyed recompile
+                        # the heuristic mislabeled step/dispatch —
+                        # surface the stall in the trace anyway.
+                        tel.instant("step/recompile",
+                                    batch=int(np.shape(x)[0]),
+                                    keyed="sharding")
+                    controller.admit(tick)
+                    step_num = int(it) + 1
+                    if failpoints.should_fire("step.nan_loss"):
+                        # The sync loop poisons the OBSERVED loss on
+                        # host (state untouched); here the observation
+                        # lives in the ring, so remember the step and
+                        # poison the row at window-read time.
+                        poisoned.append(step_num)
+                    it = step_num
+                    preempt_now = (self.preempt is not None
+                                   and self.preempt.requested)
+                    boundary = (
+                        (cfg.display and step_num % cfg.display == 0)
+                        or (test_batches is not None and cfg.test_interval
+                            and step_num % cfg.test_interval == 0)
+                        or (cfg.snapshot and step_num % cfg.snapshot == 0)
+                        or (step_num - window_start + 1 >= window_cap)
+                        or step_num >= num_iters
+                        or preempt_now
+                    )
+                    if not boundary:
+                        continue
+                    # ---- window boundary: the ONE host sync ----------
+                    with allowed():
+                        with self._span(
+                            "step/window_sync",
+                            steps=step_num - window_start + 1,
+                        ):
+                            host_ring = jax.device_get(ring)
+                            ring = self._ring_reset_fn(ring)
+                        rows = self._metric_window.read(host_ring)
+                        for s in poisoned:
+                            rows[s - window_start]["loss"] = \
+                                np.float32("nan")
+                        # The in-graph counter IS the window-edge trip
+                        # check: max_streak == 0 proves every loss in
+                        # (or carried into) this window was finite, so
+                        # the guard's per-row replay below can be
+                        # skipped wholesale.  Host-side poison
+                        # (step.nan_loss) is invisible to the device
+                        # counter, hence the OR on ``poisoned`` — and
+                        # on guard.streak, so an all-finite window
+                        # still replays to RESET a streak a previous
+                        # window's poison left in flight.
+                        nonfinite_seen = bool(poisoned) or \
+                            int(host_ring["max_streak"]) > 0 or \
+                            (guard is not None and guard.streak > 0)
+                        tripped = None
+                        for off, row in enumerate(rows):
+                            s = window_start + off
+                            self._loss_window.append(row["loss"])
+                            last = row
+                            if guard is not None and nonfinite_seen and \
+                                    guard.observe(float(row["loss"])):
+                                tripped = s
+                                break
+                            self._emit_step_row(s, row, log_fn, record_fn)
+                        if tripped is not None:
+                            # In-graph counter + window replay agreed the
+                            # streak crossed patience; the steps already
+                            # dispatched past the trip are discarded (the
+                            # documented bounded-staleness cost) and the
+                            # rollback machinery runs unchanged.
+                            controller.drain()
+                            it = self._handle_divergence(
+                                guard, tripped, log_fn, record_fn
+                            )
+                            ring = None  # cfg may have been replaced
+                            window_start = it + 1
+                            poisoned = []
+                            continue
+                        self._boundary_actions(step_num, test_batches,
+                                               log_fn, record_fn)
+                    window_start = step_num + 1
+                    poisoned = []
+        finally:
+            prefetcher.close()
+            last = self._flush_pending_window(ring, window_start,
+                                              poisoned, last)
+            self._train_epilogue()
+        return {k: float(v) for k, v in last.items()}
+
+    def _flush_pending_window(self, ring, window_start: int, poisoned,
+                              last):
+        """Salvage the un-flushed tail of a window on an abnormal exit
+        (data exhaustion, a staging-thread error, a raised step error)
+        — the synchronous loop would already have emitted these rows,
+        and the deferred-emission contract (docs/PIPELINE.md) promises
+        only their TIMING differs.  Boundary steps always flush
+        in-loop, so a pending tail can never contain a display/test/
+        snapshot step: telemetry rows + the loss window are the whole
+        debt.  Best-effort — teardown must not mask the in-flight
+        exception."""
+        if ring is None or self._metric_window is None:
+            return last
+        try:
+            rows = self._metric_window.read(jax.device_get(ring))
+            for s in poisoned:
+                if 0 <= s - window_start < len(rows):
+                    rows[s - window_start]["loss"] = np.float32("nan")
+            for off, row in enumerate(rows):
+                s = window_start + off
+                self._loss_window.append(row["loss"])
+                last = row
+                self._emit_step_row(s, row)
+        except Exception as e:  # noqa: BLE001
+            log.error("pending-window flush failed: %s", e)
+        return last
 
     def _handle_divergence(self, guard, step_num: int, log_fn,
                            record_fn) -> int:

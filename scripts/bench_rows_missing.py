@@ -105,7 +105,18 @@ def main() -> None:
     # the before-call ("don't re-pass for a row bench.py will skip")
     # but wrong as a success criterion — a re-pass that wedged and
     # auto-quarantined a VERDICT row must not read as DONE.
+    # --print-rows: the bench.py --rows argument for a selective
+    # re-pass — the missing dispatchable rows, comma-separated (empty
+    # output = nothing to re-measure).
     strict = "--strict" in sys.argv[1:]
+    print_rows = "--print-rows" in sys.argv[1:]
+    if not quarantine_ok and print_rows:
+        # Same refusal as the before-call: without quarantine protection
+        # a green-lit dispatch could hit known tunnel-wedgers.
+        print("")
+        print("quarantine.json unparseable — refusing to emit a --rows "
+              "list; fix or delete the file first", file=sys.stderr)
+        return
     if not quarantine_ok and not strict:
         # Before-call with no quarantine protection: do NOT dispatch.
         print("no")
@@ -118,6 +129,9 @@ def main() -> None:
         if not _measured(rows.get(k))
         and (strict or k not in quarantine)
     ]
+    if print_rows:
+        print(",".join(missing))
+        return
     print("yes" if missing else "no")
     if missing:
         print(f"missing rows: {missing}", file=sys.stderr)

@@ -74,6 +74,61 @@ grep -q "resuming from iteration" "$smoke_dir/run3.log" \
     || { echo "smoke: relaunch did not resume"; cat "$smoke_dir/run3.log"; exit 1; }
 echo "fault-injection smoke OK (preempted at iter $k, resumed, finished)"
 
+echo "== pipelined-solver smoke (docs/PIPELINE.md) =="
+# Sync-free loop, 20 steps, with the strict sync guard armed: ANY host
+# transfer on the step-loop thread between window boundaries raises
+# SyncGuardViolation and fails the run — the counting-device_put-shim
+# assertion of the no-mid-window-host-syncs contract.
+cat > "$smoke_dir/p_solver.prototxt" <<EOF
+net: "examples/tiny_net.prototxt"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+max_iter: 20
+display: 5
+test_interval: 0
+test_iter: 0
+snapshot: 0
+snapshot_prefix: "$smoke_dir/p_"
+EOF
+NPAIRLOSS_PIPELINE_SYNC_GUARD=strict JAX_PLATFORMS=cpu \
+    python -m npairloss_tpu train --solver "$smoke_dir/p_solver.prototxt" \
+    --model mlp --synthetic --pipeline > "$smoke_dir/pipe.log" 2>&1 \
+    || { echo "smoke: pipelined run failed (mid-window host sync?)"; cat "$smoke_dir/pipe.log"; exit 1; }
+grep -q "iter 20 " "$smoke_dir/pipe.log" \
+    || { echo "smoke: pipelined run missing display output"; cat "$smoke_dir/pipe.log"; exit 1; }
+echo "pipelined smoke OK (20 steps, zero mid-window host syncs)"
+
+echo "== compile-cache round-trip (persistent XLA cache) =="
+# Two fresh processes compile the same step; the second must hit the
+# cache: the cache dir gains no new entries and its step/compile span
+# is the deserialization cost, not an XLA compile.
+cache_dir="$smoke_dir/xla_cache"
+for i in 1 2; do
+    JAX_PLATFORMS=cpu python -m npairloss_tpu train \
+        --solver "$smoke_dir/p_solver.prototxt" --model mlp --synthetic \
+        --max_iter 2 --compile-cache "$cache_dir" \
+        --trace-dir "$smoke_dir/trace$i" > "$smoke_dir/cc$i.log" 2>&1 \
+        || { echo "smoke: compile-cache run $i failed"; cat "$smoke_dir/cc$i.log"; exit 1; }
+    n=$(ls "$cache_dir" | grep -c -- '-cache$' || true)
+    eval "entries$i=$n"
+done
+[[ "${entries1:-0}" -gt 0 ]] \
+    || { echo "smoke: compile cache not populated"; exit 1; }
+[[ "${entries2}" -eq "${entries1}" ]] \
+    || { echo "smoke: second process MISSED the compile cache (${entries1} -> ${entries2} entries)"; exit 1; }
+python - "$smoke_dir/trace1/trace.json" "$smoke_dir/trace2/trace.json" <<'EOF'
+import json, sys
+durs = []
+for path in sys.argv[1:]:
+    evs = json.load(open(path))["traceEvents"]
+    compiles = [e for e in evs if e["name"] == "step/compile"]
+    assert compiles, f"{path}: no step/compile span"
+    durs.append(max(e["dur"] for e in compiles) / 1e3)
+print(f"step/compile: cold {durs[0]:.0f} ms -> cached {durs[1]:.0f} ms")
+EOF
+echo "compile-cache round-trip OK (no new entries on the second process)"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

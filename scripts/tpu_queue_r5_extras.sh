@@ -53,17 +53,29 @@ echo "=== $(date) 1/2 bench re-pass for wedge-lost batch rows ==="
 need_repass=$(python scripts/bench_rows_missing.py)
 echo "bench re-pass needed: ${need_repass:-checker crashed (fail-open)}"
 if [ "$need_repass" != "no" ]; then  # fail-open: crash/empty => re-pass
-  # The re-pass's record wholesale-replaces last_good.json; keep the
-  # first pass's payload so rows it measured can never be lost to a
-  # worse re-pass (evidence prose can cite either, with provenance).
-  # -n: never clobber an existing backup on an operator re-run.
+  # Belt-and-braces backup: bench.py --rows MERGES into last_good.json
+  # (a selective record can no longer clobber measured rows), but an
+  # operator re-run without --rows still replaces — keep the pass-1
+  # payload either way.  -n: never clobber an existing backup.
   if [ -f bench_cache/last_good.json ]; then
     cp -n bench_cache/last_good.json bench_cache/last_good_pass1.json
     [ -f bench_cache/last_good_pass1.json ] \
       || echo "WARNING: pass-1 backup failed; re-pass may clobber rows"
   fi
+  # Selective re-measure (bench.py --rows, ADVICE #2): only the wanted
+  # rows still missing are dispatched — the re-pass no longer spends
+  # ~70 min re-measuring the headline + eleven engine rows before
+  # reaching the batch rows it exists to recover.  Empty list with a
+  # fail-open "yes" above means the checker couldn't read last_good:
+  # fall back to the full sweep.
+  rows=$(python scripts/bench_rows_missing.py --print-rows)
+  echo "re-pass rows: ${rows:-<full sweep>}"
   if wait_tunnel; then
-    timeout 4200 python bench.py > /tmp/bench_out_repass.json
+    if [ -n "$rows" ]; then
+      timeout 4200 python bench.py --rows "$rows" > /tmp/bench_out_repass.json
+    else
+      timeout 4200 python bench.py > /tmp/bench_out_repass.json
+    fi
     echo "bench re-pass rc=$?"
     tail -c 600 /tmp/bench_out_repass.json 2>/dev/null; echo
   fi

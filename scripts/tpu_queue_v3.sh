@@ -19,6 +19,17 @@
 cd "$(dirname "$0")/.."
 exec > /tmp/tpu_queue_v3.log 2>&1
 
+# Step sentinels are keyed to the HEAD short-sha (ADVICE #3): a later
+# run of this script in the same container AFTER source changes must
+# not silently skip steps 3-6 on stale sentinels — new code means
+# re-measure.  (Committed artifacts like step 4's JSON are separate:
+# they are evidence tied to the commit that produced them.)
+SHA=$(git rev-parse --short HEAD 2>/dev/null || echo nosha)
+S3=/tmp/tpu_q_${SHA}_step3.done
+S4=/tmp/tpu_q_${SHA}_step4.done
+S5=/tmp/tpu_q_${SHA}_step5.done
+S6=/tmp/tpu_q_${SHA}_step6.done
+
 probe() {
   timeout 100 python -c \
     'import jax,sys; sys.exit(jax.devices()[0].platform != "tpu")' \
@@ -87,7 +98,7 @@ echo "profile rc=$profile_rc"
 # Sentinels live in /tmp: a container restart clears them, which only
 # costs a re-measure, never correctness.
 echo "=== $(date) 3/6 tpu_pallas_check (parity + stretch, cached@16k) ==="
-if [ -f /tmp/tpu_q_step3.done ]; then
+if [ -f "$S3" ]; then
   echo "step 3 SKIPPED: done sentinel present"
 else
   wait_tunnel || { echo "GAVE UP (step 3)"; exit 1; }
@@ -97,12 +108,12 @@ else
   echo "tpu_pallas_check rc=$rc"
   tail -c 2000 /tmp/tpu_check_out.json
   if [ "$rc" = 0 ]; then
-    python scripts/split_pallas_check.py && touch /tmp/tpu_q_step3.done
+    python scripts/split_pallas_check.py && touch "$S3"
   fi
 fi
 
 echo "=== $(date) 4/6 TPU accuracy smoke (e2e real-JPEG on the chip) ==="
-if [ -f /tmp/tpu_q_step4.done ] || [ -f accuracy/e2e_real_jpeg_tpu.json ]
+if [ -f "$S4" ] || [ -f accuracy/e2e_real_jpeg_tpu.json ]
 then
   echo "step 4 SKIPPED: artifact or sentinel present"
 else
@@ -113,11 +124,11 @@ else
     --artifact accuracy/e2e_real_jpeg_tpu.json
   rc=$?
   echo "e2e tpu rc=$rc"
-  [ "$rc" = 0 ] && touch /tmp/tpu_q_step4.done
+  [ "$rc" = 0 ] && touch "$S4"
 fi
 
 echo "=== $(date) 5/6 diag_sim_cache 8192,16384 (safe pools) ==="
-if [ -f /tmp/tpu_q_step5.done ]; then
+if [ -f "$S5" ]; then
   echo "step 5 SKIPPED: done sentinel present"
 else
   wait_tunnel || { echo "GAVE UP (step 5)"; exit 1; }
@@ -125,27 +136,37 @@ else
     --pools 8192,16384
   rc=$?
   echo "diag safe rc=$rc"
-  [ "$rc" = 0 ] && touch /tmp/tpu_q_step5.done
+  [ "$rc" = 0 ] && touch "$S5"
 fi
 
 echo "=== $(date) 6/6 diag_sim_cache 24576 (WEDGE-RISK, runs last) ==="
-if [ -f /tmp/tpu_q_step6.done ]; then
+if [ -f "$S6" ]; then
   echo "step 6 SKIPPED: done sentinel present"
 else
   wait_tunnel || { echo "GAVE UP (step 6)"; exit 1; }
   timeout 1200 python scripts/diag_sim_cache.py --pools 24576
   rc=$?
   echo "diag 24576 rc=$rc"
-  [ "$rc" = 0 ] && touch /tmp/tpu_q_step6.done
+  [ "$rc" = 0 ] && touch "$S6"
 fi
 
 # DONE only when the profile re-measure — the round's #1 evidence item
-# — is complete (rc 0 = every variant measured or terminally wedged).
-# rc 4 means retryable variants remain: exit nonzero so the supervisor
-# relaunches us; bench's freshness skip and steps 3-6's sentinels make
-# the relaunch go straight back to the profile.
-if [ "${profile_rc:-1}" = 0 ]; then
+# — is complete (rc 0 = every variant measured or terminally wedged)
+# AND every step 3-6 left its sentinel (ADVICE #4: the old gate checked
+# only profile_rc, so a failed step's artifact was silently lost for
+# the round once the supervisor saw DONE and stopped relaunching).
+# Step 4's committed artifact counts as its sentinel — it is evidence,
+# not a /tmp marker.
+missing=""
+[ -f "$S3" ] || missing="$missing step3"
+[ -f "$S4" ] || [ -f accuracy/e2e_real_jpeg_tpu.json ] || missing="$missing step4"
+[ -f "$S5" ] || missing="$missing step5"
+[ -f "$S6" ] || missing="$missing step6"
+if [ "${profile_rc:-1}" = 0 ] && [ -z "$missing" ]; then
   echo "=== $(date) QUEUE V3 DONE ==="
+elif [ "${profile_rc:-1}" = 0 ]; then
+  echo "=== $(date) QUEUE V3 PARTIAL: steps without sentinels:${missing} — supervisor will relaunch (sentinels are keyed to HEAD=$SHA) ==="
+  exit 1
 else
   echo "=== $(date) QUEUE V3 PASS COMPLETE but profile incomplete (rc=${profile_rc:-unset}); supervisor will relaunch ==="
   exit 1

@@ -283,3 +283,213 @@ def test_measure_windows_min_and_deadline(bench):
     dts = bench._measure(step, [], warmup=0, steps=3, fetch=fetch,
                          floor=0.0, repeats=2, deadline=0.0)
     assert len(dts) == 1 and calls["step"] == 3
+
+
+def test_same_day_salvage_merge_keeps_richer_base(bench, monkeypatch,
+                                                  tmp_path):
+    """ADVICE #1: a same-day salvaged record with strictly FEWER
+    measured rows must not clobber the richer same-day salvage — its
+    recovered rows merge into the existing payload instead."""
+    import datetime
+
+    lg = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(lg))
+    today = datetime.date.today().isoformat()
+    rich = {"value": 4000.0, "mode": "full", "salvaged": True,
+            "extras": {"pool": 4096,
+                       "dense_abs": {"emb_per_sec": 5.0},
+                       "ring_abs": {"emb_per_sec": 6.0},
+                       "batch_scaling": {"120": {"emb_per_sec": 7.0},
+                                         "480": {"error": "wedge"}}}}
+    lg.write_text(json.dumps({"date": today, "payload": rich}))
+    sparse = {"value": 4100.0, "mode": "full", "salvaged": True,
+              "extras": {"batch_scaling":
+                         {"vit_b16_128": {"emb_per_sec": 9.0}}}}
+    bench._save_last_good(sparse)
+    out = json.load(open(lg))["payload"]
+    # Richer base survives (headline + engine rows), recovered row lands.
+    assert out["value"] == 4000.0
+    assert out["extras"]["dense_abs"] == {"emb_per_sec": 5.0}
+    assert out["extras"]["batch_scaling"]["120"] == {"emb_per_sec": 7.0}
+    assert out["extras"]["batch_scaling"]["vit_b16_128"] == \
+        {"emb_per_sec": 9.0}
+
+
+def test_same_day_salvage_merge_richer_replaces_but_keeps_rows(
+        bench, monkeypatch, tmp_path):
+    """The other branch: a same-day salvage with MORE measured rows
+    becomes the base, but the older salvage's measured rows it did not
+    re-measure are folded in rather than lost."""
+    import datetime
+
+    lg = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(lg))
+    today = datetime.date.today().isoformat()
+    old = {"value": 4000.0, "mode": "full", "salvaged": True,
+           "extras": {"ring_abs": {"emb_per_sec": 6.0}}}
+    lg.write_text(json.dumps({"date": today, "payload": old}))
+    new = {"value": 4200.0, "mode": "full", "salvaged": True,
+           "extras": {"dense_abs": {"emb_per_sec": 5.0},
+                      "batch_scaling": {"120": {"emb_per_sec": 7.0}}}}
+    bench._save_last_good(new)
+    out = json.load(open(lg))["payload"]
+    assert out["value"] == 4200.0  # richer record is the base
+    assert out["extras"]["dense_abs"] == {"emb_per_sec": 5.0}
+    assert out["extras"]["ring_abs"] == {"emb_per_sec": 6.0}  # kept
+
+
+def test_rows_filter_record_merges_into_last_good(bench, monkeypatch,
+                                                  tmp_path):
+    """A --rows selective re-pass record MERGES into the existing
+    payload (measured rows win over skip markers) instead of wholesale
+    replacement, and stamps rows_updated provenance."""
+    lg = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(lg))
+    full = {"value": 4000.0, "mode": "full",
+            "extras": {"dense_abs": {"emb_per_sec": 5.0},
+                       "batch_scaling": {"120": {"emb_per_sec": 7.0},
+                                         "vit_b16_128": {"error": "x"}}}}
+    lg.write_text(json.dumps({"date": "2026-07-01", "payload": full}))
+    repass = {"value": 4000.0, "mode": "full", "headline_reused": True,
+              "rows_filter": ["vit_b16_128"],
+              "extras": {"dense_abs": {"skipped": "not selected (--rows)"},
+                         "batch_scaling":
+                         {"120": {"skipped": "not selected (--rows)"},
+                          "vit_b16_128": {"emb_per_sec": 9.0}}}}
+    bench._save_last_good(repass)
+    out = json.load(open(lg))
+    pay = out["payload"]
+    assert pay["value"] == 4000.0
+    assert pay["extras"]["dense_abs"] == {"emb_per_sec": 5.0}
+    assert pay["extras"]["batch_scaling"]["120"] == {"emb_per_sec": 7.0}
+    assert pay["extras"]["batch_scaling"]["vit_b16_128"] == \
+        {"emb_per_sec": 9.0}
+    assert pay["rows_updated"]["rows"] == ["vit_b16_128"]
+
+
+def test_rows_repass_replaces_already_measured_row(bench, monkeypatch,
+                                                   tmp_path):
+    """An explicitly re-measured --rows row REPLACES the base's stale
+    measured value (prefer semantics) — otherwise the re-pass is
+    silently discarded while rows_updated claims it landed.  Unselected
+    rows and a reused headline still never override."""
+    lg = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(lg))
+    full = {"value": 4000.0, "mode": "full",
+            "extras": {"dense_abs": {"emb_per_sec": 5.0},
+                       "ring_abs": {"emb_per_sec": 6.0},
+                       "batch_scaling": {"120": {"emb_per_sec": 7.0}}}}
+    lg.write_text(json.dumps({"date": "2026-07-01", "payload": full}))
+    repass = {"value": 4000.0, "mode": "full", "headline_reused": True,
+              "rows_filter": ["dense_abs", "120"],
+              "extras": {"dense_abs": {"emb_per_sec": 9.5},
+                         "ring_abs": {"skipped": "not selected (--rows)"},
+                         "batch_scaling": {"120": {"emb_per_sec": 8.5}}}}
+    bench._save_last_good(repass)
+    pay = json.load(open(lg))["payload"]
+    assert pay["extras"]["dense_abs"] == {"emb_per_sec": 9.5}  # replaced
+    assert pay["extras"]["batch_scaling"]["120"] == {"emb_per_sec": 8.5}
+    assert pay["extras"]["ring_abs"] == {"emb_per_sec": 6.0}  # untouched
+    assert pay["value"] == 4000.0  # reused headline never overrides
+
+
+def test_rows_merge_keeps_base_date_when_headline_not_remeasured(
+        bench, monkeypatch, tmp_path):
+    """A --rows merge that did not re-measure the headline keeps the
+    base's date — re-stamping would let old headline evidence win the
+    'same-day complete payload beats salvaged partial' rule against a
+    genuinely fresh salvage."""
+    lg = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(lg))
+    full = {"value": 4000.0, "mode": "full",
+            "extras": {"batch_scaling": {"vit_b16_128": {"error": "x"}}}}
+    lg.write_text(json.dumps({"date": "2026-07-01", "payload": full}))
+    repass = {"value": 4000.0, "mode": "full", "headline_reused": True,
+              "rows_filter": ["vit_b16_128"],
+              "extras": {"batch_scaling":
+                         {"vit_b16_128": {"emb_per_sec": 9.0}}}}
+    bench._save_last_good(repass)
+    out = json.load(open(lg))
+    assert out["date"] == "2026-07-01"  # headline evidence is that old
+    assert out["payload"]["rows_updated"]["rows"] == ["vit_b16_128"]
+    # A re-pass that DID re-measure the headline stamps today.
+    import datetime
+
+    repass2 = {"value": 4300.0, "mode": "full",
+               "rows_filter": ["headline"],
+               "extras": {}}
+    bench._save_last_good(repass2)
+    out2 = json.load(open(lg))
+    assert out2["date"] == datetime.date.today().isoformat()
+    assert out2["payload"]["value"] == 4300.0
+
+
+def test_engine_extras_early_skip_builds_nothing(bench, monkeypatch,
+                                                 tmp_path):
+    """A --rows selection with no engine row returns before the 4096x512
+    pool is built or device_put — jax/jnp/np are never touched (None
+    stands in for all three)."""
+    monkeypatch.setattr(bench, "QUARANTINE_PATH", str(tmp_path / "q.json"))
+    extras = {}
+    bench._engine_extras(None, None, None, 0.0, deadline=None,
+                         extras=extras, flush=None,
+                         selected={"headline", "vit_b16_128"})
+    assert extras["pool"] == 4096
+    assert all(extras[n] == {"skipped": "not selected (--rows)"}
+               for n in bench.ENGINE_ROWS)
+
+
+def test_rows_selection_skips_unselected_batch_rows(bench, monkeypatch,
+                                                    tmp_path):
+    """--rows gates every batch-scaling row before any model build or
+    quarantine consult — an unselected row costs a dict write."""
+    monkeypatch.setattr(bench, "QUARANTINE_PATH", str(tmp_path / "q.json"))
+    rows = {}
+    # jax/jnp/np/dev are never touched when nothing is selected.
+    bench._batch_scaling_extras(None, None, None, None, 0.0,
+                                deadline=None, rows=rows, flush=None,
+                                selected={"headline"})
+    assert rows and all(v == {"skipped": "not selected (--rows)"}
+                        for v in rows.values())
+
+
+def test_rows_unknown_name_errors_before_dispatch(bench, capsys):
+    """A typo'd --rows name would match nothing downstream (a wasted
+    tunnel-window child that still stamps merge provenance), so main()
+    rejects it at parse time, naming the offender."""
+    with pytest.raises(SystemExit) as exc:
+        bench.main(["--rows", "blockwise_flagship_bf16,headline"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "blockwise_flagship_bf16" in err and "unknown row name" in err
+
+
+def test_known_row_names_covers_full_vocabulary(bench):
+    """known_row_names() = headline + engine rows + batch-scaling keys,
+    each sourced from the spec the measuring code itself iterates."""
+    names = bench.known_row_names()
+    assert "headline" in names
+    assert set(bench.ENGINE_ROWS) <= names
+    assert {s[2] for s in bench.BATCH_SCALING_SPECS} <= names
+    assert len(names) == (1 + len(bench.ENGINE_ROWS)
+                          + len(bench.BATCH_SCALING_SPECS))
+
+
+def test_bench_rows_missing_print_rows(tmp_path, monkeypatch):
+    """--print-rows emits the comma-separated bench.py --rows argument
+    for the missing wanted rows (quarantined ones excluded)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_rows_missing.py"),
+         "--print-rows"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0
+    rows = out.stdout.strip()
+    # Against the committed last_good/quarantine state the list is a
+    # (possibly empty) comma-separated subset of the WANT rows.
+    want = {"vit_b16_128", "120_s2d", "120_fused", "vit_b16_256"}
+    assert set(filter(None, rows.split(","))) <= want
