@@ -453,7 +453,7 @@ ENGINE_ROWS = (
     "blockwise_flagship_nocache", "blockwise_flagship_radix",
     "blockwise_flagship_bf16matmul", "dense_flagship_bf16matmul",
     "ring_abs", "ring_flagship", "ring_flagship_nocache",
-    "ring_flagship_bf16matmul",
+    "ring_flagship_bf16matmul", "serve_qps",
 )
 
 
@@ -726,6 +726,72 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
         ring_loss(REFERENCE_CONFIG, matmul_precision="default"),
     )
     delta("ring_bf16matmul_loss_delta", l_ring_rel, l_ring_rel_bf16)
+
+    # serve_qps: the online path (serve.QueryEngine) against the same
+    # 4096 x 512 pool as a gallery — warmed-bucket query latency p50/p99
+    # + QPS at each fixed padding bucket, plus the counted proof that
+    # steady-state serving performed zero post-warmup compiles.  Every
+    # timed dispatch queries DISTINCT rows of a fresh random pool so a
+    # memoizing tunnel backend cannot serve a repeat (docs/DESIGN.md §6).
+    def _serve_qps():
+        from npairloss_tpu.serve import (
+            EngineConfig,
+            GalleryIndex,
+            QueryEngine,
+        )
+
+        buckets = (8, 32)
+        trials = 20
+        idx = GalleryIndex.build(f, np.asarray(labels), normalize=False)
+        engine = QueryEngine(
+            idx, EngineConfig(top_k=10, buckets=buckets)
+        )
+        warm_s = engine.warmup()
+        qpool = np.random.default_rng(7).standard_normal(
+            (max(buckets) * trials, d)
+        ).astype(np.float32)
+        row = {"gallery": n, "top_k": 10, "warmup_s": round(warm_s, 2)}
+        for bucket in buckets:
+            lats = []
+            for t in range(trials):
+                q = qpool[t * bucket:(t + 1) * bucket]
+                t0 = time.perf_counter()
+                engine.query(q, normalize=True)
+                # query() already materialized the answer (np.asarray)
+                lats.append(
+                    max(time.perf_counter() - t0 - floor, 1e-9) * 1e3
+                )
+            lats.sort()
+            row[f"bucket_{bucket}"] = {
+                "p50_ms": round(lats[len(lats) // 2], 2),
+                "p99_ms": round(lats[min(int(len(lats) * 0.99),
+                                         len(lats) - 1)], 2),
+                "qps": round(bucket * trials / (sum(lats) / 1e3), 1),
+            }
+        row["compiles_after_warmup"] = \
+            engine.compile_stats()["compiles_after_warmup"]
+        extras["serve_qps"] = row
+        _log(f"extras: serve_qps: {row}")
+
+    name = "serve_qps"
+    if selected is not None and name not in selected:
+        extras[name] = {"skipped": "not selected (--rows)"}
+    elif deadline is not None and time.time() > deadline:
+        _log(f"extras: skipping {name} (soft time budget reached)")
+        extras[name] = {"skipped": "soft time budget reached"}
+    elif _quarantined(name):
+        q = _quarantined(name)
+        _log(f"extras: skipping {name} (quarantined: {q})")
+        extras[name] = {"skipped": f"quarantined: {q}"}
+    else:
+        _log(f"extras: measuring {name}...")
+        flush(name)
+        try:
+            _serve_qps()
+        except Exception as e:  # the serve row must not void the rest
+            _log(f"extras: {name} FAILED: {e}")
+            extras[name] = {"error": str(e)[:300]}
+        flush()
     return extras
 
 

@@ -791,6 +791,161 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_index(args) -> int:
+    """Build (or inspect) a committed gallery index from the ``extract``
+    subcommand's .npy pair — the offline half of the serving path
+    (docs/SERVING.md).  ``--add-to`` appends to an existing index
+    instead of building fresh (the incremental ``GalleryIndex.add``
+    path); commits are atomic either way."""
+    import numpy as np
+
+    from npairloss_tpu.serve.index import GalleryIndex, index_info
+
+    if args.info:
+        print(json.dumps(index_info(args.info)))
+        return 0
+    prefix = args.prefix
+    emb_path = args.emb or prefix + ".emb.npy"
+    lab_path = args.labels or prefix + ".labels.npy"
+    for p in (emb_path, lab_path):
+        if not os.path.exists(p):
+            log.error("missing %s (run the extract subcommand first)", p)
+            return 2
+    emb = np.load(emb_path)
+    lab = np.load(lab_path)
+    if emb.shape[0] != lab.shape[0]:
+        log.error("embeddings/labels row mismatch: %s vs %s",
+                  emb.shape, lab.shape)
+        return 2
+    if args.add_to:
+        idx = GalleryIndex.load(args.add_to)
+        idx.add(emb, lab, normalize=not args.no_normalize)
+    else:
+        idx = GalleryIndex.build(
+            emb, lab, normalize=not args.no_normalize
+        )
+    out = idx.save(args.out or (args.add_to or prefix + ".gidx"))
+    print(json.dumps({
+        "out": out,
+        "rows": idx.size,
+        "dim": idx.dim,
+        "classes": int(np.unique(idx._host_labels).shape[0]),
+    }))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """The online path: load a committed gallery index (and optionally a
+    training snapshot for raw-input queries), warm every padding bucket,
+    and answer top-K queries over stdin/JSONL or localhost HTTP until
+    EOF or a graceful SIGTERM drain (exit 75) — docs/SERVING.md."""
+    import sys as _sys
+
+    import jax
+
+    from npairloss_tpu.resilience import EXIT_PREEMPTED, PreemptionSignal
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        GalleryIndex,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+    from npairloss_tpu.serve.index import load_newest
+
+    if args.compile_cache:
+        from npairloss_tpu.pipeline import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
+
+    _pkg_handlers = [
+        h for h in logging.getLogger("npairloss_tpu").handlers
+        if not isinstance(h, logging.NullHandler)
+    ]
+    if not logging.getLogger().handlers and not _pkg_handlers:
+        # Serving answers ride stdout; logs go to stderr so a JSONL
+        # consumer never has to parse around them.
+        logging.basicConfig(level=logging.INFO, format="%(message)s",
+                            stream=_sys.stderr)
+
+    mesh = None
+    n_dev = len(jax.devices())
+    want = args.mesh if args.mesh is not None else (n_dev if n_dev > 1 else 1)
+    if want > 1:
+        from npairloss_tpu.parallel import data_parallel_mesh
+
+        mesh = data_parallel_mesh(jax.devices()[:want])
+
+    if args.index_prefix:
+        found = load_newest(args.index_prefix, mesh=mesh)
+        if found is None:
+            log.error("no valid index under prefix %r", args.index_prefix)
+            return 2
+        path, index = found
+        log.info("serving index %s", path)
+    else:
+        index = GalleryIndex.load(args.index, mesh=mesh)
+
+    model = state = None
+    input_shape = None
+    if args.snapshot:
+        from npairloss_tpu.models import get_model
+        from npairloss_tpu.train import restore_for_inference
+
+        model = get_model(args.model or "googlenet")
+        state = restore_for_inference(args.snapshot)
+        side = args.input_size
+        input_shape = (side, side, 3)
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    telemetry = None
+    tel_dir = getattr(args, "telemetry_dir", None)
+    trace_dir = getattr(args, "trace_dir", None)
+    if tel_dir or trace_dir:
+        from npairloss_tpu.obs import RunTelemetry
+
+        telemetry = RunTelemetry(tel_dir or trace_dir, metrics=bool(tel_dir))
+        if tel_dir:
+            telemetry.write_manifest(config={
+                "serve": True,
+                "index": args.index or args.index_prefix,
+                "top_k": args.top_k,
+                "buckets": list(buckets),
+                "deadline_ms": args.deadline_ms,
+                "max_queue": args.max_queue,
+            })
+
+    preempt = PreemptionSignal().install()
+    try:
+        engine = QueryEngine(
+            index,
+            EngineConfig(top_k=args.top_k, buckets=buckets,
+                         gallery_block=args.gallery_block),
+            model=model, state=state, telemetry=telemetry,
+        )
+        if not args.no_warmup:
+            engine.warmup(input_shape)
+        server = RetrievalServer(
+            engine,
+            BatcherConfig(max_batch=buckets[-1],
+                          max_delay_ms=args.deadline_ms,
+                          max_queue=args.max_queue),
+            ServerConfig(metrics_window=args.metrics_window),
+            telemetry=telemetry, preempt=preempt,
+        )
+        if args.http is not None:
+            return server.run_http(args.http)
+        return server.run_jsonl(_sys.stdin, _sys.stdout)
+    finally:
+        preempt.uninstall()
+        if telemetry is not None:
+            try:
+                telemetry.close()
+            except Exception as e:  # noqa: BLE001
+                log.error("telemetry close failed: %s", e)
+
+
 def cmd_parse(args) -> int:
     from npairloss_tpu.config import dumps, parse_file
 
@@ -1299,6 +1454,108 @@ def main(argv: Optional[list] = None) -> int:
     )
     ev.add_argument("--kmeans-iters", type=int, default=20)
     ev.set_defaults(fn=cmd_eval)
+
+    ix = sub.add_parser(
+        "index",
+        help="build a committed gallery index from extracted embeddings",
+    )
+    ix.add_argument(
+        "--prefix", default="./features",
+        help="extract output prefix (reads PREFIX.emb.npy + "
+        "PREFIX.labels.npy; default index path PREFIX.gidx)",
+    )
+    ix.add_argument("--emb", help="explicit embeddings .npy path")
+    ix.add_argument("--labels", help="explicit labels .npy path")
+    ix.add_argument("--out", help="index directory to commit (.gidx)")
+    ix.add_argument(
+        "--add-to", dest="add_to", metavar="INDEX",
+        help="append rows to an existing index (incremental add) and "
+        "re-commit it instead of building fresh",
+    )
+    ix.add_argument(
+        "--no-normalize", dest="no_normalize", action="store_true",
+        help="trust the rows are already unit-norm (extract output is)",
+    )
+    ix.add_argument(
+        "--info", metavar="INDEX",
+        help="print an existing index's manifest summary and exit",
+    )
+    ix.set_defaults(fn=cmd_index)
+
+    sv = sub.add_parser(
+        "serve",
+        help="serve top-K retrieval queries against a gallery index "
+        "(stdin/JSONL, or localhost HTTP with --http)",
+    )
+    sv_idx = sv.add_mutually_exclusive_group(required=True)
+    sv_idx.add_argument("--index", help="committed index dir (.gidx)")
+    sv_idx.add_argument(
+        "--index-prefix", dest="index_prefix",
+        help="scan PREFIX*.gidx newest-first and serve the first valid "
+        "one (torn/corrupt indexes skipped with a logged reason)",
+    )
+    sv.add_argument(
+        "--snapshot",
+        help="training snapshot to restore for raw-'input' queries "
+        "(embedding queries need no model)",
+    )
+    sv.add_argument("--model", help="model registry name for --snapshot")
+    sv.add_argument(
+        "--input-size", dest="input_size", type=int, default=224,
+        help="input side length for the encode path (default 224)",
+    )
+    sv.add_argument("--top-k", dest="top_k", type=int, default=10)
+    sv.add_argument(
+        "--buckets", default="1,8,32",
+        help="ascending query padding buckets; steady state serves "
+        "exactly these program shapes (default 1,8,32)",
+    )
+    sv.add_argument(
+        "--deadline-ms", dest="deadline_ms", type=float, default=5.0,
+        help="max added latency a query may wait for micro-batch "
+        "co-riders (default 5)",
+    )
+    sv.add_argument(
+        "--max-queue", dest="max_queue", type=int, default=256,
+        help="admission queue bound; submits beyond it are rejected "
+        "with backpressure (default 256)",
+    )
+    sv.add_argument(
+        "--metrics-window", dest="metrics_window", type=int, default=100,
+        help="queries per emitted latency/QPS/queue-depth metrics row "
+        "(0 = none)",
+    )
+    sv.add_argument(
+        "--gallery-block", dest="gallery_block", type=int, default=4096,
+        help="gallery rows streamed per block inside a shard",
+    )
+    sv.add_argument("--mesh", type=int, help="devices in the dp mesh")
+    sv.add_argument(
+        "--http", type=int, metavar="PORT",
+        help="serve localhost HTTP on PORT instead of stdin/JSONL",
+    )
+    sv.add_argument(
+        "--no-warmup", dest="no_warmup", action="store_true",
+        help="skip the per-bucket warmup (first queries then pay "
+        "the compiles the warmup would have)",
+    )
+    sv.add_argument(
+        "--compile-cache", dest="compile_cache", metavar="DIR",
+        help="persistent XLA compilation cache (see train "
+        "--compile-cache): replica restarts deserialize the warmed "
+        "buckets instead of recompiling",
+    )
+    sv_tel = sv.add_mutually_exclusive_group()
+    sv_tel.add_argument(
+        "--telemetry-dir", dest="telemetry_dir", metavar="DIR",
+        help="run-telemetry directory (manifest + per-window serve "
+        "metric rows + span trace) — see docs/OBSERVABILITY.md",
+    )
+    sv_tel.add_argument(
+        "--trace-dir", dest="trace_dir", metavar="DIR",
+        help="span tracing only (serve/admit|batch|dispatch|topk)",
+    )
+    sv.set_defaults(fn=cmd_serve)
 
     im = sub.add_parser(
         "import-caffemodel",

@@ -132,14 +132,33 @@ def kmeans_assign(
 ) -> jax.Array:
     """Lloyd's k-means on-device; returns the (N,) cluster assignment.
 
-    Centroids init from k distinct data points (seeded permutation);
-    empty clusters keep their previous centroid.  Euclidean on
-    L2-normalized embeddings == cosine, matching the retrieval metric.
+    Centroids init by deterministic farthest-point traversal (the
+    greedy k-means++ variant): a seeded random first point, then each
+    next centroid is the point maximizing the min distance to those
+    already chosen.  A seeded-permutation init — the obvious
+    alternative — routinely seeds one tight cluster twice and misses
+    another entirely, and Lloyd's cannot escape that local optimum
+    (a perfectly separable gallery then scores NMI ~0.9, not 1.0).
+    Ties in the argmax break to the lowest index, so the assignment
+    is deterministic for a given seed.  Empty clusters keep their
+    previous centroid.  Euclidean on L2-normalized embeddings ==
+    cosine, matching the retrieval metric.
     """
     n, d = embeddings.shape
     x = embeddings.astype(jnp.float32)
-    perm = jax.random.permutation(jax.random.PRNGKey(seed), n)
-    centroids = x[perm[:k]]
+    first = jax.random.randint(jax.random.PRNGKey(seed), (), 0, n)
+    centroids0 = jnp.zeros((k, d), jnp.float32).at[0].set(x[first])
+
+    def pick(i, carry):
+        centroids, min_sq = carry
+        sq = jnp.sum((x - centroids[i - 1]) ** 2, axis=1)
+        min_sq = jnp.minimum(min_sq, sq)
+        nxt = jnp.argmax(min_sq)
+        return centroids.at[i].set(x[nxt]), min_sq
+
+    centroids, _ = jax.lax.fori_loop(
+        1, k, pick, (centroids0, jnp.full((n,), jnp.inf, jnp.float32))
+    )
 
     def step(centroids, _):
         # (N, k) squared distances via the expansion trick — no N x k x d

@@ -56,8 +56,28 @@ def _resolve_pvary():
     return lambda x, axis_names: x
 
 
+def lowered_text(lowered) -> str:
+    """StableHLO text WITH debug info (source locations / named scopes)
+    for a ``jax.stage.Lowered``.
+
+    Newer jax spells this ``lowered.as_text(debug_info=True)``; older
+    releases (<= 0.4.x) have no such kwarg — there the MLIR module's own
+    printer provides the same payload via
+    ``compiler_ir().operation.get_asm(enable_debug_info=True)``.  Plain
+    ``as_text()`` strips locations on BOTH sides of the move, so
+    anything asserting on ``jax.named_scope`` annotations must come
+    through here.
+    """
+    try:
+        return lowered.as_text(debug_info=True)
+    except TypeError:
+        return lowered.compiler_ir(dialect="stablehlo").operation.get_asm(
+            enable_debug_info=True
+        )
+
+
 shard_map = _resolve_shard_map()
 axis_size = _resolve_axis_size()
 pvary = _resolve_pvary()
 
-__all__ = ["axis_size", "pvary", "shard_map"]
+__all__ = ["axis_size", "lowered_text", "pvary", "shard_map"]

@@ -1,0 +1,42 @@
+"""serve: the embedding-retrieval serving subsystem (docs/SERVING.md).
+
+The online half of the deployment protocol: ``ops/eval_retrieval.py``
+reproduces the offline full-gallery evaluation; this package answers
+live queries against the same math.  A trained snapshot plus an
+extracted gallery become a running service:
+
+  * :mod:`.index` — :class:`GalleryIndex`, the mesh-resident gallery
+    (L2-normalized embedding shards + labels/ids), persisted through the
+    ``resilience.snapshot`` atomic-commit path (manifest + CRC, torn
+    indexes skipped on load);
+  * :mod:`.engine` — :class:`QueryEngine`, the jitted query path:
+    encode -> normalize -> block-streamed sharded similarity matmul +
+    merged ``lax.top_k``, warmed once per padding bucket;
+  * :mod:`.batcher` — :class:`MicroBatcher`, deadline-bounded query
+    coalescing into fixed padding buckets over a bounded admission
+    queue (reject-with-backpressure);
+  * :mod:`.server` — :class:`RetrievalServer`, the stdin/JSONL and
+    localhost-HTTP front ends with graceful SIGTERM drain
+    (``resilience.preempt`` semantics, exit 75) and per-request
+    ``serve/*`` telemetry spans.
+"""
+
+from npairloss_tpu.serve.batcher import (
+    BatcherConfig,
+    MicroBatcher,
+    QueueFullError,
+)
+from npairloss_tpu.serve.engine import EngineConfig, QueryEngine
+from npairloss_tpu.serve.index import GalleryIndex
+from npairloss_tpu.serve.server import RetrievalServer, ServerConfig
+
+__all__ = [
+    "BatcherConfig",
+    "EngineConfig",
+    "GalleryIndex",
+    "MicroBatcher",
+    "QueryEngine",
+    "QueueFullError",
+    "RetrievalServer",
+    "ServerConfig",
+]

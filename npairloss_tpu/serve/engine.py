@@ -1,0 +1,408 @@
+"""QueryEngine — the jitted online query path: encode -> topk answers.
+
+One dispatch per micro-batch: (optionally) encode raw inputs through the
+restored model trunk, ``ops.normalize`` the query rows, then a
+block-streamed similarity matmul against the mesh-resident gallery with
+``lax.top_k`` merged across gallery blocks and mesh shards.  The
+math is the deployment protocol of ``ops/eval_retrieval.py`` — fp32
+HIGHEST-precision cosine on the MXU — so served answers are exactly
+consistent with the offline ``gallery_recall_at_k`` numbers (parity is
+pinned by tests/test_serve.py).
+
+Streaming + merge layout (docs/SERVING.md):
+
+  * within a shard, gallery rows stream in fixed blocks through a
+    ``lax.scan`` carrying the running (B, k) best scores/rows — the
+    B x N similarity matrix is never materialized (the
+    ``ops/eval_retrieval.py`` trick, applied to the gallery axis);
+  * across shards, each mesh shard returns its local top-k with GLOBAL
+    row numbers (shard offset via ``axis_index``); the (G, B, k)
+    candidates reshape to (B, G*k) in ascending-shard order and one
+    final ``top_k`` merges them.
+
+Both merges preserve ``lax.top_k``'s lowest-index-wins tie-break:
+candidates always concatenate in ascending global-row order, so the
+streamed/sharded answer is bit-identical to a dense single-device
+``top_k`` over the whole gallery.
+
+Steady-state serving never compiles: :meth:`warmup` compiles and primes
+every padding bucket with one dummy dispatch each (populating the
+persistent compile cache when one is enabled — see
+:meth:`QueryEngine.warmup` for why AOT ``lower().compile()`` would pay
+each compile twice).  Every later compile is COUNTED
+(``compiles_after_warmup``) via
+the jit cache size, and ``NPAIRLOSS_SERVE_COMPILE_GUARD=strict`` turns
+a post-warmup compile into an error — the serving twin of the pipeline
+sync guard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from npairloss_tpu.ops.normalize import l2_normalize
+from npairloss_tpu.parallel._compat import shard_map
+from npairloss_tpu.serve.index import GalleryIndex, l2_normalize_rows
+
+log = logging.getLogger("npairloss_tpu.serve")
+
+COMPILE_GUARD_ENV = "NPAIRLOSS_SERVE_COMPILE_GUARD"
+
+_NEG_FILL = float(-np.finfo(np.float32).max)
+
+
+class ServeCompileError(RuntimeError):
+    """A post-warmup XLA compile happened under the strict guard."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """``buckets`` are the fixed query padding sizes (ascending); every
+    micro-batch pads to the smallest bucket that fits, so steady state
+    dispatches only ``len(buckets)`` distinct programs.  ``top_k`` is
+    the answer length; ``gallery_block`` the gallery rows streamed per
+    scan step inside a shard (bounds the similarity working set)."""
+
+    top_k: int = 10
+    buckets: Tuple[int, ...] = (1, 8, 32)
+    gallery_block: int = 4096
+
+    def __post_init__(self):
+        if not self.buckets or list(self.buckets) != sorted(
+                set(int(b) for b in self.buckets)):
+            raise ValueError(
+                f"buckets must be ascending and unique, got {self.buckets}"
+            )
+        if self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+
+
+def _stream_topk(q, emb, labels_unused, valid, k: int, block: int):
+    """Running top-k of ``q @ emb.T`` over gallery blocks.
+
+    Returns (scores, rows) of shape (B, k) with rows GLOBAL over ``emb``
+    (0-based).  Invalid (padding) rows never win; the final clamped
+    block masks rows a previous block already scored, so each gallery
+    row is a candidate exactly once.
+    """
+    n = emb.shape[0]
+    b = int(min(block, n))
+    n_blocks = -(-n // b)
+    kb = min(k, b)
+    bq = q.shape[0]
+
+    def one_block(carry, j):
+        best_s, best_r = carry
+        start = jnp.minimum(j * b, n - b)
+        g = jax.lax.dynamic_slice_in_dim(emb, start, b, axis=0)
+        v = jax.lax.dynamic_slice_in_dim(valid, start, b, axis=0)
+        sims = jnp.dot(
+            q, g.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        rows = start + jnp.arange(b, dtype=jnp.int32)
+        # Mask padding rows AND the final block's clamped overlap (rows
+        # below the unclamped start were scored by an earlier block — a
+        # duplicate candidate would corrupt the top-k answer).
+        ok = v & (rows >= j * b)
+        sims = jnp.where(ok[None, :], sims, jnp.float32(_NEG_FILL))
+        blk_s, blk_i = jax.lax.top_k(sims, kb)
+        blk_r = rows[blk_i]
+        # Merge: best-first concat keeps candidates in ascending global
+        # row order within equal scores, so top_k's lowest-index-first
+        # tie-break reproduces the dense answer exactly.
+        cand_s = jnp.concatenate([best_s, blk_s], axis=1)
+        cand_r = jnp.concatenate([best_r, blk_r], axis=1)
+        new_s, sel = jax.lax.top_k(cand_s, k)
+        new_r = jnp.take_along_axis(cand_r, sel, axis=1)
+        return (new_s, new_r), None
+
+    init = (
+        jnp.full((bq, k), jnp.float32(_NEG_FILL)),
+        jnp.zeros((bq, k), jnp.int32),
+    )
+    (best_s, best_r), _ = jax.lax.scan(
+        one_block, init, jnp.arange(n_blocks, dtype=jnp.int32)
+    )
+    return best_s, best_r
+
+
+class QueryEngine:
+    """Answers ``(B, D)`` query embeddings with the gallery's top-k.
+
+    ``model``/``state`` (a Flax module + the ``restore_for_inference``
+    tree) enable :meth:`encode` for raw-input queries; embedding-only
+    serving needs neither.  ``telemetry`` records a ``serve/topk`` span
+    per dispatch.  Thread-safety: dispatches are serialized by the
+    MicroBatcher (one dispatcher thread); the engine itself keeps no
+    per-call mutable state beyond the compile counters.
+    """
+
+    def __init__(
+        self,
+        index: GalleryIndex,
+        cfg: EngineConfig = EngineConfig(),
+        model=None,
+        state: Optional[Dict[str, Any]] = None,
+        telemetry=None,
+    ):
+        if cfg.top_k > index.size:
+            raise ValueError(
+                f"top_k={cfg.top_k} exceeds gallery size {index.size}"
+            )
+        self.index = index
+        self.cfg = cfg
+        self.model = model
+        self.state = state
+        self.telemetry = telemetry
+        self.warmed = False
+        self.compiles_total = 0
+        self.compiles_after_warmup = 0
+        self._guard = os.environ.get(COMPILE_GUARD_ENV, "").strip().lower()
+        self._seen_sigs: set = set()
+        self._build_fns()
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _build_fns(self) -> None:
+        k = self.cfg.top_k
+        block = self.cfg.gallery_block
+        index = self.index
+
+        def topk_single(q, emb, labels, valid):
+            return _stream_topk(q, emb, labels, valid, k, block)
+
+        if index.mesh is not None:
+            mesh, axis = index.mesh, index.axis
+
+            def per_shard(q, emb, labels, valid):
+                # Shard extent comes from the TRACED local shard, not a
+                # value captured at engine build: GalleryIndex.add() can
+                # grow padded_size, and the retrace the new shapes force
+                # must compute offsets for the NEW layout.
+                shard_n = emb.shape[0]
+                kl = min(k, shard_n)
+                s, r = _stream_topk(q, emb, labels, valid, kl, block)
+                offset = jax.lax.axis_index(axis) * shard_n
+                return s[None], (r + offset)[None]
+
+            sharded = shard_map(
+                per_shard,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P(axis), P(axis)),
+                out_specs=(P(axis), P(axis)),
+            )
+
+            def topk(q, emb, labels, valid):
+                # (G, B, kl) per-shard candidates -> (B, G*kl) in
+                # ascending-shard (== ascending global row) order, then
+                # one merging top_k.
+                s, r = sharded(q, emb, labels, valid)
+                g, _, kl = s.shape
+                s = jnp.transpose(s, (1, 0, 2)).reshape(q.shape[0], g * kl)
+                r = jnp.transpose(r, (1, 0, 2)).reshape(q.shape[0], g * kl)
+                best_s, sel = jax.lax.top_k(s, k)
+                best_r = jnp.take_along_axis(r, sel, axis=1)
+                return best_s, best_r
+
+            self._topk_fn = jax.jit(topk)
+        else:
+            self._topk_fn = jax.jit(topk_single)
+
+        if self.model is not None:
+            model = self.model
+
+            def encode(state, x):
+                variables = {"params": state["params"]}
+                if state.get("batch_stats"):
+                    variables["batch_stats"] = state["batch_stats"]
+                return l2_normalize(model.apply(variables, x, train=False))
+
+            self._encode_fn = jax.jit(encode)
+        else:
+            self._encode_fn = None
+
+    def _span(self, name: str, **args):
+        if self.telemetry is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return self.telemetry.span(name, **args)
+
+    def _cache_size(self) -> Optional[int]:
+        sizes = []
+        for fn in (self._topk_fn, self._encode_fn):
+            if fn is None:
+                continue
+            get = getattr(fn, "_cache_size", None)
+            if get is None:
+                return None
+            sizes.append(get())
+        return sum(sizes) if sizes else 0
+
+    def _count_compiles(self, sig, n_before: Optional[int]) -> None:
+        """Signature-set + executable-cache-size compile accounting; the
+        cache size also catches sharding/aval-keyed recompiles the
+        signature heuristic cannot predict (the PR-4 lesson)."""
+        fresh = sig not in self._seen_sigs
+        self._seen_sigs.add(sig)
+        grew = (n_before is not None
+                and (self._cache_size() or 0) > n_before)
+        if not (fresh or grew):
+            return
+        self.compiles_total += 1
+        if not self.warmed:
+            return
+        self.compiles_after_warmup += 1
+        if self.telemetry is not None:
+            self.telemetry.instant("serve/recompile", sig=str(sig))
+        log.warning("serve: post-warmup XLA compile (sig=%s)", sig)
+        if self._guard == "strict":
+            raise ServeCompileError(
+                f"post-warmup compile in the serving hot path (sig={sig}); "
+                "warm every bucket before taking traffic "
+                "(docs/SERVING.md)"
+            )
+
+    # -- query path --------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest configured bucket >= n (callers chunk above max)."""
+        for b in self.cfg.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the largest bucket "
+            f"{self.cfg.buckets[-1]} (the batcher must chunk)"
+        )
+
+    def encode(self, inputs: np.ndarray) -> np.ndarray:
+        """Raw inputs -> unit-norm query embeddings via the restored
+        trunk (eval mode), padded per bucket like :meth:`query`."""
+        if self._encode_fn is None:
+            raise RuntimeError(
+                "engine built without model/state: embedding queries only"
+            )
+        x = np.asarray(inputs, np.float32)
+        n = x.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket > n:
+            x = np.concatenate(
+                [x, np.zeros((bucket - n, *x.shape[1:]), np.float32)]
+            )
+        sig = ("encode", tuple(x.shape))
+        n_before = self._cache_size()
+        with self._span("serve/encode", batch=n, bucket=bucket):
+            emb = self._encode_fn(self.state, jnp.asarray(x))
+        self._count_compiles(sig, n_before)
+        return np.asarray(emb)[:n]
+
+    def query(
+        self, embeddings: np.ndarray, normalize: bool = True
+    ) -> Dict[str, np.ndarray]:
+        """Top-k for ``(B, D)`` query embeddings.
+
+        Pads B to the smallest bucket (chunking batches above the
+        largest), dispatches the jitted streamed/sharded top-k, and maps
+        winning gallery rows to labels/ids host-side.  Returns
+        ``{"scores", "rows", "labels", "ids"}``, each (B, top_k).
+        """
+        q = np.asarray(embeddings, np.float32)
+        if q.ndim != 2 or q.shape[1] != self.index.dim:
+            raise ValueError(
+                f"queries {q.shape} do not match gallery dim "
+                f"{self.index.dim}"
+            )
+        if q.shape[0] == 0:
+            k = self.cfg.top_k
+            return {
+                "scores": np.zeros((0, k), np.float32),
+                "rows": np.zeros((0, k), np.int32),
+                "labels": np.zeros((0, k), np.int32),
+                "ids": np.zeros((0, k), np.int64),
+            }
+        if normalize:
+            q = l2_normalize_rows(q)
+        max_b = self.cfg.buckets[-1]
+        outs = [self._query_bucketed(q[i:i + max_b])
+                for i in range(0, q.shape[0], max_b)]
+        return {
+            key: np.concatenate([o[key] for o in outs])
+            for key in outs[0]
+        }
+
+    def _query_bucketed(self, q: np.ndarray) -> Dict[str, np.ndarray]:
+        n = q.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket > n:
+            q = np.concatenate(
+                [q, np.zeros((bucket - n, q.shape[1]), np.float32)]
+            )
+        idx = self.index
+        sig = ("topk", bucket, idx.padded_size, idx.dim)
+        n_before = self._cache_size()
+        with self._span("serve/topk", batch=n, bucket=bucket):
+            scores, rows = self._topk_fn(
+                jnp.asarray(q), idx.emb, idx.labels, idx.valid
+            )
+            scores = np.asarray(scores)[:n]
+            rows = np.asarray(rows)[:n]
+        self._count_compiles(sig, n_before)
+        return {
+            "scores": scores,
+            "rows": rows,
+            "labels": idx._host_labels[rows],
+            "ids": idx.ids[rows],
+        }
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self, input_shape: Optional[Sequence[int]] = None) -> float:
+        """Compile and prime every padding bucket with one dummy
+        dispatch each — after this returns, steady-state serving
+        performs ZERO XLA compiles (the counters prove it).  The
+        dispatch-time compile consults AND populates the persistent
+        compile cache when one is enabled, so replica restarts
+        deserialize instead of recompiling.  (An AOT
+        ``lower().compile()`` first would pay every compile twice: jit's
+        dispatch cache ignores AOT executables, so the priming dispatch
+        recompiles from scratch.)  Returns the wall seconds spent."""
+        import time as _time
+
+        idx = self.index
+        t0 = _time.perf_counter()
+        for bucket in self.cfg.buckets:
+            with self._span("serve/warmup", bucket=bucket, kind="topk"):
+                self._query_bucketed(np.zeros((bucket, idx.dim),
+                                              np.float32))
+            if self._encode_fn is not None:
+                if input_shape is None:
+                    raise ValueError(
+                        "warmup needs input_shape to warm the encode path"
+                    )
+                with self._span("serve/warmup", bucket=bucket,
+                                kind="encode"):
+                    self.encode(np.zeros((bucket, *tuple(input_shape)),
+                                         np.float32))
+        self.warmed = True
+        dt = _time.perf_counter() - t0
+        log.info("serve warmup: %d bucket(s) compiled in %.2fs",
+                 len(self.cfg.buckets), dt)
+        return dt
+
+    def compile_stats(self) -> Dict[str, Any]:
+        return {
+            "warmed": self.warmed,
+            "compiles_total": self.compiles_total,
+            "compiles_after_warmup": self.compiles_after_warmup,
+            "executable_cache_size": self._cache_size(),
+        }

@@ -1,0 +1,347 @@
+"""GalleryIndex — the mesh-resident gallery an online query runs against.
+
+The gallery is the serving-side counterpart of the training negative
+pool: (N, D) L2-normalized embeddings with their class labels and item
+ids, laid out on the device mesh with rows sharded over the data
+axis (``parallel.mesh`` sharding) so a gallery larger than one chip's
+HBM still fits — each shard holds N/G rows and the query engine merges
+per-shard top-k candidates.
+
+Persistence rides the ``resilience.snapshot`` atomic-commit path: the
+arrays are written as ``.npy`` into a ``.tmp-<pid>-<nonce>`` dir, a
+``manifest.json`` with per-array CRC-32 records is fsync'd inside it,
+and ``os.replace`` onto the final name is the commit point.  A torn or
+bit-rotted index fails checksum verification at load and is skipped by
+:func:`load_newest` with a logged reason — the same contract training
+snapshots follow (docs/RESILIENCE.md), so a serving replica never
+answers queries from a half-written gallery.
+
+Rows are padded up to a multiple of the mesh size (and at least one row
+per shard); padding rows carry ``valid == False`` and are masked to
+-inf similarity inside the engine, so they can never appear in an
+answer.  ``labels`` may be any int values — validity is tracked by the
+mask, not a sentinel label.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import shutil
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from npairloss_tpu.resilience import failpoints
+from npairloss_tpu.resilience.snapshot import (
+    TMP_MARKER,
+    SnapshotValidationError,
+    _fsync_dir,
+    read_manifest,
+    state_checksums,
+    validate_snapshot,
+    verify_restored,
+    write_manifest,
+)
+
+log = logging.getLogger("npairloss_tpu.serve")
+
+INDEX_KIND = "gallery-index"
+INDEX_SUFFIX = ".gidx"
+_ARRAYS = ("emb", "labels", "ids")
+
+
+def l2_normalize_rows(x: np.ndarray) -> np.ndarray:
+    """Host-side safe row L2-normalize (an all-zero row stays zero) —
+    the one definition build/add/query all share, so the gallery and
+    the queries scored against it can never normalize differently."""
+    return x / np.maximum(
+        np.linalg.norm(x, axis=1, keepdims=True), 1e-12
+    )
+
+
+@dataclasses.dataclass
+class GalleryIndex:
+    """Mesh-resident gallery: sharded embeddings + labels + validity.
+
+    ``emb``/``labels``/``valid`` are device arrays of padded length
+    ``padded_size`` (rows sharded over ``mesh``'s axis when one is
+    attached, single-device otherwise); ``ids`` is the host-side
+    int64 item-id vector of TRUE length ``size`` — answers map a
+    global gallery row back through it.  Build via :meth:`build` /
+    :meth:`load`, never the raw constructor.
+    """
+
+    emb: jax.Array
+    labels: jax.Array
+    valid: jax.Array
+    ids: np.ndarray
+    size: int
+    mesh: Optional[Mesh] = None
+    axis: str = "dp"
+    # Host master copy (unpadded, normalized): add() re-pads + re-places
+    # from here instead of pulling the gallery back off the mesh.
+    _host_emb: Optional[np.ndarray] = None
+    _host_labels: Optional[np.ndarray] = None
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def build(
+        cls,
+        embeddings: np.ndarray,
+        labels: np.ndarray,
+        ids: Optional[np.ndarray] = None,
+        mesh: Optional[Mesh] = None,
+        axis: str = "dp",
+        normalize: bool = True,
+    ) -> "GalleryIndex":
+        """Build the index from extracted embeddings (the ``extract``
+        subcommand's output pair).  ``normalize=False`` trusts the rows
+        are already unit-norm (extract output is); cosine similarity in
+        the engine assumes unit rows either way."""
+        emb = np.asarray(embeddings, np.float32)
+        lab = np.asarray(labels, np.int32).reshape(-1)
+        if emb.ndim != 2 or emb.shape[0] != lab.shape[0]:
+            raise ValueError(
+                f"embeddings {emb.shape} / labels {lab.shape} mismatch"
+            )
+        if emb.shape[0] == 0:
+            raise ValueError("cannot build an empty gallery")
+        if normalize:
+            emb = l2_normalize_rows(emb)
+        if ids is None:
+            ids = np.arange(emb.shape[0], dtype=np.int64)
+        else:
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            if ids.shape[0] != emb.shape[0]:
+                raise ValueError(
+                    f"ids {ids.shape} / embeddings {emb.shape} mismatch"
+                )
+        idx = cls(
+            emb=None, labels=None, valid=None, ids=ids,  # type: ignore
+            size=int(emb.shape[0]), mesh=mesh, axis=axis,
+            _host_emb=emb, _host_labels=lab,
+        )
+        idx._place()
+        return idx
+
+    def _place(self) -> None:
+        """Pad the host master copy to the mesh multiple and place it
+        sharded (rows over the mesh axis) / on the default device."""
+        n = self._host_emb.shape[0]
+        g = self.mesh.size if self.mesh is not None else 1
+        pad = (-n) % g
+        emb = self._host_emb
+        lab = self._host_labels
+        valid = np.ones(n, bool)
+        if pad:
+            emb = np.concatenate(
+                [emb, np.zeros((pad, emb.shape[1]), np.float32)]
+            )
+            lab = np.concatenate([lab, np.zeros(pad, np.int32)])
+            valid = np.concatenate([valid, np.zeros(pad, bool)])
+        if self.mesh is not None:
+            sharding = NamedSharding(self.mesh, P(self.axis))
+            self.emb = jax.device_put(emb, sharding)
+            self.labels = jax.device_put(lab, sharding)
+            self.valid = jax.device_put(valid, sharding)
+        else:
+            self.emb = jax.device_put(jnp.asarray(emb))
+            self.labels = jax.device_put(jnp.asarray(lab))
+            self.valid = jax.device_put(jnp.asarray(valid))
+        self.size = n
+
+    @property
+    def padded_size(self) -> int:
+        return int(self.emb.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.emb.shape[1])
+
+    def add(
+        self,
+        embeddings: np.ndarray,
+        labels: np.ndarray,
+        ids: Optional[np.ndarray] = None,
+        normalize: bool = True,
+    ) -> int:
+        """Incrementally append rows and re-place the gallery.
+
+        O(N) host work + one fresh placement — the padded/sharded layout
+        must be rebuilt, so adds are for index-refresh cadence (seconds),
+        not the per-query hot path.  Returns the new ``size``.  The
+        engine notices the new placement on its next dispatch; a changed
+        PADDED size is a new program signature (one recompile, counted).
+        """
+        emb = np.asarray(embeddings, np.float32)
+        lab = np.asarray(labels, np.int32).reshape(-1)
+        if emb.ndim != 2 or emb.shape[1] != self._host_emb.shape[1]:
+            raise ValueError(
+                f"added embeddings {emb.shape} do not match gallery dim "
+                f"{self._host_emb.shape[1]}"
+            )
+        if emb.shape[0] != lab.shape[0]:
+            raise ValueError(
+                f"embeddings {emb.shape} / labels {lab.shape} mismatch"
+            )
+        if normalize:
+            emb = l2_normalize_rows(emb)
+        if ids is None:
+            start = int(self.ids.max()) + 1 if self.ids.size else 0
+            ids = np.arange(start, start + emb.shape[0], dtype=np.int64)
+        else:
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            if ids.shape[0] != emb.shape[0]:
+                raise ValueError(
+                    f"ids {ids.shape} / embeddings {emb.shape} mismatch"
+                )
+        self._host_emb = np.concatenate([self._host_emb, emb])
+        self._host_labels = np.concatenate([self._host_labels, lab])
+        self.ids = np.concatenate([self.ids, ids])
+        self._place()
+        return self.size
+
+    # -- persistence (resilience.snapshot commit path) --------------------
+
+    def _tree(self):
+        return {
+            "emb": self._host_emb,
+            "labels": self._host_labels,
+            "ids": self.ids,
+        }
+
+    def save(self, path: str) -> str:
+        """Commit the index atomically at ``path``: arrays into a
+        ``.tmp-`` dir, CRC manifest fsync'd inside, ``os.replace`` as
+        the commit point.  A crash mid-save leaves only tmp debris the
+        load scan never matches.  Overwriting an existing index (the
+        ``--add-to`` re-commit) renames the old dir ASIDE first and
+        deletes it only after the new commit + fsync — the committed
+        data is never destroyed before its replacement is in place, so
+        the worst crash leaves the old arrays intact under a
+        ``.tmp-…-prev`` name instead of an empty prefix."""
+        final = os.path.abspath(path)
+        parent = os.path.dirname(final)
+        os.makedirs(parent, exist_ok=True)
+        nonce = f"{os.getpid()}-{os.urandom(2).hex()}"
+        tmp = f"{final}{TMP_MARKER}{nonce}"
+        os.makedirs(tmp)
+        tree = self._tree()
+        for name in _ARRAYS:
+            np.save(os.path.join(tmp, name + ".npy"), tree[name])
+        write_manifest(
+            tmp, 0, state_checksums(tree),
+            extra={"kind": INDEX_KIND, "size": self.size,
+                   "dim": self.dim},
+        )
+        old = None
+        if os.path.isdir(final):
+            old = f"{final}{TMP_MARKER}{nonce}-prev"
+            os.replace(final, old)
+        failpoints.fire("index.commit.crash")
+        os.replace(tmp, final)
+        _fsync_dir(parent)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+        # Reclaim debris from earlier crashed saves of this path (their
+        # nonce differs, so the rename-aside above never matches them).
+        # Single-writer, same as resilience.snapshot's stale-tmp GC.
+        stale_mark = os.path.basename(final) + TMP_MARKER
+        for name in os.listdir(parent):
+            if name.startswith(stale_mark):
+                shutil.rmtree(os.path.join(parent, name),
+                              ignore_errors=True)
+        log.info("gallery index -> %s (%d rows, dim %d)",
+                 final, self.size, self.dim)
+        return final
+
+    @classmethod
+    def load(
+        cls,
+        path: str,
+        mesh: Optional[Mesh] = None,
+        axis: str = "dp",
+    ) -> "GalleryIndex":
+        """Restore a committed index, checksum-verified against its
+        manifest; raises :class:`SnapshotValidationError` on a torn or
+        corrupt index instead of serving garbage answers."""
+        manifest = validate_snapshot(os.path.abspath(path))
+        if manifest.get("kind") != INDEX_KIND:
+            raise SnapshotValidationError(
+                f"{path} is not a gallery index "
+                f"(kind={manifest.get('kind')!r})"
+            )
+        tree = {}
+        for name in _ARRAYS:
+            p = os.path.join(path, name + ".npy")
+            try:
+                tree[name] = np.load(p)
+            except (OSError, ValueError) as e:
+                raise SnapshotValidationError(
+                    f"unreadable index array {p}: {e}"
+                ) from e
+        verify_restored(tree, manifest)
+        idx = cls(
+            emb=None, labels=None, valid=None,  # type: ignore
+            ids=np.asarray(tree["ids"], np.int64),
+            size=int(tree["emb"].shape[0]), mesh=mesh, axis=axis,
+            _host_emb=np.asarray(tree["emb"], np.float32),
+            _host_labels=np.asarray(tree["labels"], np.int32),
+        )
+        idx._place()
+        return idx
+
+
+def list_indexes(prefix: str) -> List[Tuple[str, str]]:
+    """Committed index candidates ``<prefix>*.gidx`` as (name, path),
+    sorted ascending by name; tmp dirs never match."""
+    prefix = os.path.abspath(prefix)
+    parent, base = os.path.dirname(prefix), os.path.basename(prefix)
+    out: List[Tuple[str, str]] = []
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return out
+    for name in entries:
+        if (name.startswith(base) and name.endswith(INDEX_SUFFIX)
+                and TMP_MARKER not in name):
+            path = os.path.join(parent, name)
+            if os.path.isdir(path):
+                out.append((name, path))
+    out.sort()
+    return out
+
+
+def load_newest(
+    prefix: str,
+    mesh: Optional[Mesh] = None,
+    axis: str = "dp",
+) -> Optional[Tuple[str, GalleryIndex]]:
+    """Scan ``<prefix>*.gidx`` newest-first (by name — the build cadence
+    names indexes sortably) and load the first one that validates,
+    skipping torn/corrupt candidates with a logged reason — the serving
+    twin of ``Solver.restore_auto``.  Returns (path, index) or None."""
+    for _, path in reversed(list_indexes(prefix)):
+        try:
+            return path, GalleryIndex.load(path, mesh=mesh, axis=axis)
+        except Exception as e:  # noqa: BLE001 — skip, try the next
+            log.warning("index load: skipping %s: %s", path, e)
+    return None
+
+
+def index_info(path: str) -> dict:
+    """Manifest summary for tooling (no array loads)."""
+    m = read_manifest(path)
+    return {
+        "path": os.path.abspath(path),
+        "kind": m.get("kind"),
+        "size": m.get("size"),
+        "dim": m.get("dim"),
+        "created": m.get("created"),
+    }

@@ -1,0 +1,467 @@
+"""RetrievalServer — snapshot-to-answers front ends over the engine.
+
+Two front ends share one serving core (admit -> micro-batch -> jitted
+top-k -> answer):
+
+  * **stdin/JSONL** (:meth:`RetrievalServer.run_jsonl`): one request
+    object per line in, one answer object per line out, in request
+    order.  The loop reads ahead (bounded by the batcher's admission
+    queue) so consecutive requests coalesce into micro-batches.
+  * **localhost HTTP** (:meth:`RetrievalServer.run_http`): ``POST
+    /query`` with a JSON request (or JSONL body of several), ``GET
+    /healthz`` for liveness/stats.  Each request thread submits and
+    waits, so concurrent clients batch naturally.
+
+Request: ``{"id": ..., "embedding": [...]}`` (a query embedding) or
+``{"id": ..., "input": [...]}`` (raw input, needs a restored model).
+Answer: ``{"id", "neighbors": [{"rank", "row", "gallery_id", "label",
+"score"}, ...]}``; a rejected/failed query answers ``{"id", "error"}``
+instead of being silently dropped.
+
+Shutdown is the training preemption contract (docs/RESILIENCE.md)
+applied to serving: SIGTERM/SIGINT set the ``resilience.preempt`` flag,
+the front end stops ADMITTING, every in-flight query drains to an
+answer, telemetry flushes, and the process exits
+:data:`~npairloss_tpu.resilience.preempt.EXIT_PREEMPTED` (75) so a
+supervisor knows the stop was graceful.  A final ``serve_drain``
+summary record (queries, answers, p50/p99, compile counters) is the
+last line the JSONL front end writes.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from npairloss_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionSignal
+from npairloss_tpu.serve.batcher import (
+    BatcherConfig,
+    MicroBatcher,
+    QueueFullError,
+)
+from npairloss_tpu.serve.engine import QueryEngine
+
+log = logging.getLogger("npairloss_tpu.serve")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """``metrics_window``: queries per emitted latency/throughput row
+    (0 = none); ``latency_window``: ring capacity for the percentile
+    estimate; ``poll_s``: front-end wakeup period for noticing a drain
+    request while idle."""
+
+    metrics_window: int = 100
+    latency_window: int = 1024
+    poll_s: float = 0.1
+
+
+class RetrievalServer:
+    """One engine + one batcher + the request/answer protocol."""
+
+    def __init__(
+        self,
+        engine: QueryEngine,
+        batcher_cfg: BatcherConfig = BatcherConfig(),
+        cfg: ServerConfig = ServerConfig(),
+        telemetry=None,
+        preempt: Optional[PreemptionSignal] = None,
+    ):
+        self.engine = engine
+        self.cfg = cfg
+        self.telemetry = telemetry
+        self.preempt = preempt
+        self.batcher = MicroBatcher(
+            self._dispatch, batcher_cfg, span_fn=self._span,
+            on_batch=self._record_batch,
+        )
+        self._lat = collections.deque(maxlen=max(cfg.latency_window, 1))
+        self._lock = threading.Lock()
+        self.queries = 0
+        self.answered = 0
+        self.errors = 0
+        self._window_t0 = time.perf_counter()
+        self._window_n = 0
+        self._last_batch: Dict[str, Any] = {}
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _span(self, name: str, **args):
+        if self.telemetry is None:
+            return contextlib.nullcontext()
+        return self.telemetry.span(name, **args)
+
+    def _record_batch(self, stats: Dict[str, Any]) -> None:
+        self._last_batch = stats
+
+    def _record_latency(self, seconds: float) -> None:
+        qps, lat_snap = 0.0, None
+        with self._lock:
+            self._lat.append(seconds * 1e3)
+            self.answered += 1
+            self._window_n += 1
+            if (self.cfg.metrics_window
+                    and self._window_n >= self.cfg.metrics_window):
+                now = time.perf_counter()
+                qps = self._window_n / max(now - self._window_t0, 1e-9)
+                lat_snap = list(self._lat)
+                self._window_t0 = now
+                self._window_n = 0
+        if lat_snap is not None:
+            self._emit_window(qps, lat_snap)
+
+    def _account(self, answer: Dict[str, Any], t0: float) -> Dict[str, Any]:
+        """Per-answer bookkeeping: an ``{"id", "error"}`` answer (a
+        malformed record the dispatch answered individually) counts as
+        an error, everything else as an answered query with latency."""
+        if "error" in answer:
+            with self._lock:
+                self.errors += 1
+        else:
+            self._record_latency(time.perf_counter() - t0)
+        return answer
+
+    def _percentiles(
+        self, lat: Optional[List[float]] = None
+    ) -> Dict[str, float]:
+        if lat is None:
+            lat = list(self._lat)
+        if not lat:
+            return {"p50_ms": 0.0, "p99_ms": 0.0}
+        return {
+            "p50_ms": float(np.percentile(lat, 50)),
+            "p99_ms": float(np.percentile(lat, 99)),
+        }
+
+    def _emit_window(self, qps: float, lat: List[float]) -> None:
+        """One latency/throughput/queue-depth row per window — the
+        serving counterpart of the train loop's display cadence.  The
+        counters were snapshot under the lock; the percentile math and
+        telemetry/log I/O here run OUTSIDE it so concurrent answer
+        accounting never stalls on a window emission."""
+        row = {
+            "qps": round(qps, 1),
+            **{k: round(v, 3) for k, v in self._percentiles(lat).items()},
+            "queue_depth": self.batcher.queue_depth,
+            "batches": self.batcher.batches,
+            "rejected": self.batcher.rejected,
+            **{f"batch_{k}": round(v, 3) if isinstance(v, float) else v
+               for k, v in self._last_batch.items()},
+        }
+        if self.telemetry is not None and self.telemetry.metrics_enabled:
+            try:
+                self.telemetry.log("serve", self.answered, row)
+            except Exception as e:  # noqa: BLE001 — telemetry is not the run
+                log.error("serve metrics emission failed: %s", e)
+        log.info("serve window: %s", row)
+
+    # -- serving core ------------------------------------------------------
+
+    def _dispatch(self, items: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        """Batcher dispatch: coalesced query records -> per-query
+        answers.  A malformed record (missing field, wrong embedding
+        shape, ragged input) answers ``{"id", "error"}`` WITHOUT failing
+        its co-riders — one hostile client must not degrade unrelated
+        traffic sharing the micro-batch.  Raw-'input' records encode as
+        ONE stacked dispatch (that is the batcher's whole point), then
+        merge with the embedding records for one top-k dispatch."""
+        from npairloss_tpu.serve.engine import ServeCompileError
+
+        dim = self.engine.index.dim
+        answers: List[Optional[Dict[str, Any]]] = [None] * len(items)
+        emb_rows: List[tuple] = []  # (item position, (D,) query row)
+        enc_rows: List[tuple] = []  # (item position, raw input array)
+        for i, rec in enumerate(items):
+            try:
+                if "embedding" in rec:
+                    e = np.asarray(rec["embedding"], np.float32)
+                    if e.shape != (dim,):
+                        raise ValueError(
+                            f"embedding shape {e.shape} does not match "
+                            f"gallery dim ({dim},)"
+                        )
+                    emb_rows.append((i, e))
+                elif "input" in rec:
+                    enc_rows.append(
+                        (i, np.asarray(rec["input"], np.float32))
+                    )
+                else:
+                    raise ValueError(
+                        "query record needs an 'embedding' or 'input' field"
+                    )
+            except Exception as e:  # noqa: BLE001 — answer THIS record
+                answers[i] = {"id": rec.get("id"), "error": str(e)}
+        if enc_rows:
+            try:
+                enc = self.engine.encode(
+                    np.stack([x for _, x in enc_rows])
+                )
+                emb_rows.extend(
+                    (i, row) for (i, _), row in zip(enc_rows, enc)
+                )
+            except ServeCompileError:
+                raise  # strict-guard trip is a server fault, fail loudly
+            except Exception as e:  # noqa: BLE001 — ragged stack, no model
+                for i, _ in enc_rows:
+                    answers[i] = {"id": items[i].get("id"),
+                                  "error": str(e)}
+        if emb_rows:
+            out = self.engine.query(np.stack([x for _, x in emb_rows]))
+            for j, (i, _) in enumerate(emb_rows):
+                answers[i] = {
+                    "id": items[i].get("id"),
+                    "neighbors": [
+                        {
+                            "rank": r,
+                            "row": int(out["rows"][j, r]),
+                            "gallery_id": int(out["ids"][j, r]),
+                            "label": int(out["labels"][j, r]),
+                            "score": round(float(out["scores"][j, r]), 6),
+                        }
+                        for r in range(out["scores"].shape[1])
+                    ],
+                }
+        return answers
+
+    def submit(self, record: Dict[str, Any]):
+        """Admit one query record; returns (future, t_submit).  Raises
+        :class:`QueueFullError` on backpressure."""
+        with self._span("serve/admit"):
+            with self._lock:  # HTTP front end submits from many threads
+                self.queries += 1
+            return self.batcher.submit(record), time.perf_counter()
+
+    def handle_many(
+        self,
+        records: List[Dict[str, Any]],
+        timeout: Optional[float] = 60.0,
+    ) -> List[Dict[str, Any]]:
+        """Blocking multi-query path: admit EVERY record before waiting
+        on any, so co-riders from one request coalesce into shared
+        micro-batches instead of each paying its own deadline wait."""
+        staged: List[Any] = []
+        for rec in records:
+            try:
+                staged.append((rec, *self.submit(rec)))
+            except QueueFullError as e:
+                # counted in batcher.rejected — NOT also in errors, or
+                # the drain invariant queries == answered + errors +
+                # rejected double-counts every rejection
+                staged.append((rec, None, str(e)))
+        answers = []
+        for rec, fut, t0_or_err in staged:
+            if fut is None:
+                answers.append({"id": rec.get("id"),
+                                "error": t0_or_err})
+                continue
+            try:
+                answer = fut.result(timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — answer the error
+                with self._lock:
+                    self.errors += 1
+                answers.append({"id": rec.get("id"), "error": str(e)})
+                continue
+            answers.append(self._account(answer, t0_or_err))
+        return answers
+
+    def handle(self, record: Dict[str, Any],
+               timeout: Optional[float] = 60.0) -> Dict[str, Any]:
+        """Blocking one-query path (the HTTP front end's per-thread
+        call): admit, wait, account latency."""
+        return self.handle_many([record], timeout=timeout)[0]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "event": "serve_drain",
+            "queries": self.queries,
+            "answered": self.answered,
+            "errors": self.errors,
+            "rejected": self.batcher.rejected,
+            "batches": self.batcher.batches,
+            **{k: round(v, 3) for k, v in self._percentiles().items()},
+            **self.engine.compile_stats(),
+        }
+
+    def _drain(self) -> Dict[str, Any]:
+        """Finish in-flight batches, flush telemetry, return the
+        summary record.  Idempotent enough for every exit path."""
+        self.batcher.close(drain=True)
+        s = self.summary()
+        if self.telemetry is not None:
+            with contextlib.suppress(Exception):
+                if self.telemetry.metrics_enabled:
+                    self.telemetry.log("serve", self.answered, s)
+                self.telemetry.flush()
+        log.info("serve drain: %s", s)
+        return s
+
+    def _preempted(self) -> bool:
+        return self.preempt is not None and self.preempt.requested
+
+    # -- stdin/JSONL front end --------------------------------------------
+
+    def run_jsonl(self, in_stream, out_stream) -> int:
+        """Serve line-delimited JSON until EOF or preemption; answers go
+        out in request order.  Returns the process exit code (0 on EOF,
+        EXIT_PREEMPTED after a graceful drain)."""
+        self.batcher.start()
+        pending: collections.deque = collections.deque()
+        emit_lock = threading.Lock()
+
+        def emit(obj) -> None:
+            with emit_lock:
+                out_stream.write(json.dumps(obj) + "\n")
+                out_stream.flush()
+
+        def flush_ready(block: bool) -> None:
+            while pending:
+                rec_id, fut, t0 = pending[0]
+                if not block and not fut.done():
+                    return
+                try:
+                    answer = self._account(fut.result(timeout=120.0), t0)
+                except Exception as e:  # noqa: BLE001
+                    with self._lock:
+                        self.errors += 1
+                    answer = {"id": rec_id, "error": str(e)}
+                pending.popleft()
+                emit(answer)
+
+        # A dedicated reader thread blocks in readline and feeds a
+        # queue, so the loop notices a SIGTERM within poll_s even while
+        # idle.  (An fd-level select + buffered readline cannot do this
+        # safely: readline reads ahead into the stream buffer, and lines
+        # stranded there never make the fd readable again — the tail of
+        # a burst would sit unanswered until EOF.)
+        lines_q: queue.Queue = queue.Queue()
+        _eof = object()
+
+        def _read() -> None:
+            try:
+                for line in iter(in_stream.readline, ""):
+                    lines_q.put(line)
+            except Exception as e:  # noqa: BLE001 — surface as EOF
+                log.warning("jsonl reader: %s", e)
+            finally:
+                lines_q.put(_eof)
+
+        threading.Thread(target=_read, daemon=True,
+                         name="serve-jsonl-reader").start()
+        preempted = False
+        try:
+            eof = False
+            while not eof:
+                if self._preempted():
+                    preempted = True
+                    break
+                try:
+                    line = lines_q.get(timeout=self.cfg.poll_s)
+                except queue.Empty:
+                    flush_ready(block=False)
+                    continue
+                if line is _eof:
+                    eof = True
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    with self._lock:
+                        self.errors += 1
+                    emit({"id": None, "error": f"bad request JSON: {e}"})
+                    continue
+                try:
+                    fut, t0 = self.submit(rec)
+                    pending.append((rec.get("id"), fut, t0))
+                except QueueFullError as e:
+                    # counted in batcher.rejected, not errors (drain
+                    # invariant: queries == answered + errors + rejected)
+                    emit({"id": rec.get("id"), "error": str(e)})
+                flush_ready(block=False)
+        finally:
+            # Graceful drain on EVERY exit: stop admitting, answer every
+            # in-flight query, flush telemetry — zero drops.
+            self.batcher.close(drain=True)
+            flush_ready(block=True)
+            emit(self._drain())
+        # A SIGTERM that lands while the reader is blocked can surface
+        # as EOF first (the supervisor closes stdin as it signals);
+        # any observed preemption request still means "preempted".
+        return EXIT_PREEMPTED if (preempted or self._preempted()) else 0
+
+    # -- localhost HTTP front end -----------------------------------------
+
+    def run_http(self, port: int, host: str = "127.0.0.1") -> int:
+        """Serve HTTP until preemption (the only exit path besides an
+        error); each request thread batches through the shared core."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server_ref = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, obj) -> None:
+                body = (json.dumps(obj) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {
+                        "ok": True,
+                        "draining": server_ref._preempted(),
+                        **server_ref.summary(),
+                    })
+                else:
+                    self._send(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/query":
+                    self._send(404, {"error": "unknown path"})
+                    return
+                if server_ref._preempted():
+                    self._send(503, {"error": "draining"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length).decode("utf-8", "replace")
+                try:
+                    lines = [ln for ln in raw.splitlines() if ln.strip()]
+                    recs = [json.loads(ln) for ln in lines]
+                except ValueError as e:
+                    self._send(400, {"error": f"bad request JSON: {e}"})
+                    return
+                if not recs:
+                    self._send(400, {"error": "empty request"})
+                    return
+                answers = server_ref.handle_many(recs)
+                self._send(200, answers[0] if len(answers) == 1 else answers)
+
+        self.batcher.start()
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        httpd.timeout = self.cfg.poll_s
+        log.info("serving on http://%s:%d (POST /query, GET /healthz)",
+                 host, httpd.server_address[1])
+        try:
+            while not self._preempted():
+                httpd.handle_request()
+        finally:
+            with contextlib.suppress(Exception):
+                httpd.server_close()
+            self._drain()
+        return EXIT_PREEMPTED

@@ -1,2 +1,6 @@
 from npairloss_tpu.train.optim import caffe_sgd, lr_schedule
-from npairloss_tpu.train.solver import Solver, SolverConfig
+from npairloss_tpu.train.solver import (
+    Solver,
+    SolverConfig,
+    restore_for_inference,
+)

@@ -1433,5 +1433,65 @@ class Solver:
         return None
 
 
+def restore_for_inference(
+    path: str,
+    retry: Optional[RetryPolicy] = None,
+) -> Dict[str, Any]:
+    """Snapshot -> ``{"params", "batch_stats"}`` for the serving path.
+
+    The snapshot->inference direction, split out of the Solver: serving
+    (``serve.QueryEngine``) needs the model variables from a committed
+    training snapshot but must not drag in the optimizer rebuild, the
+    schedule, or a Solver instance.  Raw Orbax restore (no target tree),
+    retried like ``Solver.restore_snapshot``, with the params/batch_stats
+    SUBSET checksum-verified against the commit manifest — the optimizer
+    leaves are skipped both because inference never touches them and
+    because the raw restore rehydrates the opt NamedTuple as a plain
+    dict, which would shift every keystr.  Manifest-less dirs restore
+    unverified (the legacy contract).
+    """
+    import os
+
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckpt = ocp.StandardCheckpointer()
+
+    def do_restore():
+        failpoints.fire("snapshot.restore.io")
+        return ckpt.restore(path)
+
+    state = call_with_retry(
+        do_restore, retry if retry is not None else RetryPolicy(),
+        describe=f"inference restore ({path})",
+    )
+    if not isinstance(state, dict) or "params" not in state:
+        raise SnapshotValidationError(
+            f"{path} does not look like a training snapshot "
+            "(no 'params' subtree)"
+        )
+    infer = {
+        "params": state["params"],
+        "batch_stats": state.get("batch_stats") or {},
+    }
+    try:
+        manifest = read_manifest(path)
+    except FileNotFoundError:
+        log.info("restored %s for inference without checksum "
+                 "verification (no commit manifest)", path)
+    except (OSError, ValueError) as e:
+        raise SnapshotValidationError(
+            f"unreadable manifest in {path}: {e}"
+        ) from e
+    else:
+        prefixes = ("['params']", "['batch_stats']")
+        subset = {
+            k: v for k, v in manifest.get("arrays", {}).items()
+            if k.startswith(prefixes)
+        }
+        verify_restored(infer, {"arrays": subset})
+    return infer
+
+
 def _fmt(metrics: Dict[str, float]) -> str:
     return " ".join(f"{k}={float(v):.4g}" for k, v in sorted(metrics.items()))

@@ -129,6 +129,75 @@ print(f"step/compile: cold {durs[0]:.0f} ms -> cached {durs[1]:.0f} ms")
 EOF
 echo "compile-cache round-trip OK (no new entries on the second process)"
 
+echo "== serving smoke (docs/SERVING.md) =="
+# Build a synthetic gallery index, serve it over stdin/JSONL with the
+# strict compile guard armed, issue 100 queries, assert every answer
+# (incl. exact self-match top-1), a p99 bound, and ZERO post-warmup
+# compiles from the counted drain summary — then kill -TERM and assert
+# the graceful-drain contract: exit 75, all admitted queries answered,
+# telemetry flushed to disk.
+serve_dir="$smoke_dir/serve"
+mkdir -p "$serve_dir"
+python - "$serve_dir" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+rng = np.random.default_rng(0)
+emb = rng.standard_normal((512, 64)).astype(np.float32)
+emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+np.save(d + "/g.emb.npy", emb)
+np.save(d + "/g.labels.npy", np.repeat(np.arange(64), 8).astype(np.int32))
+with open(d + "/queries.jsonl", "w") as f:
+    for i in range(100):  # queries ARE gallery rows: top-1 must self-match
+        f.write(json.dumps({"id": i, "embedding": emb[i].tolist()}) + "\n")
+EOF
+JAX_PLATFORMS=cpu python -m npairloss_tpu index \
+    --emb "$serve_dir/g.emb.npy" --labels "$serve_dir/g.labels.npy" \
+    --no-normalize --out "$serve_dir/g.gidx" > "$serve_dir/index.log" 2>&1 \
+    || { echo "smoke: index build failed"; cat "$serve_dir/index.log"; exit 1; }
+mkfifo "$serve_dir/in"
+JAX_PLATFORMS=cpu NPAIRLOSS_SERVE_COMPILE_GUARD=strict \
+    python -m npairloss_tpu serve --index "$serve_dir/g.gidx" \
+    --top-k 5 --buckets 1,8,32 --telemetry-dir "$serve_dir/tel" \
+    < "$serve_dir/in" > "$serve_dir/answers.jsonl" \
+    2> "$serve_dir/serve.log" &
+spid=$!
+exec 3> "$serve_dir/in"  # hold the writer open: EOF must not end the run
+cat "$serve_dir/queries.jsonl" >&3
+for _ in $(seq 1 240); do  # wait for all 100 answers (warmup included)
+    [[ "$(wc -l < "$serve_dir/answers.jsonl")" -ge 100 ]] && break
+    kill -0 "$spid" 2>/dev/null \
+        || { echo "smoke: server died mid-serve"; cat "$serve_dir/serve.log"; exit 1; }
+    sleep 0.5
+done
+kill -TERM "$spid" 2>/dev/null || true
+exec 3>&-
+rc=0; wait "$spid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "smoke: expected exit 75 after SIGTERM, got $rc"; cat "$serve_dir/serve.log"; exit 1; }
+python - "$serve_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", f"last line is not the drain summary: {drain}"
+answers = {a["id"]: a for a in lines[:-1]}
+assert len(answers) == 100, f"expected 100 answers, got {len(answers)}"
+for i in range(100):
+    a = answers[i]
+    assert "neighbors" in a, f"query {i} answered with an error: {a}"
+    top1 = a["neighbors"][0]
+    assert top1["row"] == i, f"query {i}: top-1 row {top1['row']} != self"
+assert drain["answered"] == 100 and drain["errors"] == 0, drain
+assert drain["compiles_after_warmup"] == 0, drain  # counted, not eyeballed
+assert drain["p99_ms"] < 500.0, f"p99 {drain['p99_ms']} ms over bound"
+tel = [json.loads(ln) for ln in open(d + "/tel/metrics.jsonl") if ln.strip()]
+assert any(r.get("event") == "serve_drain" for r in tel), "drain summary not flushed to telemetry"
+assert json.load(open(d + "/tel/manifest.json"))["config"]["serve"], "manifest missing"
+print(f"serving smoke OK (100 answers, p99 {drain['p99_ms']:.1f} ms, "
+      f"0 post-warmup compiles, clean drain)")
+EOF
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

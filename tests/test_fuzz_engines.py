@@ -157,6 +157,7 @@ def test_fuzz_ring_vs_dense_two_shards(trial):
                                err_msg=str(cfg))
 
 
+@pytest.mark.slow  # ~87s over 4 trials; tier-1 budget, run with -m slow
 @pytest.mark.parametrize("trial", range(4))
 def test_fuzz_pos_topk_fast_path_vs_radix(trial):
     """The sparse-positive fast path (pos_topk buffer) and forced radix

@@ -200,6 +200,7 @@ def test_googlenet_bn_trains_from_scratch_spread():
     assert np.isfinite(emb_eval).all()
 
 
+@pytest.mark.slow  # ~46s; tier-1 budget (ROADMAP.md), run with -m slow
 def test_googlenet_remat_is_numerically_identical():
     """remat=True checkpoints each inception block (recompute in the
     backward) — outputs AND gradients must match remat=False exactly;

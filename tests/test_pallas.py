@@ -247,6 +247,7 @@ def test_blockwise_relative_clamp_quirk(rng):
     )
 
 
+@pytest.mark.slow  # ~115s over 4 params; tier-1 budget, run with -m slow
 @pytest.mark.parametrize("region", [MiningRegion.LOCAL, MiningRegion.GLOBAL])
 @pytest.mark.parametrize("imgs_per_id", [9, 11])
 def test_blockwise_pos_topk_fallback_boundary(rng, region, imgs_per_id):
@@ -292,6 +293,7 @@ def test_blockwise_pos_topk_disabled_matches(rng):
         aux_b["pos_threshold"], aux_d["pos_threshold"], rtol=1e-6)
 
 
+@pytest.mark.slow  # ~28s; tier-1 budget, run with -m slow
 def test_blockwise_pos_topk_with_sim_cache(rng):
     """Fast path + fp32 sim cache together (the 32k stretch shape):
     cached and uncached must agree bit-for-bit, and both must match the

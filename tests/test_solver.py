@@ -167,6 +167,7 @@ def test_training_learns_sharded_mesh():
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow  # ~31s; tier-1 budget, run with -m slow
 def test_batchnorm_model_trains():
     """Mutable batch_stats path (resnet18 on tiny inputs)."""
     cfg = SolverConfig(base_lr=0.01, lr_policy="fixed", display=0, snapshot=0)
