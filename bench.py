@@ -91,9 +91,9 @@ SPILL_PATH = os.environ.get(
 # voided round.  Clear an entry manually to re-try the row.
 QUARANTINE_PATH = os.path.join(REPO, "bench_cache", "quarantine.json")
 
-# Peak-FLOP/s table and cost analysis live in utils.profiling
-# (peak_flops / cost_flops) — one home, shared with the CLI `time`
-# subcommand.
+# Peak-FLOP/s table, cost analysis, and the MFU computation live in
+# npairloss_tpu/obs/perf/costs.py (mfu_from_timing) — one home, shared
+# with the CLI `time`/`prof` subcommands (utils.profiling re-exports).
 
 # Every final parent record also lands here as one JSONL row with the
 # obs envelope (run_id/step/wall_time/phase) — the bench trajectory as a
@@ -175,20 +175,17 @@ def _child_setup(platform: str):
     return jax, dev
 
 
-def _peak_flops(device_kind: str):
-    from npairloss_tpu.utils.profiling import peak_flops
+def _mfu_estimate(compiled, dt: float, steps: int, device_kind: str):
+    """``{"step_flops", "mfu"}`` (values possibly None) via THE shared
+    helper (obs.perf.costs.mfu_from_timing) — bench must never grow its
+    own flops/peak arithmetic again."""
+    from npairloss_tpu.utils.profiling import mfu_from_timing
 
-    return peak_flops(device_kind)
-
-
-def _cost_flops(compiled):
-    """XLA's analytic FLOPs for one compiled step, or None."""
-    from npairloss_tpu.utils.profiling import cost_flops
-
-    f = cost_flops(compiled)
-    if f is None:
+    est = mfu_from_timing(compiled, seconds=dt, steps=steps,
+                          device_kind=device_kind)
+    if est["step_flops"] is None:
         _log("cost_analysis unavailable")
-    return f
+    return est
 
 
 def child_probe(platform: str) -> int:
@@ -348,12 +345,10 @@ def child_full(platform: str, steps: int, warmup: int,
             compiled = solver._step_fn.lower(
                 solver.state, x, lab
             ).compile()
-            step_flops = _cost_flops(compiled)
-            peak = _peak_flops(dev.device_kind)
-            if step_flops and peak:
-                mfu = (step_flops * steps / dt) / peak
-                _log(f"mfu={mfu:.3f} (step_flops={step_flops:.3e}, "
-                     f"peak={peak:.0e})")
+            est = _mfu_estimate(compiled, dt, steps, dev.device_kind)
+            step_flops, mfu = est["step_flops"], est["mfu"]
+            if mfu is not None:
+                _log(f"mfu={mfu:.3f} (step_flops={step_flops:.3e})")
         except Exception as e:
             _log(f"mfu estimate failed: {e}")
 
@@ -902,10 +897,9 @@ def _batch_scaling_row(jax, jnp, np, dev, floor, rows, batch, model_name,
     mfu = None
     try:
         compiled = solver._step_fn.lower(solver.state, x, lab).compile()
-        step_flops = _cost_flops(compiled)
-        peak = _peak_flops(dev.device_kind)
-        if step_flops and peak:
-            mfu = round((step_flops * steps / dt) / peak, 4)
+        est = _mfu_estimate(compiled, dt, steps, dev.device_kind)
+        if est["mfu"] is not None:
+            mfu = round(est["mfu"], 4)
     except Exception as e:
         _log(f"batch {key} mfu estimate failed: {e}")
     rows[key] = {

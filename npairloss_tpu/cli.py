@@ -323,6 +323,10 @@ def cmd_train(args) -> int:
         from npairloss_tpu.obs import HealthConfig
 
         solver.health = HealthConfig()
+    if getattr(args, "perf_metrics", False):
+        # Continuous phase="perf" rows (ms_per_step / emb_per_sec /
+        # MFU) at display cadence — docs/OBSERVABILITY.md §Perf.
+        solver.perf_metrics = True
 
     from npairloss_tpu.resilience import (
         EXIT_PREEMPTED,
@@ -1046,9 +1050,8 @@ def cmd_time(args) -> int:
 
     from npairloss_tpu.data import synthetic_identity_batches
     from npairloss_tpu.utils.profiling import (
-        cost_flops,
         dispatch_floor,
-        peak_flops,
+        mfu_from_timing,
         time_scan,
     )
 
@@ -1118,20 +1121,21 @@ def cmd_time(args) -> int:
         rec["emb_per_sec"] = round(batch / fb_ms * 1e3, 1)
         # XLA's analytic FLOPs for one step, from the LOWERED program
         # (client-side; never asks the backend to compile a second
-        # executable), plus MFU when the device's peak is known.
+        # executable), plus MFU when the device's peak is known — both
+        # via THE shared helper (obs.perf.costs.mfu_from_timing).
         try:
             lowered = jax.jit(
                 lambda c: fb_body(c, jnp.float32(0.0))
             ).lower(init)
-            flops = cost_flops(lowered)
+            est = mfu_from_timing(lowered, seconds=fb_ms * 1e-3,
+                                  device_kind=dev.device_kind)
         except Exception as e:
             log.info("step_flops estimate unavailable: %s", e)
-            flops = None
-        if flops:
-            rec["step_flops"] = flops
-            peak = peak_flops(dev.device_kind)
-            if peak:
-                rec["mfu"] = round(flops / (fb_ms * 1e-3) / peak, 4)
+            est = {"step_flops": None, "mfu": None}
+        if est["step_flops"]:
+            rec["step_flops"] = est["step_flops"]
+            if est["mfu"] is not None:
+                rec["mfu"] = round(est["mfu"], 4)
     print(json.dumps(rec))
     return 0
 
@@ -1170,6 +1174,181 @@ def cmd_device_query(args) -> int:
         "devices": devices,
     }, indent=2))
     return 0
+
+
+def cmd_prof(args) -> int:
+    """Perf observatory (docs/OBSERVABILITY.md §Perf): one on-disk
+    report per run — static per-``named_scope``-region FLOPs / bytes /
+    arithmetic-intensity / roofline bound-class attribution of the
+    jitted step, plus the span-derived step-time decomposition
+    reconciled against wall time.  Device-trace-free by design
+    (``jax.profiler`` wedges tunneled backends); everything comes from
+    compiled-HLO metadata and the host span streams, so it runs
+    anywhere — including CPU, where the roofline falls back to the v4
+    reference spec (flagged in the report)."""
+    import jax
+    import numpy as np
+
+    from npairloss_tpu.obs import RunTelemetry
+    from npairloss_tpu.obs import perf as obsperf
+
+    steps = max(int(args.steps), 1)
+    out_dir = args.out
+    dev = jax.devices()[0]
+    tel = RunTelemetry(os.path.join(out_dir, "run"), metrics=True,
+                       trace=True)
+    try:
+        if args.step == "train":
+            report = _prof_train(args, jax, np, dev, tel, steps, obsperf)
+        else:
+            report = _prof_serve(args, jax, np, dev, tel, steps, obsperf)
+    finally:
+        tel.close()
+    err = obsperf.validate_report(report)
+    if err is not None:
+        log.error("perf report failed its own schema check: %s", err)
+        return 1
+    paths = obsperf.write_report(report, out_dir)
+    print(obsperf.render_table(report))
+    print(json.dumps({"report": paths["json"], "table": paths["txt"],
+                      "telemetry": tel.run_dir}))
+    return 0
+
+
+def _prof_train(args, jax, np, dev, tel, steps, obsperf):
+    """Train-step profile: N real solver steps (device-wait spanned so
+    device compute is attributed, not absorbed), then one extra AOT
+    compile of the same program for its HLO text."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from npairloss_tpu import REFERENCE_CONFIG
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    batch = int(args.batch)
+    side = int(args.image)
+    model = get_model(
+        args.model, dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    mesh = None
+    if args.mesh and args.mesh > 1:
+        from npairloss_tpu.parallel import data_parallel_mesh
+
+        mesh = data_parallel_mesh(jax.devices()[:args.mesh])
+    input_shape = (side, side, 3) if args.model != "mlp" else (side,)
+    solver = Solver(
+        model, REFERENCE_CONFIG,
+        SolverConfig(base_lr=0.001, lr_policy="step", stepsize=10000,
+                     gamma=0.5, momentum=0.9, weight_decay=2e-5,
+                     display=0, snapshot=0),
+        # perf_metrics stays OFF: with display=0 the continuous rows
+        # never emit, so the flops capture would only pay an extra
+        # client-side re-lowering (~1/3 of a small prof run's wall)
+        # that the report doesn't consume — build_report reads the
+        # compiled stage directly.
+        mesh=mesh, engine=args.engine, input_shape=input_shape,
+        telemetry=tel,
+    )
+    # The shared synthetic generator, not a hand-rolled batch — the
+    # identity-pair layout contract lives in data.synthetic only.
+    from npairloss_tpu.data import synthetic_identity_batches
+
+    ids = max((batch + 1) // 2, 1)
+    x, lab = next(iter(synthetic_identity_batches(
+        ids, ids, 2, input_shape, seed=0)))
+    x, lab = x[:batch], lab[:batch]
+    log.info("prof train: model=%s batch=%d steps=%d device=%s",
+             args.model, batch, steps, dev.device_kind)
+    solver.init(x[:2])
+    t0_us = tel.tracer.now_us()
+    step_walls = []
+    t0 = _time.perf_counter()
+    for i in range(steps):
+        s0 = _time.perf_counter()
+        metrics = solver.step(x, lab)
+        # The dispatch is async: without this span the device compute
+        # would land in "unattributed"; with it, the wait IS the
+        # device-compute share of the loop wall clock.
+        with tel.span("step/device_wait", step=i):
+            jax.block_until_ready(metrics)
+        step_walls.append(_time.perf_counter() - s0)
+    wall_ms = (_time.perf_counter() - t0) * 1e3
+    # Post-compile per-step time: the first step paid the XLA compile.
+    warm = step_walls[1:] or step_walls
+    ms_per_step = min(warm) * 1e3
+    log.info("prof train: %d steps in %.1f ms (%.2f ms/step warm); "
+             "extracting HLO (one extra AOT compile)...",
+             steps, wall_ms, ms_per_step)
+    x_sds = jax.ShapeDtypeStruct((batch, *input_shape), jnp.float32)
+    lab_sds = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    compiled = solver._step_fn.lower(solver.state, x_sds, lab_sds).compile()
+    events = [e for e in tel.tracer.to_chrome_trace()["traceEvents"]
+              if e.get("ts", 0) >= t0_us]
+    return obsperf.build_report(
+        step="train", device_kind=dev.device_kind, batch=batch,
+        stage=compiled, span_events=events, wall_ms=wall_ms,
+        ms_per_step=ms_per_step, steps=steps,
+        region_depth=int(args.region_depth),
+        extra={"model": args.model, "engine": solver.engine},
+    )
+
+
+def _prof_serve(args, jax, np, dev, tel, steps, obsperf):
+    """Serve-query profile: synthetic gallery + warmed QueryEngine, N
+    per-bucket query dispatches, static attribution of the largest
+    bucket's top-k program, serve/* span latency split."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from npairloss_tpu.serve import EngineConfig, GalleryIndex, QueryEngine
+
+    rng = np.random.default_rng(0)
+    gallery = int(args.gallery)
+    dim = int(args.dim)
+    emb = rng.standard_normal((gallery, dim)).astype(np.float32)
+    index = GalleryIndex.build(
+        emb, (np.arange(gallery) % max(gallery // 8, 1)).astype(np.int32))
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    engine = QueryEngine(
+        index, EngineConfig(top_k=int(args.top_k), buckets=buckets),
+        telemetry=tel,
+    )
+    log.info("prof serve: gallery=%d dim=%d buckets=%s steps=%d",
+             gallery, dim, buckets, steps)
+    engine.warmup()
+    t0_us = tel.tracer.now_us()
+    t0 = _time.perf_counter()
+    q = rng.standard_normal((buckets[-1], dim)).astype(np.float32)
+    # Cycle largest-bucket-first so every bucket contributes spans to
+    # the latency split, but time ONLY the largest bucket's own
+    # dispatches: the MFU/emb_per_sec line prices the largest bucket's
+    # compiled program, and dividing its FLOPs by a wall averaged over
+    # smaller batches would inflate both by the bucket-size spread.
+    big_walls = []
+    for i in range(steps):
+        b = buckets[-1 - (i % len(buckets))]
+        s0 = _time.perf_counter()
+        engine.query(q[:b])
+        if b == buckets[-1]:
+            big_walls.append(_time.perf_counter() - s0)
+    wall_ms = (_time.perf_counter() - t0) * 1e3
+    bucket = buckets[-1]
+    qpad = jnp.zeros((bucket, dim), jnp.float32)
+    compiled = engine._topk_fn.lower(
+        qpad, index.emb, index.labels, index.valid).compile()
+    events = [e for e in tel.tracer.to_chrome_trace()["traceEvents"]
+              if e.get("ts", 0) >= t0_us]
+    return obsperf.build_report(
+        step="serve", device_kind=dev.device_kind, batch=bucket,
+        stage=compiled, span_events=events, wall_ms=wall_ms,
+        ms_per_step=min(big_walls) * 1e3, steps=len(big_walls),
+        serve_spans=True,
+        region_depth=int(args.region_depth),
+        extra={"gallery": gallery, "dim": dim,
+               "compile_stats": engine.compile_stats()},
+    )
 
 
 def cmd_bench(args) -> int:
@@ -1368,6 +1547,12 @@ def main(argv: Optional[list] = None) -> int:
         help="fold in-graph training-health signals into every step's "
         "metrics (grad/param/update norms, update/param ratio, embedding "
         "magnitude, mined-pair hardness) — obs.health.HealthConfig",
+    )
+    t.add_argument(
+        "--perf-metrics", dest="perf_metrics", action="store_true",
+        help="emit one phase=\"perf\" telemetry row per display window "
+        "(ms_per_step, emb_per_sec, MFU from XLA's analytic step FLOPs) "
+        "— needs --telemetry-dir; docs/OBSERVABILITY.md §Perf",
     )
     t.add_argument(
         "--debug-checks", dest="debug_checks", action="store_true",
@@ -1659,6 +1844,45 @@ def main(argv: Optional[list] = None) -> int:
         help="enumerate accelerators (the caffe device_query action)",
     )
     dq.set_defaults(fn=cmd_device_query)
+
+    pr = sub.add_parser(
+        "prof",
+        help="perf observatory: per-region HLO cost attribution + "
+        "roofline bound-class + step-time decomposition report "
+        "(docs/OBSERVABILITY.md §Perf)",
+    )
+    pr.add_argument(
+        "--step", choices=["train", "serve"], default="train",
+        help="which jitted program to profile",
+    )
+    pr.add_argument("--model", default="googlenet",
+                    help="model registry name (train)")
+    pr.add_argument("--batch", type=int, default=8,
+                    help="train batch size (identity pairs)")
+    pr.add_argument("--image", type=int, default=224,
+                    help="input side (or flat dim for --model mlp)")
+    pr.add_argument("--steps", type=int, default=4,
+                    help="measured steps/queries for the dynamic layer")
+    pr.add_argument("--engine", choices=["dense", "ring", "blockwise"],
+                    help="loss engine (train)")
+    pr.add_argument("--mesh", type=int, default=0,
+                    help="devices in the dp mesh (train; 0 = single)")
+    pr.add_argument("--bf16", action="store_true",
+                    help="bf16 trunk activations (train)")
+    pr.add_argument("--gallery", type=int, default=2048,
+                    help="synthetic gallery rows (serve)")
+    pr.add_argument("--dim", type=int, default=64,
+                    help="embedding dim (serve)")
+    pr.add_argument("--top-k", dest="top_k", type=int, default=10)
+    pr.add_argument("--buckets", default="1,8,32",
+                    help="query padding buckets (serve)")
+    pr.add_argument("--region-depth", dest="region_depth", type=int,
+                    default=2,
+                    help="named-scope path depth to aggregate regions at")
+    pr.add_argument("--out", default="perf_reports",
+                    help="report output directory (perf_report.json/.txt "
+                    "+ run telemetry)")
+    pr.set_defaults(fn=cmd_prof)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")
     pp.add_argument("file")

@@ -1,0 +1,85 @@
+"""Perf observatory (docs/OBSERVABILITY.md §Perf observatory).
+
+The device-trace-free performance attribution layer — built because
+``jax.profiler`` device traces wedge the tunneled backend
+(``scripts/profile_flagship.py``), so *where the step time goes* must
+be recoverable from artifacts the host already has:
+
+  * ``perf.costs`` — THE shared cost-analysis/MFU helper (every
+    ``mfu`` number in the repo routes through here);
+  * ``perf.hlo`` — per-``jax.named_scope``-region FLOPs / bytes /
+    collective-bytes attribution parsed from compiled HLO text;
+  * ``perf.roofline`` — chip peak specs + compute/memory/collective
+    bound classification with arithmetic intensity;
+  * ``perf.decompose`` — step-time and serve-latency decomposition
+    from the obs.tracing span streams, wall-reconciled;
+  * ``perf.report`` — the versioned ``prof`` report artifact
+    (schema, validator, renderers).
+
+All modules are stdlib-only; jax-free processes (bench.py's parent,
+the profile orchestrator) load the ones they need by file path.
+Entry points: ``python -m npairloss_tpu prof --step train|serve`` and
+``scripts/bench_check.py``.
+"""
+
+from npairloss_tpu.obs.perf.costs import (
+    PEAK_FLOPS,
+    cost_analysis_dict,
+    cost_bytes,
+    cost_flops,
+    mfu_from_timing,
+    peak_flops,
+)
+from npairloss_tpu.obs.perf.decompose import (
+    SERVE_CATEGORIES,
+    STEP_CATEGORIES,
+    decompose_step_time,
+    serve_latency_decomposition,
+)
+from npairloss_tpu.obs.perf.hlo import (
+    UNSCOPED,
+    attribute_regions,
+    region_of,
+    stage_hlo_text,
+)
+from npairloss_tpu.obs.perf.report import (
+    REPORT_SCHEMA,
+    ablation_markdown,
+    build_report,
+    render_table,
+    validate_report,
+    write_report,
+)
+from npairloss_tpu.obs.perf.roofline import (
+    BOUND_CLASSES,
+    ChipSpec,
+    chip_peaks,
+    classify,
+)
+
+__all__ = [
+    "PEAK_FLOPS",
+    "cost_analysis_dict",
+    "cost_bytes",
+    "cost_flops",
+    "mfu_from_timing",
+    "peak_flops",
+    "STEP_CATEGORIES",
+    "SERVE_CATEGORIES",
+    "decompose_step_time",
+    "serve_latency_decomposition",
+    "UNSCOPED",
+    "attribute_regions",
+    "region_of",
+    "stage_hlo_text",
+    "REPORT_SCHEMA",
+    "ablation_markdown",
+    "build_report",
+    "render_table",
+    "validate_report",
+    "write_report",
+    "BOUND_CLASSES",
+    "ChipSpec",
+    "chip_peaks",
+    "classify",
+]

@@ -104,26 +104,32 @@ def _stream_topk(q, emb, labels_unused, valid, k: int, block: int):
         start = jnp.minimum(j * b, n - b)
         g = jax.lax.dynamic_slice_in_dim(emb, start, b, axis=0)
         v = jax.lax.dynamic_slice_in_dim(valid, start, b, axis=0)
-        sims = jnp.dot(
-            q, g.T,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        # named_scope: the scoring gemm vs the top-k merge show up as
+        # separate regions in `prof --step serve` (obs.perf) — the
+        # split that decides whether bf16/int8 scoring pays.
+        with jax.named_scope("serve/score"):
+            sims = jnp.dot(
+                q, g.T,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
         rows = start + jnp.arange(b, dtype=jnp.int32)
         # Mask padding rows AND the final block's clamped overlap (rows
         # below the unclamped start were scored by an earlier block — a
         # duplicate candidate would corrupt the top-k answer).
         ok = v & (rows >= j * b)
-        sims = jnp.where(ok[None, :], sims, jnp.float32(_NEG_FILL))
-        blk_s, blk_i = jax.lax.top_k(sims, kb)
-        blk_r = rows[blk_i]
-        # Merge: best-first concat keeps candidates in ascending global
-        # row order within equal scores, so top_k's lowest-index-first
-        # tie-break reproduces the dense answer exactly.
-        cand_s = jnp.concatenate([best_s, blk_s], axis=1)
-        cand_r = jnp.concatenate([best_r, blk_r], axis=1)
-        new_s, sel = jax.lax.top_k(cand_s, k)
-        new_r = jnp.take_along_axis(cand_r, sel, axis=1)
+        with jax.named_scope("serve/merge"):
+            sims = jnp.where(ok[None, :], sims, jnp.float32(_NEG_FILL))
+            blk_s, blk_i = jax.lax.top_k(sims, kb)
+            blk_r = rows[blk_i]
+            # Merge: best-first concat keeps candidates in ascending
+            # global row order within equal scores, so top_k's
+            # lowest-index-first tie-break reproduces the dense answer
+            # exactly.
+            cand_s = jnp.concatenate([best_s, blk_s], axis=1)
+            cand_r = jnp.concatenate([best_r, blk_r], axis=1)
+            new_s, sel = jax.lax.top_k(cand_s, k)
+            new_r = jnp.take_along_axis(cand_r, sel, axis=1)
         return (new_s, new_r), None
 
     init = (
@@ -225,7 +231,10 @@ class QueryEngine:
                 variables = {"params": state["params"]}
                 if state.get("batch_stats"):
                     variables["batch_stats"] = state["batch_stats"]
-                return l2_normalize(model.apply(variables, x, train=False))
+                with jax.named_scope("serve/encode"):
+                    emb = model.apply(variables, x, train=False)
+                with jax.named_scope("serve/normalize"):
+                    return l2_normalize(emb)
 
             self._encode_fn = jax.jit(encode)
         else:

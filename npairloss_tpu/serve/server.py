@@ -92,6 +92,26 @@ class RetrievalServer:
         self._window_t0 = time.perf_counter()
         self._window_n = 0
         self._last_batch: Dict[str, Any] = {}
+        # Tracer event-index cursor for the per-window latency
+        # decomposition (obs.perf.decompose): each emitted window reads
+        # only the spans appended since the previous one (appends
+        # happen at span END, so a span in flight across the boundary
+        # lands in the next window instead of vanishing), and the read
+        # is O(window), never a full-buffer rescan under the tracer
+        # lock.  The cursor's read-advance is guarded by its own lock:
+        # window emissions run on whichever request thread crossed the
+        # window threshold (deliberately outside self._lock), and two
+        # concurrent emissions reading the same stale cursor would
+        # double-count one window's spans into both splits.  Both
+        # cursors baseline at CONSTRUCTION time: cmd_serve warms the
+        # engine first, and warmup's serve/topk spans are XLA compiles
+        # — seconds-long outliers that would otherwise own the first
+        # window's and the drain summary's p99.
+        tracer = self._tracer()
+        baseline = tracer.num_events if tracer is not None else 0
+        self._events_start_idx = baseline
+        self._window_events_idx = baseline
+        self._window_events_lock = threading.Lock()
 
     # -- telemetry ---------------------------------------------------------
 
@@ -142,6 +162,45 @@ class RetrievalServer:
             "p99_ms": float(np.percentile(lat, 99)),
         }
 
+    def _tracer(self):
+        tel = self.telemetry
+        return getattr(tel, "tracer", None) if tel is not None else None
+
+    @staticmethod
+    def _latency_split(events) -> Dict[str, float]:
+        """Per-stage p50/p99 (encode/batch/dispatch/topk/admit) from a
+        list of serve/* span events — flattened to
+        ``<stage>_p50_ms``/``<stage>_p99_ms`` row keys (the Gemma-
+        serving-style latency decomposition, obs.perf.decompose)."""
+        from npairloss_tpu.obs.perf.decompose import (
+            serve_latency_decomposition,
+        )
+
+        split = serve_latency_decomposition(events)
+        return {
+            f"{stage}_{q}": v
+            for stage, row in split.items()
+            for q, v in row.items() if q != "count"
+        }
+
+    def _window_latency_split(self) -> Dict[str, float]:
+        """The current window's split: spans appended (= finished)
+        since the last window read, via the tracer's incremental
+        cursor.  ``spans_dropped`` surfaces the tracer's max_events cap
+        in the row stream itself — a capped tracer means the split has
+        silently gone partial, and that must be visible where the
+        p50/p99 numbers are read."""
+        tracer = self._tracer()
+        if tracer is None:
+            return {}
+        with self._window_events_lock:
+            events, self._window_events_idx, dropped = tracer.events_since(
+                self._window_events_idx)
+        out = self._latency_split(events)
+        if dropped:
+            out["spans_dropped"] = dropped
+        return out
+
     def _emit_window(self, qps: float, lat: List[float]) -> None:
         """One latency/throughput/queue-depth row per window — the
         serving counterpart of the train loop's display cadence.  The
@@ -154,6 +213,7 @@ class RetrievalServer:
             "queue_depth": self.batcher.queue_depth,
             "batches": self.batcher.batches,
             "rejected": self.batcher.rejected,
+            **self._window_latency_split(),
             **{f"batch_{k}": round(v, 3) if isinstance(v, float) else v
                for k, v in self._last_batch.items()},
         }
@@ -288,6 +348,13 @@ class RetrievalServer:
             "rejected": self.batcher.rejected,
             "batches": self.batcher.batches,
             **{k: round(v, 3) for k, v in self._percentiles().items()},
+            # Whole-run latency split: where an answer's time went,
+            # stage by stage (one read at drain, not per window; from
+            # the construction-time baseline so warmup compiles never
+            # masquerade as serving tail latency).
+            **(self._latency_split(
+                self._tracer().events_since(self._events_start_idx)[0])
+               if self._tracer() is not None else {}),
             **self.engine.compile_stats(),
         }
 

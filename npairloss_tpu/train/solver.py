@@ -146,6 +146,7 @@ class Solver:
         divergence: Optional[DivergenceConfig] = None,
         preempt: Optional[PreemptionSignal] = None,
         snapshot_retry: Optional[RetryPolicy] = None,
+        perf_metrics: bool = False,
     ):
         self.model = model
         self.loss_cfg = loss_cfg
@@ -180,6 +181,17 @@ class Solver:
         # must never abort training, so further metric emission stops
         # (spans, which are in-memory, keep recording).
         self._telemetry_failed = False
+        # Perf observatory hook (docs/OBSERVABILITY.md §Perf): when ON
+        # and telemetry is attached, one ``phase="perf"`` row per
+        # display window carries ms_per_step / emb_per_sec / MFU (from
+        # XLA's analytic step FLOPs, obs.perf.costs).  OFF by default —
+        # the rows carry wall-clock values, so the sync-vs-pipelined
+        # byte-parity contract only covers them when both runs opt in.
+        self.perf_metrics = bool(perf_metrics)
+        self._step_flops: Optional[float] = None
+        self._perf_last: Optional[Tuple[float, int]] = None
+        self._last_batch_size: Optional[int] = None
+        self._dev_kind: Optional[str] = None
         # The loss top's `loss_weight` (reference: cu:435 scales the
         # whole backward by top[0]'s weight; Caffe's objective is the
         # weighted loss).  The shipped template uses 1.
@@ -435,18 +447,26 @@ class Solver:
             # The lr reported and the lr applied both read the optimizer's
             # own step counter — a single source of truth.
             metrics["lr"] = self.rate_fn(state["opt"].step)
-            upd, opt = self.tx.update(grads, state["opt"], state["params"])
+            # named_scope: the optimizer shows up as its own region in
+            # the prof report (obs.perf) instead of bloating (unscoped);
+            # metadata-only, the compiled program is unchanged.
+            with jax.named_scope("optim/update"):
+                upd, opt = self.tx.update(
+                    grads, state["opt"], state["params"])
             if self.health is not None:
                 # Optimizer-side health signals (obs.health): whole-tree
                 # fp32 reductions folded into the same jitted graph.
-                metrics.update(
-                    update_health(grads, state["params"], upd, self.health)
+                with jax.named_scope("health"):
+                    metrics.update(
+                        update_health(grads, state["params"], upd,
+                                      self.health)
+                    )
+            with jax.named_scope("optim/apply"):
+                params = jax.tree_util.tree_map(
+                    lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+                    state["params"],
+                    upd,
                 )
-            params = jax.tree_util.tree_map(
-                lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
-                state["params"],
-                upd,
-            )
             new_state = {
                 "params": params,
                 "batch_stats": new_bs,
@@ -640,6 +660,60 @@ class Solver:
                 "rest of the run): %s", e,
             )
 
+    def _want_perf(self) -> bool:
+        tel = self.telemetry
+        return (self.perf_metrics and tel is not None
+                and tel.metrics_enabled and not self._telemetry_failed)
+
+    def _capture_step_flops(self, fn, args) -> None:
+        """XLA's analytic per-step FLOPs of the program about to
+        dispatch (client-side lowering, no extra compile) — feeds the
+        continuous ``perf`` rows' MFU.  Best-effort: a backend without
+        cost analysis just means MFU-less rows."""
+        from npairloss_tpu.obs.perf.costs import cost_flops
+
+        try:
+            # Spanned: the client-side lowering costs a full re-trace
+            # (once per signature) and must show in the host timeline
+            # as obs overhead, not as unattributed wall time.
+            with self._span("step/cost_analysis"):
+                self._step_flops = cost_flops(fn.lower(*args))
+        except Exception as e:  # noqa: BLE001 — perf rows are optional
+            log.debug("step flops estimate unavailable: %s", e)
+
+    def _device_kind(self) -> str:
+        if self._dev_kind is None:
+            self._dev_kind = jax.devices()[0].device_kind
+        return self._dev_kind
+
+    def _emit_perf_row(self, step_num: int) -> None:
+        """One ``phase="perf"`` row per display window: wall clock
+        between boundary emissions over the steps they cover (honest in
+        BOTH loops — the pipelined window's deferred emission still
+        spans the window's dispatched steps)."""
+        now = time.perf_counter()
+        prev = self._perf_last
+        self._perf_last = (now, step_num)
+        if prev is None:
+            return
+        t0, s0 = prev
+        steps_n = step_num - s0
+        if steps_n <= 0 or now <= t0:
+            return
+        sec = (now - t0) / steps_n
+        row: Dict[str, Any] = {"ms_per_step": round(sec * 1e3, 3)}
+        if self._last_batch_size:
+            row["emb_per_sec"] = round(self._last_batch_size / sec, 1)
+        from npairloss_tpu.obs.perf.costs import mfu_from_timing
+
+        est = mfu_from_timing(flops=self._step_flops, seconds=sec,
+                              steps=1, device_kind=self._device_kind())
+        if est["mfu"] is not None:
+            row["mfu"] = round(est["mfu"], 4)
+        if self._step_flops is not None:
+            row["step_flops"] = self._step_flops
+        self._tel_log("perf", step_num, row)
+
     def _tel_event(self, kind: str, step: int, **extra) -> None:
         """Resilience events (``retry``/``rollback``/``preempt``/
         ``resume_skip``) through the telemetry pipeline: one metrics row
@@ -687,6 +761,9 @@ class Solver:
         if self.telemetry is not None and compiling \
                 and len(self._seen_step_shapes) > 1:
             self.telemetry.instant("step/recompile", batch=int(np.shape(x)[0]))
+        self._last_batch_size = int(np.shape(x)[0])
+        if compiling and self._want_perf():
+            self._capture_step_flops(self._step_fn, (self.state, x, lab))
         with self._span(
             "step/compile" if compiling else "step/dispatch",
             batch=int(np.shape(x)[0]),
@@ -853,6 +930,12 @@ class Solver:
                 and not self._telemetry_failed:
             self._tel_log("train", step_num,
                           {k: float(v) for k, v in row.items()})
+        if self._want_perf() and cfg.display \
+                and step_num % cfg.display == 0:
+            # Continuous perf/mfu rows at display cadence (a pending-
+            # window flush can never contain a display step, so the
+            # log_fn=None path never reaches here).
+            self._emit_perf_row(step_num)
         if log_fn is not None and cfg.display \
                 and step_num % cfg.display == 0:
             host = {k: float(v) for k, v in row.items()}
@@ -1017,6 +1100,10 @@ class Solver:
                             and len(self._seen_step_shapes) > 1:
                         tel.instant("step/recompile",
                                     batch=int(np.shape(x)[0]))
+                    self._last_batch_size = int(np.shape(x)[0])
+                    if compiling and self._want_perf():
+                        self._capture_step_flops(
+                            self._pipe_step_fn, (self.state, ring, x, lab))
                     cache_size = getattr(self._pipe_step_fn,
                                          "_cache_size", lambda: None)
                     n_before = cache_size()

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import collections
 import contextlib
-import logging
 import os
 import time
 from typing import Callable, Dict, Optional
@@ -175,45 +174,17 @@ def time_scan(body, init_carry, *, steps: int = 10, floor: float = 0.0,
     return best * 1e3 / steps
 
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring (public
-# specs); used only for MFU estimates.
-PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
-
-def peak_flops(device_kind: str):
-    """Peak dense bf16 FLOP/s for a device kind, or None if unknown."""
-    kind = device_kind.lower()
-    for key, peak in PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return None
-
-
-def cost_flops(stage):
-    """XLA's analytic FLOPs for a lowered or compiled program, or None.
-
-    Accepts a ``jax.stages.Lowered`` (client-side, no device compile —
-    what the CLI ``time`` command uses so the tunnel isn't asked to
-    compile a second program) or a ``Compiled`` (bench.py's children).
-    """
-    try:
-        cost = stage.cost_analysis()
-        if isinstance(cost, list):  # older jax returns [dict]
-            cost = cost[0]
-        f = float(cost.get("flops", 0.0))
-        return f if f > 0 else None
-    except Exception as e:
-        logging.getLogger("npairloss_tpu.profiling").debug(
-            "cost_analysis failed: %s", e)
-        return None
+# Peak-FLOP table and cost analysis moved to their one home,
+# obs.perf.costs (the perf observatory, docs/OBSERVABILITY.md); these
+# re-exports keep the historical import path working.  The MFU
+# computation itself is obs.perf.costs.mfu_from_timing — call that, do
+# not re-derive flops/dt/peak by hand.
+from npairloss_tpu.obs.perf.costs import (  # noqa: E402,F401  (re-export)
+    PEAK_FLOPS,
+    cost_flops,
+    mfu_from_timing,
+    peak_flops,
+)
 
 
 class StepTimer:

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Bench regression gate (docs/OBSERVABILITY.md §Perf observatory).
+
+Walks the bench trajectory — ``bench_cache/bench_history.jsonl`` rows
+plus the committed round artifacts (``BENCH_r*.json`` tails and
+``bench_cache/last_good.json``) — and FAILS (exit != 0) when the newest
+measured record regresses against the best earlier evidence, so an
+emb/s or p99 regression dies in CI instead of being discovered a bench
+round later.
+
+Noise-aware thresholds, two-window-min semantics (bench round 5): every
+measured row publishes ``min(ms_per_step_windows)`` and keeps both
+windows; tunnel jitter is one-sided, so the spread between a row's own
+windows IS its noise floor.  A row only counts as regressed when it
+falls below the reference by MORE than ``max(--tol, spread_new,
+spread_ref)`` — a jittery measurement widens its own gate instead of
+crying wolf.
+
+What is gated, per comparable record pair:
+  * the headline ``value`` (emb/s, higher is better) — fresh
+    measurements only (``headline_reused``/``degraded``/``stale``
+    records carry evidence, they are not measurements);
+  * every extras row with ``emb_per_sec`` (engine + batch-scaling
+    rows), matched by name/path;
+  * every extras row with ``p99_ms`` (serving rows; LOWER is better).
+Rows present only on one side are coverage changes, not regressions.
+
+Modes:
+  * default: gate the JSONL history (``--history PATH``), newest row
+    vs the best of the earlier ones;
+  * ``--offline``: committed artifacts only (BENCH_r*.json +
+    last_good.json) — no TPU, no history file needed; this is the
+    ci.sh wiring.
+
+Stdlib-only and jax-free by design (CI gates must never hang on a
+backend import) — same contract as bench.py's parent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HISTORY = os.path.join(REPO, "bench_cache", "bench_history.jsonl")
+LAST_GOOD = os.path.join(REPO, "bench_cache", "last_good.json")
+DEFAULT_TOL = 0.05
+
+
+def _log(msg: str) -> None:
+    print(f"[bench_check] {msg}", file=sys.stderr, flush=True)
+
+
+# -- record harvesting --------------------------------------------------------
+
+def _is_measurement(rec: Dict[str, Any]) -> bool:
+    """A record whose headline was measured THIS run (not reused/stale
+    degraded-mode evidence) and looks like the flagship geometry."""
+    return (
+        isinstance(rec, dict)
+        and isinstance(rec.get("value"), (int, float))
+        and rec.get("value", 0) > 0
+        and not rec.get("degraded")
+        and not rec.get("stale")
+        and not rec.get("headline_reused")
+        and rec.get("mode", "full") == "full"
+    )
+
+
+def _json_candidates(text: str) -> List[Dict[str, Any]]:
+    """Parse every JSON object found on its own line of ``text`` —
+    committed BENCH_r*.json tails hold the child's stdout, where the
+    record is the last JSON line (possibly truncated away)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+def load_offline_records() -> List[Tuple[str, Dict[str, Any]]]:
+    """(source, record) pairs in round order from the committed
+    artifacts; last_good.json (the newest full payload the bench
+    committed) is appended last when it is not already represented."""
+    records: List[Tuple[str, Dict[str, Any]]] = []
+    rounds = sorted(
+        glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)),
+    )
+    for path in rounds:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError) as e:
+            _log(f"{name}: unreadable ({e}); skipped")
+            continue
+        cands = []
+        if isinstance(art.get("parsed"), dict):
+            cands.append(art["parsed"])
+        cands.extend(_json_candidates(str(art.get("tail", ""))))
+        measured = [c for c in cands if _is_measurement(c)]
+        if measured:
+            records.append((name, measured[-1]))
+        else:
+            _log(f"{name}: no fresh measurement (rc={art.get('rc')}); "
+                 "skipped")
+    try:
+        with open(LAST_GOOD) as f:
+            lg = json.load(f)
+        payload = lg.get("payload") or {}
+        if _is_measurement(payload):
+            if not records or records[-1][1].get("value") != \
+                    payload.get("value"):
+                records.append((f"last_good ({lg.get('date')})", payload))
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError) as e:
+        _log(f"last_good.json unreadable ({e}); skipped")
+    return records
+
+
+def load_history_records(path: str) -> List[Tuple[str, Dict[str, Any]]]:
+    records = []
+    try:
+        with open(path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    _log(f"{path}:{i + 1}: bad JSON line skipped")
+                    continue
+                if _is_measurement(rec):
+                    records.append((f"history[{i}]", rec))
+    except FileNotFoundError:
+        pass
+    return records
+
+
+# -- the gate -----------------------------------------------------------------
+
+def _spread(rec: Dict[str, Any]) -> float:
+    """Relative window spread = the record's own measured noise floor
+    (two-window-min semantics: the min is published, the spread is the
+    jitter evidence)."""
+    w = rec.get("ms_per_step_windows")
+    if isinstance(w, list) and len(w) >= 2:
+        ws = [float(x) for x in w if isinstance(x, (int, float)) and x > 0]
+        if len(ws) >= 2 and min(ws) > 0:
+            return (max(ws) - min(ws)) / min(ws)
+    return 0.0
+
+
+def _walk_rows(rec: Dict[str, Any], prefix: str = "") -> Dict[str, Dict]:
+    """Flatten extras into {path: row} for every dict carrying a
+    gateable metric; error/skipped rows are not measurements."""
+    out: Dict[str, Dict] = {}
+    extras = rec.get("extras") if not prefix else rec
+    if not isinstance(extras, dict):
+        return out
+    for name, row in extras.items():
+        if not isinstance(row, dict):
+            continue
+        path = f"{prefix}{name}"
+        if "error" in row or row.get("skipped"):
+            continue
+        if any(isinstance(row.get(k), (int, float))
+               for k in ("emb_per_sec", "p99_ms")):
+            out[path] = row
+        else:
+            out.update(_walk_rows(row, prefix=path + "/"))
+    return out
+
+
+def check(
+    records: List[Tuple[str, Dict[str, Any]]],
+    tol: float = DEFAULT_TOL,
+) -> List[str]:
+    """Newest record vs the best earlier evidence; returns the list of
+    violations (empty = gate passes)."""
+    if len(records) < 2:
+        _log(f"{len(records)} measured record(s) — nothing to gate")
+        return []
+    new_src, new = records[-1]
+    violations: List[str] = []
+
+    # Headline: higher is better; reference = best earlier value, with
+    # its own windows' spread as that reference's noise contribution.
+    best_src, best = max(records[:-1], key=lambda r: r[1]["value"])
+    eff = max(tol, _spread(new), _spread(best))
+    floor = best["value"] * (1.0 - eff)
+    verdict = "OK" if new["value"] >= floor else "REGRESSED"
+    _log(f"headline: {new['value']:.1f} ({new_src}) vs best "
+         f"{best['value']:.1f} ({best_src}), tol {eff:.1%} -> {verdict}")
+    if verdict != "OK":
+        violations.append(
+            f"headline emb/s {new['value']:.1f} < {floor:.1f} "
+            f"(best {best['value']:.1f} from {best_src}, tol {eff:.1%})")
+
+    # Per-row gates against the most recent earlier record carrying the
+    # same row (engine rows are re-measured selectively; the freshest
+    # prior evidence is the comparison that means something).
+    new_rows = _walk_rows(new)
+    for path, row in sorted(new_rows.items()):
+        ref_row, ref_src = None, None
+        for src, rec in reversed(records[:-1]):
+            cand = _walk_rows(rec).get(path)
+            if cand is not None:
+                ref_row, ref_src = cand, src
+                break
+        if ref_row is None:
+            continue
+        eff = max(tol, _spread(row), _spread(ref_row))
+        if isinstance(row.get("emb_per_sec"), (int, float)) and \
+                isinstance(ref_row.get("emb_per_sec"), (int, float)):
+            floor = ref_row["emb_per_sec"] * (1.0 - eff)
+            if row["emb_per_sec"] < floor:
+                violations.append(
+                    f"{path}: emb/s {row['emb_per_sec']:.1f} < "
+                    f"{floor:.1f} (ref {ref_row['emb_per_sec']:.1f} from "
+                    f"{ref_src}, tol {eff:.1%})")
+        if isinstance(row.get("p99_ms"), (int, float)) and \
+                isinstance(ref_row.get("p99_ms"), (int, float)) and \
+                ref_row["p99_ms"] > 0:
+            ceil = ref_row["p99_ms"] * (1.0 + eff)
+            if row["p99_ms"] > ceil:
+                violations.append(
+                    f"{path}: p99 {row['p99_ms']:.2f} ms > {ceil:.2f} ms "
+                    f"(ref {ref_row['p99_ms']:.2f} from {ref_src}, "
+                    f"tol {eff:.1%})")
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="noise-aware bench regression gate")
+    ap.add_argument(
+        "--offline", action="store_true",
+        help="gate the committed BENCH_r*.json + last_good.json only "
+        "(no history file, no TPU) — the ci.sh mode",
+    )
+    ap.add_argument(
+        "--history", default=HISTORY,
+        help="bench trajectory JSONL (default bench_cache/"
+        "bench_history.jsonl); offline records are appended before it "
+        "unless --offline",
+    )
+    ap.add_argument(
+        "--tol", type=float, default=DEFAULT_TOL,
+        help="base relative tolerance before the per-record window "
+        "spread widens it (default 0.05)",
+    )
+    args = ap.parse_args(argv)
+
+    records = load_offline_records()
+    if not args.offline:
+        records.extend(load_history_records(args.history))
+    _log(f"{len(records)} measured record(s): "
+         + ", ".join(src for src, _ in records))
+    violations = check(records, tol=args.tol)
+    if violations:
+        for v in violations:
+            print(f"REGRESSION: {v}")
+        return 1
+    print(f"bench_check OK ({len(records)} records, no regressions)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

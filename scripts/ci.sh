@@ -198,6 +198,34 @@ print(f"serving smoke OK (100 answers, p99 {drain['p99_ms']:.1f} ms, "
       f"0 post-warmup compiles, clean drain)")
 EOF
 
+echo "== perf observatory smoke (docs/OBSERVABILITY.md §Perf) =="
+# A 10-step prof run on the tiny trunk must produce a schema-valid
+# report whose step-time decomposition reconciles to wall time, and
+# the offline bench gate must pass on the committed BENCH_r* trajectory
+# (it fails CI on a regressed one — tests/test_perf.py pins that).
+prof_dir="$smoke_dir/prof"
+JAX_PLATFORMS=cpu python -m npairloss_tpu prof --step train \
+    --model mlp --image 32 --batch 16 --steps 10 --out "$prof_dir" \
+    > "$prof_dir.log" 2>&1 \
+    || { echo "smoke: prof run failed"; cat "$prof_dir.log"; exit 1; }
+python - "$prof_dir/perf_report.json" <<'EOF'
+import json, sys
+from npairloss_tpu.obs.perf import validate_report
+report = json.load(open(sys.argv[1]))
+# validate_report IS the contract (bound enum, region keys, the
+# reconciliation invariant) — the smoke only adds what it can't know:
+# that THIS run produced a non-degenerate report.
+err = validate_report(report)
+assert err is None, f"schema-invalid prof report: {err}"
+assert report["regions"], "prof report has no regions"
+assert "decomposition" in report, "prof report has no decomposition"
+dec = report["decomposition"]
+print(f"prof smoke OK ({len(report['regions'])} regions, wall "
+      f"{dec['wall_ms']:.0f} ms, unattributed {dec['unattributed_ms']:.0f} ms)")
+EOF
+python scripts/bench_check.py --offline \
+    || { echo "smoke: offline bench gate FAILED"; exit 1; }
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

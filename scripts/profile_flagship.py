@@ -508,81 +508,30 @@ def run_inline(args):
     return 0
 
 
+def _load_perf_report_module():
+    """File-path import of the stdlib-only obs/perf/report module — the
+    orchestrator parent is jax-free by design (a hung backend import
+    must never kill the resumable per-variant loop), so it must not
+    import the npairloss_tpu package (same trick as bench.py's parent
+    loading obs.sinks)."""
+    import importlib.util
+
+    path = os.path.join(REPO, "npairloss_tpu", "obs", "perf", "report.py")
+    spec = importlib.util.spec_from_file_location("_npair_perf_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _write_profile_md(payload):
-    """profile/flagship.md: the generated ablation table (PROFILE.md
-    itself is hand-curated — it cites this artifact)."""
-    r = {k: v["ms_per_step"] for k, v in payload["results"].items()
-         if "ms_per_step" in v}
-    full = r.get("full", 0.0)
-
-    def pct(ms):
-        return f"{ms:.1f} ms ({100 * ms / full:.0f}%)" if full else f"{ms:.1f} ms"
-
-    def _table_lines(results):
-        out = ["| variant | ms/step | emb/s |", "|---|---|---|"]
-        for k, v in results.items():
-            if "ms_per_step" in v:
-                out.append(
-                    f"| {k} | {v['ms_per_step']} | {v['emb_per_sec']} |")
-            else:
-                out.append(f"| {k} | ERROR: {v.get('error', '?')} | — |")
-        if len(out) == 2:
-            out.append("| (no measurements yet — re-run pending) | — | — |")
-        return out
-
-    lines = [
-        "# Flagship step profile (differential)",
-        "",
-        f"Device: `{payload['device']}` — GoogLeNet bf16 + mined N-pair "
-        f"loss (def.prototxt config) + analytic VJP + Caffe-SGD, batch "
-        f"{payload['batch']} @ {payload['image']}x{payload['image']}.",
-        "",
-        "`jax.profiler` traces wedge the tunneled backend, so attribution",
-        "is by ablation (scripts/profile_flagship.py): each variant is",
-        f"{payload['steps_per_timing']} perturbed steps inside one jitted",
-        "lax.scan, host-fetch synced, dispatch floor",
-        f"({payload['fetch_floor_ms']} ms) subtracted.",
-        "",
-    ]
-    lines += _table_lines(payload["results"])
-    lines += ["", "## Attribution", ""]
-    if all(k in r for k in ("full", "fwd_only", "fwd_bwd", "npair_only")):
-        lines += [
-            f"- model forward: {pct(r['fwd_only'])}",
-            f"- model backward + update: "
-            f"{pct(max(r['fwd_bwd'] - r['fwd_only'], 0.0))}",
-            f"- N-pair loss machinery (mining + custom VJP): "
-            f"{pct(r['npair_only'])} standalone; in-graph cost "
-            f"{pct(max(r['full'] - r['fwd_bwd'], 0.0))}",
-        ]
-    if "no_lrn" in r and full:
-        lines.append(
-            f"- LRN (both layers): {pct(max(full - r['no_lrn'], 0.0))} — "
-            "VPU-bound across-channel window"
-        )
-    if "fp32" in r and full:
-        lines.append(
-            f"- bf16 vs fp32 activations: fp32 costs "
-            f"{pct(max(r['fp32'] - full, 0.0))} extra"
-        )
-    if "bn" in r and full:
-        lines.append(
-            f"- Inception-BN trunk (BN instead of LRN): {pct(r['bn'])} total"
-        )
-    # Dated superseded measurement sets stay visible (e.g. the rows
-    # captured before the LRN pow->rsqrt rewrite).
-    for run in payload.get("prior_runs", []):
-        lines += [
-            "",
-            f"## Prior measurements ({run.get('date', '?')})",
-            "",
-            run.get("note", ""),
-            "",
-        ]
-        lines += _table_lines(run.get("results", {}))
-    lines.append("")
+    """profile/flagship.md via the shared ablation renderer
+    (obs.perf.report.ablation_markdown — PROFILE.md stays hand-curated
+    and cites the artifact).  The hand-rolled table/attribution writer
+    this script used to carry lives there now, so the ablation view and
+    the `prof` reports evolve together."""
+    md = _load_perf_report_module().ablation_markdown(payload)
     with open(os.path.join(REPO, "profile", "flagship.md"), "w") as f:
-        f.write("\n".join(lines))
+        f.write(md)
 
 
 if __name__ == "__main__":

@@ -311,8 +311,18 @@ def test_loss_weight_scales_objective_and_gradient():
     loss1, d1 = one_step(1.0)
     loss2, d2 = one_step(2.0)
     np.testing.assert_allclose(loss2, 2 * loss1, rtol=1e-5)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(b, 2 * a, rtol=1e-4,
-                                                atol=1e-8),
-        d1, d2,
-    )
+
+    def close(a, b):
+        # The compared quantity is a DIFFERENCE of fp32-rounded params
+        # (after - before): each operand rounds to fp32 at O(1) param
+        # magnitude, so the delta's absolute error is bounded by
+        # ~eps_f32 * |param| ≈ 1.2e-7 per rounding, NOT by the delta's
+        # own (much smaller) magnitude — rtol alone cannot cover
+        # near-cancelling entries, and the old atol=1e-8 sat below one
+        # rounding ulp (observed flake: 1/64 elements off by 6e-8).
+        # Four ulps at unit scale covers both runs' roundings on both
+        # sides of the 2x comparison.
+        atol = 4 * np.finfo(np.float32).eps
+        np.testing.assert_allclose(b, 2 * a, rtol=1e-4, atol=atol)
+
+    jax.tree_util.tree_map(close, d1, d2)
