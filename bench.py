@@ -1,10 +1,16 @@
 """Benchmark: embeddings/sec/chip (+ MFU) for the flagship training step.
 
-Measures the reference's headline workload (BASELINE.md): GoogLeNet
-embedding trunk + L2 normalize + mined N-pair loss (shipped def.prototxt
-mining config) + analytic backward + Caffe-SGD update + in-graph
-Recall@{1,5,10} metrics, batch 120 (60 ids x 2 imgs, def.prototxt:21-27),
-as ONE jitted graph on the current accelerator.
+Measures the flagship workload: the precision-policy flagship trunk
+(``googlenet_mxu`` — s2d stem + fused inception 1x1s — under the "mxu"
+mixed-precision policy: bf16 compute / fp32 params / single-pass bf16
+MXU gemms, models.precision) + L2 normalize + mined N-pair loss (shipped
+def.prototxt mining config, policy-precision gemms) + analytic backward
++ Caffe-SGD update + in-graph Recall@{1,5,10} metrics, batch 120 (60 ids
+x 2 imgs, def.prototxt:21-27), as ONE jitted graph on the current
+accelerator.  The prototxt-parity recipes stay measured alongside: the
+``googlenet_fp32_parity`` batch row (fp32 everything) and the plain-
+trunk ``120`` row (the pre-policy bf16 headline), plus the reported
+``policy_fp32_loss_delta`` (same trunk/params under both recipes).
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
 compares against a documented estimate of the Caffe+MPI original on its
@@ -283,10 +289,6 @@ def child_full(platform: str, steps: int, warmup: int,
     import jax.numpy as jnp
     import numpy as np
 
-    from npairloss_tpu import REFERENCE_CONFIG
-    from npairloss_tpu.models import get_model
-    from npairloss_tpu.train import Solver, SolverConfig
-
     floor = _fetch_floor(jax)
     measure_headline = selected is None or "headline" in selected
     reused = None
@@ -298,16 +300,21 @@ def child_full(platform: str, steps: int, warmup: int,
             measure_headline, reused = True, None
 
     if measure_headline:
-        _log(f"building flagship solver (GoogLeNet bf16, batch {BATCH})")
-        solver = Solver(
-            get_model("googlenet", dtype=jnp.bfloat16),
-            REFERENCE_CONFIG,
-            SolverConfig(
-                base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
-                momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
-            ),
-            input_shape=(IMAGE, IMAGE, 3),
-        )
+        from npairloss_tpu.models import FLAGSHIP_POLICY, FLAGSHIP_TRUNK
+
+        _log(f"building flagship solver ({FLAGSHIP_TRUNK} under the "
+             f"{FLAGSHIP_POLICY!r} precision policy, batch {BATCH})")
+        # The headline IS the precision-policy flagship (ISSUE 7): the
+        # parity-preserving MXU trunk (s2d stem + fused 1x1s) under the
+        # "mxu" policy — bf16 compute / fp32 params / single-pass bf16
+        # MXU gemms through trunk AND loss engines.  The prototxt-parity
+        # fp32 recipe stays measured as the googlenet_fp32_parity batch
+        # row, and the policy-vs-fp32 loss delta is reported below.
+        # Built via the SAME constructor child_warmup("headline") uses,
+        # so the AOT-warmed program IS the measured program by
+        # construction, not by keeping two call sites in lockstep.
+        solver = _solver_for_spec(
+            jnp, FLAGSHIP_TRUNK, {"policy": FLAGSHIP_POLICY}, {})
         from npairloss_tpu.utils.profiling import next_timing_salt
 
         rng = np.random.default_rng(0)
@@ -373,6 +380,13 @@ def child_full(platform: str, steps: int, warmup: int,
         "image": IMAGE,
     }
     if measure_headline:
+        # Which recipe this headline measures — the policy flagship's
+        # identity travels with the number (bench_check gates a policy
+        # headline against the measured googlenet_mxu bar).
+        from npairloss_tpu.models import FLAGSHIP_POLICY, FLAGSHIP_TRUNK
+
+        record["trunk"] = FLAGSHIP_TRUNK
+        record["policy"] = FLAGSHIP_POLICY
         record.update(
             value=round(emb_per_sec, 2),
             vs_baseline=round(emb_per_sec / BASELINE_EMBEDDINGS_PER_SEC, 3),
@@ -408,6 +422,19 @@ def child_full(platform: str, steps: int, warmup: int,
         _write_spill(record, inflight)
 
     flush()
+    if measure_headline and not _quarantined("policy_loss_delta"):
+        # The recorded price of the policy (ISSUE 7 acceptance: loss
+        # delta vs fp32 parity bounded and reported): same trunk, same
+        # trained params, one forward+loss under each recipe.  Device
+        # work -> same inflight/quarantine containment as a row.
+        flush("policy_loss_delta")
+        try:
+            record.update(_policy_loss_delta(jax, jnp, np, solver, x, lab))
+            _log("policy vs fp32_parity loss delta: "
+                 f"{record.get('policy_fp32_loss_delta')}")
+        except Exception as e:
+            _log(f"policy loss delta failed (non-fatal): {e}")
+        flush()
     try:
         _engine_extras(jax, jnp, np, floor, deadline, extras, flush,
                        selected)
@@ -439,6 +466,42 @@ def child_full(platform: str, steps: int, warmup: int,
         del record["extras"]
     print(json.dumps(record))
     return 0
+
+
+def _policy_loss_delta(jax, jnp, np, solver, x, lab):
+    """``|loss(mxu policy) - loss(fp32_parity)|`` on the SAME flagship
+    trunk, SAME (post-measurement) params, SAME batch — the honest
+    apples-to-apples price of the single-pass-bf16 recipe, reported in
+    the headline record (and bounded by tests/test_precision_policy.py
+    at test scale)."""
+    from npairloss_tpu.models import FLAGSHIP_TRUNK, get_model
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    s32 = Solver(
+        get_model(FLAGSHIP_TRUNK, policy="fp32_parity"),
+        solver.loss_cfg,
+        SolverConfig(display=0, snapshot=0),
+        input_shape=solver.input_shape,
+        precision="fp32_parity",
+    )
+    s32.state = solver.state  # fp32 master params: shared verbatim
+
+    def one_loss(s):
+        def f(state, xx, ll):
+            emb, _ = s.apply_model(
+                state["params"], state["batch_stats"], xx, train=True)
+            loss, _ = s.compute_loss(emb, ll)
+            return loss
+
+        return float(np.asarray(jax.jit(f)(s.state, x, lab)))
+
+    l_pol = one_loss(solver)
+    l_32 = one_loss(s32)
+    return {
+        "policy_loss": round(l_pol, 6),
+        "fp32_parity_loss": round(l_32, 6),
+        "policy_fp32_loss_delta": round(abs(l_pol - l_32), 6),
+    }
 
 
 # Engine-extras row names — the vocabulary --rows selects from (plus
@@ -794,24 +857,35 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
 # Ordered by importance: the soft deadline may skip later rows.  The
 # parity-preserving MXU rewrites (s2d stem, fused inception 1x1s, both =
 # "mxu") and the remat row answer PROFILE.md's open attribution questions
-# with driver-captured numbers.  The vit_b16 rows time BASELINE.json
-# config 5's trunk (real ViT-B/16: patch 16, hidden 768, depth 12)
-# through the blockwise (stretch-path) engine; the 256 row probes the
-# largest batch and runs LAST so an OOM cannot cost any other row.  The
-# row_key column is the other half of the --rows vocabulary (with
-# "headline" and ENGINE_ROWS).
+# with driver-captured numbers.  A ``"policy"`` key in model_kw routes
+# the row through the named precision policy (models.precision) — the
+# *_policy rows are the flagship recipe's 240/480/960 scaling curve
+# (the 120 point is the headline itself), googlenet_fp32_parity keeps
+# the prototxt-parity fp32 delta measured, and 120_pallas_stem times
+# the fused-stem Pallas kernels (Mosaic-compiled on TPU).  The vit_b16
+# rows time BASELINE.json config 5's trunk (real ViT-B/16) through the
+# blockwise (stretch-path) engine; the 256 row probes the largest batch
+# and runs LAST so an OOM cannot cost any other row.  The row_key
+# column is the other half of the --rows/--warmup-rows vocabulary
+# (with "headline" and ENGINE_ROWS).
 BATCH_SCALING_SPECS = (
     (120, "googlenet", "120", {}, {}),
     (120, "googlenet_mxu", "120_mxu", {}, {}),
+    (120, "googlenet", "googlenet_fp32_parity",
+     {"policy": "fp32_parity"}, {}),
     (240, "googlenet", "240", {}, {}),
+    (240, "flagship", "240_policy", {"policy": "mxu"}, {}),
     (480, "googlenet", "480", {}, {}),
+    (480, "flagship", "480_policy", {"policy": "mxu"}, {}),
     (128, "vit_b16", "vit_b16_128", {}, {"engine": "blockwise"}),
     (120, "googlenet_s2d", "120_s2d", {}, {}),
     (120, "googlenet_fused", "120_fused", {}, {}),
+    (120, "googlenet_pallas", "120_pallas_stem", {"policy": "mxu"}, {}),
     # Remat row: does relieving activation HBM pressure recover the
     # batch-480 MFU decay?  (~25% extra trunk FLOPs for O(block)
     # activation memory; numerically identical.)
     (480, "googlenet", "480_remat", {"remat": True}, {}),
+    (960, "flagship", "960_policy", {"policy": "mxu"}, {}),
     (256, "vit_b16", "vit_b16_256", {}, {"engine": "blockwise"}),
 )
 
@@ -861,23 +935,41 @@ def _batch_scaling_extras(jax, jnp, np, dev, floor, deadline=None,
     return rows
 
 
-def _batch_scaling_row(jax, jnp, np, dev, floor, rows, batch, model_name,
-                       key, model_kw, solver_kw, deadline=None):
+def _solver_for_spec(jnp, model_name, model_kw, solver_kw):
+    """The ONE solver constructor for a BATCH_SCALING_SPECS row — shared
+    by the measuring path and the AOT warmup child so the program the
+    warmup compiles into the cache IS the program the row dispatches.
+    A ``"policy"`` key in model_kw selects a named precision policy
+    (threaded through trunk AND solver); the legacy rows stay the
+    bf16-dtype construction byte-for-byte."""
     from npairloss_tpu import REFERENCE_CONFIG
     from npairloss_tpu.models import get_model
     from npairloss_tpu.train import Solver, SolverConfig
-    from npairloss_tpu.utils.profiling import next_timing_salt
 
-    solver = Solver(
-        get_model(model_name, dtype=jnp.bfloat16, **model_kw),
+    model_kw = dict(model_kw)
+    policy = model_kw.pop("policy", None)
+    if policy is not None:
+        model = get_model(model_name, policy=policy, **model_kw)
+    else:
+        model = get_model(model_name, dtype=jnp.bfloat16, **model_kw)
+    return Solver(
+        model,
         REFERENCE_CONFIG,
         SolverConfig(
             base_lr=0.001, lr_policy="step", stepsize=10000, gamma=0.5,
             momentum=0.9, weight_decay=2e-5, display=0, snapshot=0,
         ),
         input_shape=(IMAGE, IMAGE, 3),
+        precision=policy,
         **solver_kw,
     )
+
+
+def _batch_scaling_row(jax, jnp, np, dev, floor, rows, batch, model_name,
+                       key, model_kw, solver_kw, deadline=None):
+    from npairloss_tpu.utils.profiling import next_timing_salt
+
+    solver = _solver_for_spec(jnp, model_name, model_kw, solver_kw)
     rng = np.random.default_rng(0)
     # Per-run salt: see the headline comment (value-keyed tunnel memo).
     x = jax.device_put(jnp.asarray(
@@ -909,6 +1001,65 @@ def _batch_scaling_row(jax, jnp, np, dev, floor, rows, batch, model_name,
         **({"mfu": mfu} if mfu is not None else {}),
     }
     _log(f"batch scaling: {key}: {rows[key]}")
+
+
+def warmable_row_names():
+    """Rows --warmup-rows may name: solver train-step programs only
+    (engine rows are loss-only scans with no Solver.warmup path)."""
+    return {"headline"} | {spec[2] for spec in BATCH_SCALING_SPECS}
+
+
+def child_warmup(platform: str, rows_csv: str) -> int:
+    """AOT-populate the committed persistent compile cache for the named
+    rows, OUTSIDE a measuring window (ROADMAP item 1: the batch-480
+    flagship compile ran 25 minutes inside a tunnel window and died
+    UNAVAILABLE — quarantined since round 5).  ``Solver.warmup()``
+    ``.lower().compile()``s each row's EXACT train-step program (the
+    shared ``_solver_for_spec`` constructor guarantees that) with the
+    cache enabled, so the later measuring dispatch pays deserialization
+    instead of a multi-minute XLA compile.  Recipe:
+
+        python bench.py --warmup-rows 480,480_policy,960_policy
+
+    then commit the new bench_cache/xla_cache/ entries; the next bench
+    round measures the (quarantine-cleared) rows instead of compiling
+    them.
+    """
+    jax, dev = _child_setup(platform)
+    import jax.numpy as jnp
+
+    from npairloss_tpu.models import FLAGSHIP_POLICY, FLAGSHIP_TRUNK
+
+    names = {r.strip() for r in rows_csv.split(",") if r.strip()}
+    specs = ((BATCH, FLAGSHIP_TRUNK, "headline",
+              {"policy": FLAGSHIP_POLICY}, {}),) + BATCH_SCALING_SPECS
+    warmed, errors = {}, {}
+    for batch, model_name, key, model_kw, solver_kw in specs:
+        if key not in names:
+            continue
+        _log(f"warmup: AOT-compiling {key} ({model_name} @ batch "
+             f"{batch})...")
+        try:
+            solver = _solver_for_spec(jnp, model_name, model_kw, solver_kw)
+            warmed[key] = round(solver.warmup(batch), 1)
+            _log(f"warmup: {key} compiled in {warmed[key]}s")
+        except Exception as e:  # one row failing must not void the rest
+            errors[key] = str(e)[:300]
+            _log(f"warmup: {key} FAILED: {e}")
+    print(json.dumps({
+        "metric": "aot_warmup_compile_seconds",
+        "mode": "warmup",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "cache_dir": CACHE_DIR,
+        "warmed": warmed,
+        **({"errors": errors} if errors else {}),
+    }))
+    # Always rc 0 once the record is printed: the parent's _run_child
+    # discards child stdout on rc != 0, so a nonzero here would turn a
+    # partial success (480 warmed in 25 min, 960 OOMed) into an opaque
+    # "warmup child failed" — per-row failures travel in "errors".
+    return 0
 
 
 def child_smoke(platform: str) -> int:
@@ -1344,15 +1495,27 @@ def main(argv=None) -> int:
         "the result MERGES into bench_cache/last_good.json instead of "
         "replacing it (re-pass recipe, ADVICE #2)",
     )
+    ap.add_argument(
+        "--warmup-rows", default=None, metavar="NAME,...",
+        help="AOT-compile these rows' train-step programs into the "
+        "committed bench_cache/xla_cache (Solver.warmup) and exit — "
+        "run OUTSIDE a measuring window so large-batch compiles "
+        "(480/960) stop burning tunnel minutes; names from the "
+        "batch_scaling vocabulary plus 'headline'",
+    )
+    ap.add_argument("--warmup-timeout", type=float, default=5400.0,
+                    help="wall budget for the --warmup-rows child (the "
+                    "batch-480 compile alone has run 25 minutes)")
     # child modes (internal)
-    ap.add_argument("--child", choices=["probe", "full", "smoke"])
+    ap.add_argument("--child", choices=["probe", "full", "smoke", "warmup"])
     ap.add_argument("--platform", default="default")
     ap.add_argument("--soft-budget", type=float, default=900.0)
     args = ap.parse_args(argv)
 
-    # Validate --rows BEFORE dispatching: a typo'd row name matches
-    # nothing downstream, so the re-pass would burn a tunnel-window
-    # child measuring zero rows while still stamping merge provenance.
+    # Validate --rows/--warmup-rows BEFORE dispatching: a typo'd row
+    # name matches nothing downstream, so the re-pass would burn a
+    # tunnel-window child measuring zero rows while still stamping
+    # merge provenance (same contract as known_row_names for --rows).
     if args.rows:
         unknown = {r.strip() for r in args.rows.split(",") if r.strip()}
         unknown -= known_row_names()
@@ -1360,6 +1523,16 @@ def main(argv=None) -> int:
             ap.error(
                 f"--rows: unknown row name(s) {sorted(unknown)}; "
                 f"known: {sorted(known_row_names())}"
+            )
+    if args.warmup_rows:
+        unknown = {r.strip() for r in args.warmup_rows.split(",")
+                   if r.strip()}
+        unknown -= warmable_row_names()
+        if unknown:
+            ap.error(
+                f"--warmup-rows: unknown/unwarmable row name(s) "
+                f"{sorted(unknown)}; warmable: "
+                f"{sorted(warmable_row_names())}"
             )
 
     if args.child == "probe":
@@ -1369,6 +1542,8 @@ def main(argv=None) -> int:
                           args.soft_budget, rows=args.rows)
     if args.child == "smoke":
         return child_smoke(args.platform)
+    if args.child == "warmup":
+        return child_warmup(args.platform, args.rows or "")
 
     os.makedirs(CACHE_DIR, exist_ok=True)
 
@@ -1412,6 +1587,33 @@ def main(argv=None) -> int:
             rec["error"] = "no jax backend (TPU or CPU) initialized within timeout"
             return _emit(rec)
     _log(f"probe ok: {probe}")
+
+    if args.warmup_rows:
+        # AOT warmup mode: populate the committed compile cache and
+        # exit — no measurement, no last_good refresh.  Skipped on the
+        # CPU-outage fallback: CPU executables in the committed cache
+        # would be dead weight (entries are platform-keyed).
+        if platform == "cpu":
+            return _emit({
+                "metric": "aot_warmup_compile_seconds",
+                "mode": "warmup",
+                "degraded": True,
+                "platform_status": platform_status,
+                "error": "TPU backend unavailable; refusing to warm the "
+                         "committed cache with CPU executables",
+            })
+        rec = _run_child(
+            ["--child", "warmup", "--platform", platform,
+             "--rows", args.warmup_rows],
+            args.warmup_timeout,
+        )
+        if rec is None:
+            rec = {
+                "metric": "aot_warmup_compile_seconds",
+                "mode": "warmup",
+                "error": "warmup child failed or timed out",
+            }
+        return _emit(rec)
 
     if platform == "cpu":
         # Outage path: run only the cheap CPU smoke as a liveness/parity

@@ -23,6 +23,13 @@ from typing import Optional
 
 log = logging.getLogger("npairloss_tpu.cli")
 
+# The --precision vocabulary, hardcoded rather than imported: argparse
+# construction must stay jax-free (the bench parent contract — a hung
+# backend import in the parser would defeat bench.py's no-jax-in-parent
+# robustness).  Pinned == models.precision.available_policies() by
+# tests/test_precision_policy.py, so drift is a test failure.
+_PRECISION_CHOICES = ("bf16", "fp32_parity", "mxu")
+
 
 def _identity_batch_geometry(d):
     """(identities, images-per-identity) per batch from a MultibatchData
@@ -200,7 +207,15 @@ def _build_solver(args):
         model_kw["remat"] = True  # GoogLeNet trunks; others raise loudly
     if getattr(args, "caffe_pad", False):
         model_kw["caffe_pad"] = True  # GoogLeNet trunks
-    model = get_model(model_name, dtype=dtype, **model_kw)
+    precision = getattr(args, "precision", None)
+    if precision:
+        # Declarative mixed-precision policy (models.precision):
+        # resolves the trunk's dtypes AND the loss engines' gemm
+        # precision (below) from one named recipe; --bf16 is the
+        # legacy spelling of what --precision bf16 now names.
+        model = get_model(model_name, policy=precision, **model_kw)
+    else:
+        model = get_model(model_name, dtype=dtype, **model_kw)
 
     sim_cache = getattr(args, "sim_cache", None)
     pos_topk = getattr(args, "pos_topk", None)
@@ -210,6 +225,7 @@ def _build_solver(args):
         sim_cache={"auto": None, "on": True, "off": False}[sim_cache or "auto"],
         pos_topk=None if pos_topk in (None, "auto") else int(pos_topk),
         matmul_precision=getattr(args, "matmul_precision", None),
+        precision=precision or None,
         param_mults=net_cfg.param_mults,
         loss_weight=(net_cfg.loss.loss_weights[0]
                      if net_cfg.loss and net_cfg.loss.loss_weights
@@ -1229,8 +1245,12 @@ def _prof_train(args, jax, np, dev, tel, steps, obsperf):
 
     batch = int(args.batch)
     side = int(args.image)
-    model = get_model(
-        args.model, dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    policy = getattr(args, "precision", None)
+    if policy:
+        model = get_model(args.model, policy=policy)
+    else:
+        model = get_model(
+            args.model, dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     mesh = None
     if args.mesh and args.mesh > 1:
         from npairloss_tpu.parallel import data_parallel_mesh
@@ -1248,6 +1268,7 @@ def _prof_train(args, jax, np, dev, tel, steps, obsperf):
         # that the report doesn't consume — build_report reads the
         # compiled stage directly.
         mesh=mesh, engine=args.engine, input_shape=input_shape,
+        precision=policy or None,
         telemetry=tel,
     )
     # The shared synthetic generator, not a hand-rolled batch — the
@@ -1290,7 +1311,8 @@ def _prof_train(args, jax, np, dev, tel, steps, obsperf):
         stage=compiled, span_events=events, wall_ms=wall_ms,
         ms_per_step=ms_per_step, steps=steps,
         region_depth=int(args.region_depth),
-        extra={"model": args.model, "engine": solver.engine},
+        extra={"model": args.model, "engine": solver.engine,
+               "policy": policy or None},
     )
 
 
@@ -1409,6 +1431,15 @@ def main(argv: Optional[list] = None) -> int:
         "(default), default = ~6x single-pass bf16 MXU throughput mode",
     )
     t.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
+    t.add_argument(
+        "--precision", choices=_PRECISION_CHOICES, default=None,
+        help="declarative mixed-precision policy (models.precision): "
+        "mxu = the flagship default (bf16 compute over fp32 params, "
+        "single-pass bf16 MXU gemms incl. the loss engines), bf16 = "
+        "the legacy --bf16 recipe as a named policy, fp32_parity = the "
+        "prototxt-parity fp32 fallback; overrides --bf16 and supplies "
+        "--matmul-precision's default",
+    )
     t.add_argument(
         "--remat", action="store_true",
         help="rematerialize inception blocks in the backward (GoogLeNet "
@@ -1578,6 +1609,10 @@ def main(argv: Optional[list] = None) -> int:
             default="auto", help="see train --sim-cache",
         )
         sp.add_argument("--bf16", action="store_true")
+        sp.add_argument(
+            "--precision", choices=_PRECISION_CHOICES, default=None,
+            help="mixed-precision policy (see train --precision)",
+        )
         sp.add_argument(
             "--resume",
             help="snapshot path to restore, or 'auto' for the newest "
@@ -1816,6 +1851,10 @@ def main(argv: Optional[list] = None) -> int:
     )
     tm.add_argument("--bf16", action="store_true", help="bfloat16 trunk")
     tm.add_argument(
+        "--precision", choices=_PRECISION_CHOICES, default=None,
+        help="mixed-precision policy (see train --precision)",
+    )
+    tm.add_argument(
         "--sim-cache", dest="sim_cache", choices=["auto", "on", "off"],
         default="auto", help="see train --sim-cache",
     )
@@ -1869,6 +1908,11 @@ def main(argv: Optional[list] = None) -> int:
                     help="devices in the dp mesh (train; 0 = single)")
     pr.add_argument("--bf16", action="store_true",
                     help="bf16 trunk activations (train)")
+    pr.add_argument("--precision", choices=_PRECISION_CHOICES,
+                    default=None,
+                    help="mixed-precision policy for the profiled trunk "
+                    "(see train --precision); the before/after roofline "
+                    "recipe is fp32_parity vs mxu")
     pr.add_argument("--gallery", type=int, default=2048,
                     help="synthetic gallery rows (serve)")
     pr.add_argument("--dim", type=int, default=64,

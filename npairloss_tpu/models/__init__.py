@@ -4,19 +4,42 @@ The reference trains a GoogLeNet trunk truncated at pool5 with an
 L2-normalized embedding (usage/def.prototxt); BASELINE.json adds ResNet-50
 and ViT-B/16 configs.  ``get_model(name)`` is the registry the config
 front-end and trainer resolve through.
+
+``get_model(name, policy=...)`` threads a declarative mixed-precision
+policy (models.precision: "mxu" / "bf16" / "fp32_parity" or a
+PrecisionPolicy object) through the trunk: policy-aware trunks
+(GoogLeNet family, ViT) resolve per-module dtypes/precision by regex
+over their module paths; the rest honor the policy's compute dtype.
+The FLAGSHIP trunk+policy pair — what bench.py headlines and the CLI
+defaults to for ``--precision mxu`` runs — is ``googlenet_mxu`` under
+the ``"mxu"`` policy (FLAGSHIP_TRUNK / FLAGSHIP_POLICY below).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional, Union
 
 from npairloss_tpu.models.googlenet import (
     GoogLeNetEmbedding,
     fuse_inception_1x1_params,
 )
 from npairloss_tpu.models.mlp import MLPEmbedding
+from npairloss_tpu.models.precision import (
+    DEFAULT_POLICY,
+    PrecisionPolicy,
+    available_policies,
+    get_policy,
+)
 from npairloss_tpu.models.resnet import ResNetEmbedding
 from npairloss_tpu.models.vit import ViTEmbedding
+
+# The flagship workload's trunk + policy: the parity-preserving MXU
+# rewrites (s2d stem + fused inception 1x1s — measured 21.91 ms vs the
+# prototxt trunk's 27.85 ms, BENCH_r05) under the single-pass-bf16
+# mixed-precision policy.  One home, so bench.py, the CLI, and the
+# tests agree on what "flagship" means.
+FLAGSHIP_TRUNK = "googlenet_mxu"
+FLAGSHIP_POLICY = DEFAULT_POLICY
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {
     "googlenet": GoogLeNetEmbedding,
@@ -40,6 +63,17 @@ _REGISTRY: Dict[str, Callable[..., Any]] = {
     "googlenet_mxu": lambda **kw: GoogLeNetEmbedding(
         stem_s2d=True, fuse_1x1=True, **kw
     ),
+    # Pallas stem fusion on top of the MXU rewrites: fused LRN +
+    # conv-bias-ReLU(+pool) epilogues (ops.pallas_stem; interpret-mode
+    # parity-tested).  Parameter tree identical to googlenet_mxu.
+    "googlenet_pallas": lambda **kw: GoogLeNetEmbedding(
+        stem_s2d=True, fuse_1x1=True, pallas_stem=True, **kw
+    ),
+    # The headline trunk by its workload name: resolved THROUGH
+    # FLAGSHIP_TRUNK at call time, so repointing the flagship repoints
+    # --model flagship with it (a copy-pasted constructor here would
+    # silently drift).
+    "flagship": lambda **kw: _REGISTRY[FLAGSHIP_TRUNK](**kw),
     "resnet50": lambda **kw: ResNetEmbedding(stage_sizes=(3, 4, 6, 3), **kw),
     "resnet50_s2d": lambda **kw: ResNetEmbedding(
         stage_sizes=(3, 4, 6, 3), stem_s2d=True, **kw
@@ -50,11 +84,36 @@ _REGISTRY: Dict[str, Callable[..., Any]] = {
 }
 
 
-def get_model(name: str, **kwargs):
+# Registry names whose trunks thread the policy object all the way to
+# per-module resolution; the rest (mlp, resnet) honor its compute dtype
+# only.  Kept explicit so a silently-dropped policy is impossible — a
+# new policy-aware trunk must be listed here to receive the object.
+_POLICY_AWARE = {
+    "googlenet", "googlenet_embedding", "googlenet_bn", "inception_bn",
+    "googlenet_s2d", "googlenet_bn_s2d", "googlenet_fused",
+    "googlenet_mxu", "googlenet_pallas", "flagship", "vit_b16",
+}
+
+
+def get_model(name: str,
+              policy: Optional[Union[str, PrecisionPolicy]] = None,
+              **kwargs):
     key = name.lower()
     if key not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    if policy is not None:
+        pol = get_policy(policy)
+        kwargs.setdefault("dtype", pol.compute_dtype)
+        if key in _POLICY_AWARE:
+            kwargs["policy"] = pol
     return _REGISTRY[key](**kwargs)
+
+
+def flagship_model(policy: Optional[Union[str, PrecisionPolicy]] =
+                   FLAGSHIP_POLICY, **kwargs):
+    """The headline trunk under the default (or given) policy — the ONE
+    constructor bench.py, the CLI flagship paths, and the tests share."""
+    return get_model(FLAGSHIP_TRUNK, policy=policy, **kwargs)
 
 
 def jit_init(model, key, example_input, train: bool = False, **kwargs):

@@ -9,9 +9,10 @@ conv+relu fused by XLA), not a translation of the prototxt layer list.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from npairloss_tpu.models.layers import (
@@ -21,6 +22,7 @@ from npairloss_tpu.models.layers import (
     max_pool,
     space_to_depth,
 )
+from npairloss_tpu.models.precision import PrecisionPolicy
 from npairloss_tpu.ops.normalize import l2_normalize
 
 # Inception block channel plans: (1x1, 3x3red, 3x3, 5x5red, 5x5, pool_proj).
@@ -41,6 +43,9 @@ class Inception(nn.Module):
     plan: Tuple[int, int, int, int, int, int]
     dtype: Any = jnp.float32
     use_bn: bool = False
+    # Mixed-precision policy, threaded into every ConvBlock (each block
+    # regex-resolves its own path against the policy's rules).
+    policy: Optional[PrecisionPolicy] = None
     # Merge the three 1x1 convs that read the block input (b1x1,
     # b3x3_reduce, b5x5_reduce) into ONE conv with p1+p3r+p5r output
     # channels, then slice.  Same dot products, same per-channel
@@ -54,7 +59,8 @@ class Inception(nn.Module):
     def __call__(self, x, train: bool = False):
         p1, p3r, p3, p5r, p5, pp = self.plan
         conv = lambda f, k, name: ConvBlock(
-            f, k, dtype=self.dtype, use_bn=self.use_bn, name=name
+            f, k, dtype=self.dtype, use_bn=self.use_bn,
+            policy=self.policy, name=name,
         )
         if self.fuse_1x1:
             fused = conv(p1 + p3r + p5r, (1, 1), "fused_1x1")(x, train)
@@ -112,36 +118,67 @@ class GoogLeNetEmbedding(nn.Module):
     # same function, better tiling.  Weights
     # convert losslessly both ways via `conv1_kernel_to_s2d`.
     stem_s2d: bool = False
+    # Declarative mixed-precision policy (models.precision): resolves
+    # every ConvBlock's param/compute dtypes + MXU matmul precision by
+    # regex over the module path, and the trunk's entry/exit casts from
+    # its compute/output dtypes.  None keeps the pre-policy ``dtype``
+    # behavior (HLO-identical).
+    policy: Optional[PrecisionPolicy] = None
+    # Pallas stem fusion (ops.pallas_stem): route the VPU-bound stem
+    # tail — both LRN layers plus the conv1/conv2 bias+ReLU(+pool)
+    # epilogues — through the fused one-VMEM-pass kernels.  Bias-LRN
+    # trunks only (the BN trunk has neither LRN nor conv biases);
+    # parameter tree unchanged, interpret-mode parity-tested on CPU.
+    pallas_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         use_lrn = self.use_lrn and not self.use_bn
-        x = x.astype(self.dtype)
+        fuse_stem = self.pallas_stem and not self.use_bn
+        compute_dtype = (self.policy.compute_dtype
+                         if self.policy is not None else self.dtype)
+        lrn_impl = "pallas" if fuse_stem else "xla"
+        x = x.astype(compute_dtype)
         if self.stem_s2d:
             x = space_to_depth(x, 2)
             x = ConvBlock(
                 64, (4, 4), (1, 1), padding=((1, 2), (1, 2)),
-                dtype=self.dtype, use_bn=self.use_bn, name="conv1",
+                dtype=self.dtype, use_bn=self.use_bn, policy=self.policy,
+                fused_epilogue=fuse_stem,
+                fuse_pool=(3, 2) if fuse_stem else None,
+                name="conv1",
             )(x, train)
         else:
             x = ConvBlock(
                 64, (7, 7), (2, 2),
                 padding=((3, 3), (3, 3)) if self.caffe_pad else "SAME",
-                dtype=self.dtype, use_bn=self.use_bn,
+                dtype=self.dtype, use_bn=self.use_bn, policy=self.policy,
+                fused_epilogue=fuse_stem,
+                fuse_pool=(3, 2) if fuse_stem else None,
                 name="conv1",
             )(x, train)
-        x = max_pool(x, 3, 2)
+        if not fuse_stem:
+            x = max_pool(x, 3, 2)
         if use_lrn:
-            x = local_response_norm(x)
+            # named_scope: LRN is trunk-top-level code (not a flax
+            # submodule), so without a scope its cost would land in the
+            # root region of the prof report (obs.perf) instead of
+            # being attributable — metadata only, the program is
+            # unchanged.
+            with jax.named_scope("lrn"):
+                x = local_response_norm(x, impl=lrn_impl)
         x = ConvBlock(
             64, (1, 1), dtype=self.dtype, use_bn=self.use_bn,
+            policy=self.policy, fused_epilogue=fuse_stem,
             name="conv2_reduce",
         )(x, train)
         x = ConvBlock(
-            192, (3, 3), dtype=self.dtype, use_bn=self.use_bn, name="conv2"
+            192, (3, 3), dtype=self.dtype, use_bn=self.use_bn,
+            policy=self.policy, fused_epilogue=fuse_stem, name="conv2"
         )(x, train)
         if use_lrn:
-            x = local_response_norm(x)
+            with jax.named_scope("lrn"):
+                x = local_response_norm(x, impl=lrn_impl)
         x = max_pool(x, 3, 2)
         # nn.remat checkpoints the block boundary: only each block's
         # input survives to the backward, its internals recompute.
@@ -153,6 +190,7 @@ class GoogLeNetEmbedding(nn.Module):
         )
         incep = lambda key: incep_cls(
             _INCEPTION_PLAN[key], self.dtype, self.use_bn,
+            policy=self.policy,
             fuse_1x1=self.fuse_1x1, name=f"inception_{key}",
         )
         x = incep("3a")(x, train)
@@ -164,7 +202,8 @@ class GoogLeNetEmbedding(nn.Module):
         x = incep("5a")(x, train)
         x = incep("5b")(x, train)
         x = global_avg_pool(x)  # pool5/7x7_s1 -> (N, 1024)
-        x = x.astype(jnp.float32)
+        x = x.astype(self.policy.output_dtype
+                     if self.policy is not None else jnp.float32)
         if self.normalize:
             x = l2_normalize(x)
         return x

@@ -9,6 +9,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from npairloss_tpu.models.precision import (
+    ModulePrecision,
+    PrecisionPolicy,
+    module_precision,
+)
+
 
 def local_response_norm(
     x: jax.Array,
@@ -16,12 +22,24 @@ def local_response_norm(
     alpha: float = 1e-4,
     beta: float = 0.75,
     k: float = 1.0,
+    impl: str = "xla",
+    cache: Optional[bool] = None,
 ) -> jax.Array:
     """Across-channel LRN (the classic GoogLeNet/AlexNet normalization).
 
     x: NHWC.  Matches Caffe LRN semantics: denominator
     (k + alpha/size * sum_{window} x^2)^beta over a channel window.
+
+    ``impl="pallas"`` routes through the fused one-VMEM-pass kernel
+    (ops.pallas_stem.fused_lrn — parity-tested against this reference);
+    ``cache`` is its denominator-cache knob (None = auto by size).
     """
+    if impl == "pallas":
+        from npairloss_tpu.ops.pallas_stem import fused_lrn
+
+        return fused_lrn(x, size, alpha, beta, k, cache=cache)
+    if impl != "xla":
+        raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
     xf = x.astype(jnp.float32)
     sq = xf * xf
     win = jax.lax.reduce_window(
@@ -48,6 +66,44 @@ def local_response_norm(
     return out.astype(x.dtype)
 
 
+class _EpilogueConv(nn.Module):
+    """``nn.Conv``-compatible parameter tree (``kernel`` + ``bias``)
+    that returns the PRE-BIAS conv output and the bias separately, so a
+    Pallas epilogue (ops.pallas_stem) can fuse bias + ReLU (+ pool) in
+    one VMEM pass.  Named ``Conv_0`` by the caller, checkpoints
+    interchange with the plain ``nn.Conv`` path byte-for-byte."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int]
+    padding: Any
+    mp: ModulePrecision
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel
+        kernel = self.param(
+            "kernel", nn.initializers.xavier_uniform(),
+            (kh, kw, x.shape[-1], self.features), self.mp.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.constant(0.2),
+            (self.features,), self.mp.param_dtype,
+        )
+        pad = self.padding
+        if not isinstance(pad, str):
+            pad = tuple(tuple(p) for p in pad)
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.mp.compute_dtype),
+            kernel.astype(self.mp.compute_dtype),
+            window_strides=self.strides,
+            padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            precision=self.mp.precision,
+        )
+        return y, bias
+
+
 class ConvBlock(nn.Module):
     """Conv + bias + ReLU, Caffe-style 'xavier' init (def.prototxt:98-110).
 
@@ -55,6 +111,15 @@ class ConvBlock(nn.Module):
     Inception-BN recipe.  A BN-free Inception-v1 from random init
     collapses (all embeddings align; the original needed aux classifiers
     + ImageNet schedules), so the BN variant is what trains from scratch.
+
+    ``policy`` (models.precision.PrecisionPolicy) resolves this module's
+    param/compute dtypes and MXU matmul precision by regex over its own
+    flax path; with no policy the block is HLO-identical to the
+    pre-policy constructors (``dtype`` compute over fp32 params, no
+    explicit precision).  ``fused_epilogue`` routes bias+ReLU through
+    the one-VMEM-pass Pallas kernel (ops.pallas_stem), and ``fuse_pool``
+    =(window, stride) additionally folds the following SAME max-pool
+    into the same pass (the caller must then skip its own pool).
     """
 
     features: int
@@ -63,22 +128,42 @@ class ConvBlock(nn.Module):
     padding: Any = "SAME"
     dtype: Any = jnp.float32
     use_bn: bool = False
+    policy: Optional[PrecisionPolicy] = None
+    fused_epilogue: bool = False
+    fuse_pool: Optional[Tuple[int, int]] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        mp = module_precision(self.policy, self.path, self.dtype)
+        if self.fused_epilogue and not self.use_bn:
+            from npairloss_tpu.ops.pallas_stem import (
+                fused_bias_relu,
+                fused_bias_relu_pool,
+            )
+
+            y, bias = _EpilogueConv(
+                self.features, self.kernel, self.strides, self.padding,
+                mp, name="Conv_0",
+            )(x)
+            if self.fuse_pool is not None:
+                return fused_bias_relu_pool(y, bias, *self.fuse_pool)
+            return fused_bias_relu(y, bias)
         x = nn.Conv(
             self.features,
             self.kernel,
             strides=self.strides,
             padding=self.padding,
-            dtype=self.dtype,
+            dtype=mp.compute_dtype,
+            param_dtype=mp.param_dtype,
+            precision=mp.precision,
             use_bias=not self.use_bn,
             kernel_init=nn.initializers.xavier_uniform(),
             bias_init=nn.initializers.constant(0.2),
         )(x)
         if self.use_bn:
             x = nn.BatchNorm(
-                use_running_average=not train, momentum=0.9, dtype=self.dtype
+                use_running_average=not train, momentum=0.9,
+                dtype=mp.compute_dtype,
             )(x)
         return nn.relu(x)
 

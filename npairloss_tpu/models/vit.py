@@ -3,46 +3,65 @@ N-pair contrastive — the CLIP-style negative pool over ICI).
 
 Fresh Flax implementation: patchify-as-conv (MXU-friendly), pre-LN
 transformer blocks, bf16 activations / fp32 layernorm, CLS-token embedding,
-optionally L2-normalized.
+optionally L2-normalized.  The mixed-precision policy (models.precision)
+threads through every Dense/attention/patchify gemm — each module
+regex-resolves its own path — while the LayerNorms stay fp32 regardless
+(their statistics are fp32 by construction below).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
 
+from npairloss_tpu.models.precision import PrecisionPolicy, module_precision
 from npairloss_tpu.ops.normalize import l2_normalize
 
 
 class MlpBlock(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
+    policy: Optional[PrecisionPolicy] = None
 
     @nn.compact
     def __call__(self, x):
+        mp = module_precision(self.policy, self.path, self.dtype)
         d = x.shape[-1]
-        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        dense = lambda f: nn.Dense(
+            f, dtype=mp.compute_dtype, param_dtype=mp.param_dtype,
+            precision=mp.precision,
+        )
+        x = dense(self.mlp_dim)(x)
         x = nn.gelu(x)
-        return nn.Dense(d, dtype=self.dtype)(x)
+        return dense(d)(x)
 
 
 class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dtype: Any = jnp.bfloat16
+    policy: Optional[PrecisionPolicy] = None
 
     @nn.compact
     def __call__(self, x):
+        # Resolve at the NAMED submodule's path ("blockN/attn"), not
+        # this block's, so per-module rules targeting the attention
+        # actually match (nn.MultiHeadDotProductAttention cannot
+        # resolve itself — it predates the policy).
+        mp = module_precision(self.policy, (*self.path, "attn"),
+                              self.dtype)
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
-        y = ln("ln1")(x).astype(self.dtype)
+        y = ln("ln1")(x).astype(mp.compute_dtype)
         y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, dtype=self.dtype, name="attn"
+            num_heads=self.num_heads, dtype=mp.compute_dtype,
+            param_dtype=mp.param_dtype, precision=mp.precision, name="attn",
         )(y, y)
         x = x + y
-        y = ln("ln2")(x).astype(self.dtype)
-        return x + MlpBlock(self.mlp_dim, self.dtype, name="mlp")(y)
+        y = ln("ln2")(x).astype(mp.compute_dtype)
+        return x + MlpBlock(self.mlp_dim, self.dtype, policy=self.policy,
+                            name="mlp")(y)
 
 
 class ViTEmbedding(nn.Module):
@@ -55,36 +74,49 @@ class ViTEmbedding(nn.Module):
     mlp_dim: int = 3072
     dtype: Any = jnp.bfloat16
     normalize: bool = True
+    policy: Optional[PrecisionPolicy] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        # Resolved at the patchify conv's own path (see EncoderBlock).
+        mp = module_precision(self.policy, (*self.path, "patchify"),
+                              self.dtype)
         n = x.shape[0]
         x = nn.Conv(
             self.hidden,
             (self.patch, self.patch),
             strides=(self.patch, self.patch),
             padding="VALID",
-            dtype=self.dtype,
+            dtype=mp.compute_dtype,
+            param_dtype=mp.param_dtype,
+            precision=mp.precision,
             name="patchify",
-        )(x.astype(self.dtype))
+        )(x.astype(mp.compute_dtype))
         x = x.reshape(n, -1, self.hidden)
         cls = self.param(
             "cls", nn.initializers.zeros, (1, 1, self.hidden), jnp.float32
         )
-        x = jnp.concatenate([jnp.broadcast_to(cls, (n, 1, self.hidden)).astype(self.dtype), x], axis=1)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (n, 1, self.hidden)).astype(
+                mp.compute_dtype), x],
+            axis=1,
+        )
         pos = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
             (1, x.shape[1], self.hidden),
             jnp.float32,
         )
-        x = x + pos.astype(self.dtype)
+        x = x + pos.astype(mp.compute_dtype)
         for i in range(self.depth):
             x = EncoderBlock(
-                self.num_heads, self.mlp_dim, self.dtype, name=f"block{i}"
+                self.num_heads, self.mlp_dim, self.dtype,
+                policy=self.policy, name=f"block{i}"
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
-        emb = x[:, 0].astype(jnp.float32)
+        out_dtype = (self.policy.output_dtype
+                     if self.policy is not None else jnp.float32)
+        emb = x[:, 0].astype(out_dtype)
         if self.normalize:
             emb = l2_normalize(emb)
         return emb

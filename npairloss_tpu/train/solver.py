@@ -139,6 +139,7 @@ class Solver:
         sim_cache: Optional[bool] = None,
         pos_topk: Optional[int] = None,
         matmul_precision: Optional[str] = None,
+        precision: Optional[Any] = None,
         param_mults: Optional[tuple] = None,
         loss_weight: float = 1.0,
         health: Optional[HealthConfig] = None,
@@ -227,6 +228,21 @@ class Solver:
         # Streaming engines' sparse-positive buffer size (None = auto 8;
         # 0 forces radix selection) — see ``pos_topk`` there.
         self.pos_topk = pos_topk
+        # Declarative mixed-precision policy (models.precision): a name
+        # ("mxu"/"bf16"/"fp32_parity") or PrecisionPolicy.  The MODEL's
+        # dtypes are the model's own business (get_model(policy=...));
+        # here the policy supplies the loss engines' gemm precision when
+        # ``matmul_precision`` isn't set explicitly, and is recorded so
+        # telemetry/bench stamp which recipe a run trained under.
+        if precision is not None:
+            from npairloss_tpu.models.precision import get_policy
+
+            self.precision_policy = get_policy(precision)
+            if matmul_precision is None:
+                matmul_precision = \
+                    self.precision_policy.loss_matmul_precision
+        else:
+            self.precision_policy = None
         # Sim/backward gemm MXU precision: None/"highest" = oracle
         # bit-parity; "default" = the ~6x single-pass bf16 throughput
         # mode (ops.npair_loss.resolve_matmul_precision).

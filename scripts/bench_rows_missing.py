@@ -72,6 +72,14 @@ def main() -> None:
     # failed.  "480 merely unmeasured" must not seed — that would
     # re-add entries an operator deliberately cleared for a retry, and
     # would fire in fresh environments where 480 never wedged.
+    # Deliberate clears are recorded as NULL tombstones in
+    # quarantine.json ("480": null): bench.py's _quarantined treats a
+    # null entry as not-quarantined, while the `key not in quarantine`
+    # guard below sees the key and refuses to re-seed — the round-6
+    # un-quarantine (AOT warmup recipe: bench.py --warmup-rows) stays
+    # cleared even though last_good still carries the old error
+    # evidence.  A REAL re-wedge overwrites the tombstone via
+    # bench.py's _quarantine_add.
     row_480 = rows.get("480")
     evidence_480 = isinstance(row_480, dict) and "error" in row_480
     changed = False
@@ -124,10 +132,13 @@ def main() -> None:
               "a re-pass that could dispatch known tunnel-wedgers; "
               "fix or delete the file first", file=sys.stderr)
         return
+    # Truthy-entry test, NOT key presence: a null deliberate-clear
+    # tombstone means "dispatchable" to bench.py's _quarantined, so it
+    # must mean the same to the --rows list this emits.
     missing = [
         k for k in WANT
         if not _measured(rows.get(k))
-        and (strict or k not in quarantine)
+        and (strict or not quarantine.get(k))
     ]
     if print_rows:
         print(",".join(missing))

@@ -226,6 +226,61 @@ EOF
 python scripts/bench_check.py --offline \
     || { echo "smoke: offline bench gate FAILED"; exit 1; }
 
+echo "== pallas stem interpret smoke (ops/pallas_stem.py) =="
+# The fused stem kernels must hold interpret-mode parity against the
+# XLA references — forward and backward — on every box that runs CI
+# (the full ragged-tile matrix lives in tests/test_pallas_stem.py).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from npairloss_tpu.models.layers import local_response_norm
+from npairloss_tpu.ops import pallas_stem as ps
+x = jnp.asarray(np.random.default_rng(0).standard_normal(
+    (2, 6, 6, 24)).astype(np.float32))
+b = jnp.asarray(np.random.default_rng(1).standard_normal(
+    (24,)).astype(np.float32))
+np.testing.assert_allclose(np.asarray(ps.fused_lrn(x)),
+                           np.asarray(local_response_norm(x)), atol=1e-6)
+g1 = jax.grad(lambda v: ps.fused_lrn(v).sum())(x)
+g2 = jax.grad(lambda v: local_response_norm(v).sum())(x)
+np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+np.testing.assert_allclose(np.asarray(ps.fused_bias_relu(x, b)),
+                           np.asarray(jnp.maximum(x + b, 0)), atol=1e-6)
+np.testing.assert_allclose(
+    np.asarray(ps.fused_bias_relu_pool(x, b)),
+    np.asarray(ps._reference_bias_relu_pool(x, b, 3, 2)), atol=1e-6)
+print("pallas stem interpret smoke OK (lrn fwd+bwd, bias_relu, pool)")
+EOF
+
+echo "== precision-policy prof guard (models/precision.py) =="
+# The default (mxu) flagship's compute must live in the conv/inception
+# gemms, not the LRN tail: prof the default-policy flagship and assert
+# the top trunk region by flops share is a conv/inception region, the
+# lrn region exists (the named_scope attribution is wired), and lrn
+# stays under 1% of step flops.  Catches a policy regression that
+# silently reverts the trunk to an elementwise-dominated step.
+pol_dir="$smoke_dir/prof_policy"
+JAX_PLATFORMS=cpu python -m npairloss_tpu prof --step train \
+    --model flagship --precision mxu --batch 4 --image 32 --steps 2 \
+    --region-depth 2 --out "$pol_dir" > "$pol_dir.log" 2>&1 \
+    || { echo "smoke: policy prof run failed"; cat "$pol_dir.log"; exit 1; }
+python - "$pol_dir/perf_report.json" <<'EOF'
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert report.get("policy") == "mxu", report.get("policy")
+trunk = [r for r in report["regions"]
+         if r["region"].startswith("GoogLeNetEmbedding/")]
+assert trunk, "no trunk regions attributed"
+lrn = [r for r in trunk if r["region"].endswith("/lrn")]
+assert lrn, "lrn region missing — named_scope attribution broken"
+top = max(trunk, key=lambda r: r["pct_flops"])
+assert not top["region"].endswith("/lrn"), \
+    f"trunk's top region is the LRN tail: {top}"
+assert lrn[0]["pct_flops"] < 1.0, f"lrn flops share grew: {lrn[0]}"
+print(f"policy prof guard OK (top trunk region {top['region']} "
+      f"{top['pct_flops']:.1f}% flops; lrn {lrn[0]['pct_flops']:.2f}%, "
+      f"bound {lrn[0]['bound']})")
+EOF
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

@@ -275,13 +275,40 @@ def test_example_configs_parse():
     assert net_path.endswith("googlenet_cub.prototxt")
 
 
+@needs_ref
 def test_net_param_mults_from_reference_template():
     """The reference net trains conv biases at 2x lr with no decay
     (param blocks, usage/def.prototxt:90-97); the schema must surface
-    that recipe so the solver reproduces the trajectory."""
+    that recipe so the solver reproduces the trajectory.  Needs the
+    mounted reference tree like every other verbatim-usage test here
+    (this one hard-coded the path and was the seed's standing red on
+    boxes without /root/reference)."""
     from npairloss_tpu.config import load_net
 
-    net = load_net("/root/reference/usage/def.prototxt")
+    net = load_net(os.path.join(REF_USAGE, "def.prototxt"))
+    assert net.param_mults == ((1.0, 1.0), (2.0, 0.0))
+
+
+def test_param_mults_template_recipe_from_text():
+    """The same recipe, reference-mount-free: the def.prototxt param
+    blocks verbatim (w: lr 1/decay 1, b: lr 2/decay 0) must resolve to
+    the net-wide multiplier tuple the solver trains under — keeps the
+    template contract covered even where /root/reference is absent."""
+    from npairloss_tpu.config import net_from_text
+
+    net = net_from_text(
+        'name: "GoogleNet"\n'
+        'layer {\n'
+        '  name: "conv1/7x7_s2" type: "Convolution"\n'
+        '  param { lr_mult: 1 decay_mult: 1 }\n'
+        '  param { lr_mult: 2 decay_mult: 0 }\n'
+        '}\n'
+        'layer {\n'
+        '  name: "conv2/3x3" type: "Convolution"\n'
+        '  param { lr_mult: 1 decay_mult: 1 }\n'
+        '  param { lr_mult: 2 decay_mult: 0 }\n'
+        '}\n'
+    )
     assert net.param_mults == ((1.0, 1.0), (2.0, 0.0))
 
 

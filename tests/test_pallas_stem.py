@@ -1,0 +1,215 @@
+"""Interpret-mode parity tests for the fused stem kernels
+(ops.pallas_stem vs the XLA references) — forward AND backward, ragged
+tile shapes included, plus the ConvBlock/GoogLeNet wiring contracts
+(parameter-tree interchange with the plain path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from npairloss_tpu.models.layers import ConvBlock, local_response_norm
+from npairloss_tpu.ops import pallas_stem as ps
+
+# Shapes chosen to hit: full lane tiles (64->128 pad), multi-lane-tile
+# channels with a ragged edge (130), sub-tile channels (24), ragged row
+# counts (odd H*W products), and a row count above one block (>256).
+LRN_SHAPES = [
+    (2, 7, 7, 24),
+    (1, 5, 3, 64),
+    (2, 3, 9, 130),
+    (3, 10, 10, 8),  # 300 rows > one 256-row block
+]
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape).astype(dtype))
+
+
+@pytest.mark.parametrize("shape", LRN_SHAPES)
+def test_fused_lrn_forward_parity(shape):
+    x = _rand(shape)
+    ref = local_response_norm(x)
+    for cache in (True, False):
+        out = ps.fused_lrn(x, cache=cache)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6, rtol=1e-6)
+
+
+@pytest.mark.parametrize("shape", LRN_SHAPES)
+def test_fused_lrn_backward_parity_and_cache_bitparity(shape):
+    x = _rand(shape, seed=1)
+    w = jnp.cos(jnp.arange(x.size, dtype=jnp.float32).reshape(x.shape))
+    g_ref = jax.grad(lambda v: (local_response_norm(v) * w).sum())(x)
+    g_c = jax.grad(lambda v: (ps.fused_lrn(v, cache=True) * w).sum())(x)
+    g_n = jax.grad(lambda v: (ps.fused_lrn(v, cache=False) * w).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_ref),
+                               atol=1e-5, rtol=1e-4)
+    # Cached and recompute backward are BIT-identical (the cache stores
+    # exactly the fp32 d the forward produced — the sim-cache contract).
+    np.testing.assert_array_equal(np.asarray(g_c), np.asarray(g_n))
+
+
+def test_fused_lrn_generic_beta_and_params():
+    """The non-0.75-beta path (exp/log pow) and non-default size/k."""
+    x = _rand((2, 4, 4, 24), seed=2)
+    ref = local_response_norm(x, size=3, alpha=2e-3, beta=0.5, k=2.0)
+    out = ps.fused_lrn(x, size=3, alpha=2e-3, beta=0.5, k=2.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_fused_lrn_bf16_dtype_roundtrip():
+    x = _rand((2, 4, 4, 32)).astype(jnp.bfloat16)
+    out = ps.fused_lrn(x)
+    assert out.dtype == jnp.bfloat16
+    ref = local_response_norm(x)  # fp32 internals, bf16 out — same shape
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref, np.float32),
+        atol=2e-2)
+
+
+def test_lrn_cache_auto_threshold():
+    assert ps.resolve_lrn_cache_auto(ps.LRN_CACHE_AUTO_BYTES, None)
+    assert not ps.resolve_lrn_cache_auto(ps.LRN_CACHE_AUTO_BYTES + 1, None)
+    assert ps.resolve_lrn_cache_auto(1 << 40, True)  # explicit wins
+    assert not ps.resolve_lrn_cache_auto(1, False)
+
+
+def test_fused_bias_relu_parity():
+    x = _rand((2, 5, 5, 24), seed=3)
+    b = _rand((24,), seed=4)
+    ref = jnp.maximum(x + b, 0)
+    np.testing.assert_allclose(np.asarray(ps.fused_bias_relu(x, b)),
+                               np.asarray(ref), atol=1e-6)
+    got = jax.grad(
+        lambda xx, bb: (ps.fused_bias_relu(xx, bb) ** 2).sum(),
+        argnums=(0, 1))(x, b)
+    want = jax.grad(
+        lambda xx, bb: (jnp.maximum(xx + bb, 0) ** 2).sum(),
+        argnums=(0, 1))(x, b)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]),
+                               atol=1e-4, rtol=1e-5)
+
+
+@pytest.mark.parametrize("hw", [(8, 8), (7, 9), (5, 5)])
+def test_fused_bias_relu_pool_parity(hw):
+    """SAME 3x3/s2 pool epilogue vs bias+relu+reduce_window, fwd + bwd,
+    even and odd (ragged-pad) spatial sizes."""
+    x = _rand((2, *hw, 24), seed=5)
+    b = _rand((24,), seed=6)
+    ref = ps._reference_bias_relu_pool(x, b, 3, 2)
+    np.testing.assert_allclose(np.asarray(ps.fused_bias_relu_pool(x, b)),
+                               np.asarray(ref), atol=1e-6)
+    got = jax.grad(
+        lambda xx: (ps.fused_bias_relu_pool(xx, b) ** 2).sum())(x)
+    want = jax.grad(
+        lambda xx: (ps._reference_bias_relu_pool(xx, b, 3, 2)
+                    .astype(jnp.float32) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Model wiring: the fused path must interchange with the plain one
+# ---------------------------------------------------------------------------
+
+
+def test_convblock_fused_epilogue_param_tree_and_output():
+    """fused_epilogue keeps the EXACT nn.Conv parameter tree
+    (Conv_0/{kernel,bias}) and computes the same function; fuse_pool
+    folds the SAME max-pool the caller would otherwise apply."""
+    import jax.tree_util as jtu
+
+    from npairloss_tpu.models.layers import max_pool
+
+    x = _rand((2, 12, 12, 3), seed=7)
+    key = jax.random.PRNGKey(0)
+    plain = ConvBlock(16, (3, 3), (2, 2))
+    fused = ConvBlock(16, (3, 3), (2, 2), fused_epilogue=True)
+    pooled = ConvBlock(16, (3, 3), (2, 2), fused_epilogue=True,
+                       fuse_pool=(3, 2))
+    v = plain.init(key, x)
+    paths = lambda t: [jtu.keystr(k) for k, _ in
+                       jtu.tree_flatten_with_path(t)[0]]
+    assert paths(fused.init(key, x)) == paths(v)
+    o_plain = plain.apply(v, x)
+    o_fused = fused.apply(v, x)
+    np.testing.assert_allclose(np.asarray(o_fused), np.asarray(o_plain),
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(pooled.apply(v, x)),
+        np.asarray(max_pool(o_plain, 3, 2)), atol=1e-5)
+
+
+def test_fused_epilogue_nonfp32_bias_cotangent_dtype():
+    """custom_vjp requires db.dtype == bias.dtype: a policy rule may
+    store a fused-stem conv's params in bf16, and the epilogue VJPs
+    must return the bias cotangent in that dtype (a hardcoded fp32 db
+    raised at trace time on the first training step)."""
+    from npairloss_tpu.models.precision import PrecisionPolicy
+
+    pol = PrecisionPolicy(
+        name="bf16params", compute_dtype=jnp.bfloat16,
+        rules=((r".*", {"param_dtype": jnp.bfloat16}),),
+    )
+    x = _rand((2, 8, 8, 3), seed=11)
+    for fuse_pool in (None, (3, 2)):
+        blk = ConvBlock(8, (3, 3), policy=pol, fused_epilogue=True,
+                        fuse_pool=fuse_pool)
+        v = blk.init(jax.random.PRNGKey(0), x)
+        assert v["params"]["Conv_0"]["bias"].dtype == jnp.bfloat16
+        g = jax.grad(
+            lambda vv: blk.apply(vv, x).astype(jnp.float32).sum())(v)
+        assert g["params"]["Conv_0"]["bias"].dtype == jnp.bfloat16
+
+
+def test_convblock_fused_epilogue_ignored_under_bn():
+    """BN trunks have neither conv bias nor an epilogue to fuse — the
+    flag must be a no-op there, not an error."""
+    x = _rand((2, 8, 8, 3), seed=8)
+    bn = ConvBlock(8, (3, 3), use_bn=True, fused_epilogue=True)
+    v = bn.init(jax.random.PRNGKey(0), x)
+    ref = ConvBlock(8, (3, 3), use_bn=True)
+    np.testing.assert_array_equal(
+        np.asarray(bn.apply(v, x)), np.asarray(ref.apply(v, x)))
+
+
+def test_local_response_norm_impl_routing():
+    x = _rand((2, 4, 4, 16), seed=9)
+    np.testing.assert_allclose(
+        np.asarray(local_response_norm(x, impl="pallas")),
+        np.asarray(local_response_norm(x)), atol=1e-6)
+    with pytest.raises(ValueError, match="impl"):
+        local_response_norm(x, impl="cuda")
+
+
+@pytest.mark.slow
+def test_googlenet_pallas_registry_interchange():
+    """googlenet_pallas == googlenet_mxu trunk + pallas_stem: identical
+    parameter tree, near-identical function on shared params (the
+    fused-kernel wiring pin at trunk level).  Slow-marked: two
+    GoogLeNet jits (~13s); the ConvBlock-level interchange test above
+    plus the ci.sh pallas smoke keep the wiring covered in tier-1
+    time."""
+    import jax.tree_util as jtu
+
+    from npairloss_tpu.models import get_model, jit_init
+
+    x = _rand((2, 32, 32, 3), seed=10)
+    key = jax.random.PRNGKey(0)
+    m_mxu = get_model("googlenet_mxu", policy="mxu")
+    m_pal = get_model("googlenet_pallas", policy="mxu")
+    assert m_pal.pallas_stem and m_pal.stem_s2d and m_pal.fuse_1x1
+    v = jit_init(m_mxu, key, x)
+    paths = lambda t: [jtu.keystr(k) for k, _ in
+                       jtu.tree_flatten_with_path(t)[0]]
+    assert paths(jax.eval_shape(
+        lambda: m_pal.init(key, x))) == paths(v)
+    o_mxu = jax.jit(lambda v_, x_: m_mxu.apply(v_, x_))(v, x)
+    o_pal = jax.jit(lambda v_, x_: m_pal.apply(v_, x_))(v, x)
+    assert float(jnp.abs(o_pal - o_mxu).max()) < 2e-2
