@@ -379,8 +379,19 @@ def cmd_train(args) -> int:
 
             import jax
 
-            # Rank-gate like --log-json: one telemetry writer per run.
-            if jax.process_index() == 0:
+            from npairloss_tpu.obs.fleet import fleet_stamp
+
+            # Fleet stamping (docs/OBSERVABILITY.md §Fleet): automatic
+            # for multi-process runs (EVERY rank writes its own
+            # telemetry.r<k>.jsonl — the old rank-0 gate threw away
+            # exactly the streams straggler analysis needs), forceable
+            # with --fleet on a single-host mesh.  Off (the byte-
+            # identical legacy layout, rank 0 only) otherwise.
+            stamp = fleet_stamp()
+            fleet_on = bool(getattr(args, "fleet", False)) or (
+                stamp is not None and stamp.process_count > 1
+            )
+            if fleet_on or jax.process_index() == 0:
                 from npairloss_tpu.obs import RunTelemetry
 
                 # --telemetry-dir = the full run directory (manifest +
@@ -388,9 +399,12 @@ def cmd_train(args) -> int:
                 # tracing only (trace.json, no metric rows).  argparse
                 # makes them mutually exclusive.
                 telemetry = RunTelemetry(
-                    tel_dir or trace_dir, metrics=bool(tel_dir)
+                    tel_dir or trace_dir, metrics=bool(tel_dir),
+                    fleet=fleet_on,
                 )
                 if tel_dir:
+                    from npairloss_tpu.parallel import mesh_topology
+
                     telemetry.write_manifest(
                         config={
                             "solver": dataclasses.asdict(solver.cfg),
@@ -403,8 +417,7 @@ def cmd_train(args) -> int:
                                 bool(getattr(args, "health_metrics", False)),
                         },
                         mesh=(
-                            {"devices": solver.mesh.size,
-                             "axis": solver.axis}
+                            mesh_topology(solver.mesh, solver.axis)
                             if solver.mesh is not None else None
                         ),
                     )
@@ -1201,7 +1214,16 @@ def cmd_prof(args) -> int:
     (``jax.profiler`` wedges tunneled backends); everything comes from
     compiled-HLO metadata and the host span streams, so it runs
     anywhere — including CPU, where the roofline falls back to the v4
-    reference spec (flagged in the report)."""
+    reference spec (flagged in the report).
+
+    ``--fleet RUNDIR`` is the OFFLINE mode (docs/OBSERVABILITY.md
+    §Fleet observatory): aggregate a fleet run directory's per-rank
+    telemetry streams into the ``npairloss-fleet-report-v1``
+    straggler/skew/comms report plus one merged Perfetto timeline —
+    no backend is touched."""
+    if getattr(args, "fleet", None):
+        return _prof_fleet(args)
+
     import jax
     import numpy as np
 
@@ -1209,7 +1231,7 @@ def cmd_prof(args) -> int:
     from npairloss_tpu.obs import perf as obsperf
 
     steps = max(int(args.steps), 1)
-    out_dir = args.out
+    out_dir = args.out if args.out is not None else "perf_reports"
     dev = jax.devices()[0]
     tel = RunTelemetry(os.path.join(out_dir, "run"), metrics=True,
                        trace=True)
@@ -1228,6 +1250,60 @@ def cmd_prof(args) -> int:
     print(obsperf.render_table(report))
     print(json.dumps({"report": paths["json"], "table": paths["txt"],
                       "telemetry": tel.run_dir}))
+    return 0
+
+
+def _prof_fleet(args) -> int:
+    """``prof --fleet RUNDIR``: offline fleet aggregation (stdlib-only
+    — never touches a backend; the streams on disk are the input).
+    Writes ``fleet_report.json``/``.txt`` and the merged
+    ``fleet_trace.json`` to --out (default: the run dir itself), prints
+    the table, and fails on a schema-invalid report — the validator is
+    the contract, exactly like the perf report path."""
+    from npairloss_tpu.obs.fleet import (
+        build_fleet_report,
+        merge_run_traces,
+        render_fleet_table,
+        validate_fleet_report,
+        write_fleet_report,
+    )
+    from npairloss_tpu.obs.tracing import validate_chrome_trace
+
+    run_dir = os.path.abspath(args.fleet)
+    if not os.path.isdir(run_dir):
+        log.error("prof --fleet: %s is not a directory", run_dir)
+        return 2
+    # --out default is None (a sentinel, not the literal "perf_reports"
+    # string) so an EXPLICIT --out perf_reports is honored here too.
+    out_dir = args.out if args.out is not None else run_dir
+    os.makedirs(out_dir, exist_ok=True)
+    report = build_fleet_report(run_dir)
+    trace_path, merged = merge_run_traces(
+        run_dir, os.path.join(out_dir, "fleet_trace.json")
+        if os.path.abspath(out_dir) != run_dir else None)
+    if trace_path is not None:
+        terr = validate_chrome_trace(merged)
+        if terr is not None:
+            # The report itself is independent evidence — land it
+            # before failing, same as the schema-failure branch below.
+            write_fleet_report(report, out_dir)
+            log.error("merged fleet trace failed validation: %s", terr)
+            return 1
+        report.setdefault("notes", []).append(
+            f"merged timeline: {trace_path} "
+            f"({len(merged['traceEvents'])} events, "
+            f"{len(merged['otherData']['merged_ranks'])} rank lane(s))")
+    err = validate_fleet_report(report)
+    if err is not None:
+        # The report (with its failure) still lands on disk — a bad
+        # fleet state must be diagnosable from artifacts too.
+        write_fleet_report(report, out_dir)
+        log.error("fleet report failed its own schema check: %s", err)
+        return 1
+    paths = write_fleet_report(report, out_dir)
+    print(render_fleet_table(report))
+    print(json.dumps({"report": paths["json"], "table": paths["txt"],
+                      "trace": trace_path}))
     return 0
 
 
@@ -1574,6 +1650,13 @@ def main(argv: Optional[list] = None) -> int:
         "--telemetry-dir, whose run dir already includes the trace",
     )
     t.add_argument(
+        "--fleet", action="store_true",
+        help="force rank-stamped fleet telemetry (telemetry.r<k>.jsonl "
+        "per rank, comm accounting, step-numbered spans) even on a "
+        "single process; multi-process runs stamp automatically — "
+        "docs/OBSERVABILITY.md §Fleet observatory",
+    )
+    t.add_argument(
         "--health-metrics", dest="health_metrics", action="store_true",
         help="fold in-graph training-health signals into every step's "
         "metrics (grad/param/update norms, update/param ratio, embedding "
@@ -1894,6 +1977,14 @@ def main(argv: Optional[list] = None) -> int:
         "--step", choices=["train", "serve"], default="train",
         help="which jitted program to profile",
     )
+    pr.add_argument(
+        "--fleet", metavar="RUNDIR",
+        help="offline fleet aggregation: read a fleet run directory's "
+        "per-rank telemetry (telemetry.r<k>.jsonl + trace.r<k>.json), "
+        "emit the npairloss-fleet-report-v1 straggler/skew/comms "
+        "report and a merged Perfetto timeline (ignores the live-"
+        "profiling flags; no backend touched)",
+    )
     pr.add_argument("--model", default="googlenet",
                     help="model registry name (train)")
     pr.add_argument("--batch", type=int, default=8,
@@ -1923,9 +2014,9 @@ def main(argv: Optional[list] = None) -> int:
     pr.add_argument("--region-depth", dest="region_depth", type=int,
                     default=2,
                     help="named-scope path depth to aggregate regions at")
-    pr.add_argument("--out", default="perf_reports",
-                    help="report output directory (perf_report.json/.txt "
-                    "+ run telemetry)")
+    pr.add_argument("--out", default=None,
+                    help="report output directory (default: perf_reports "
+                    "for live profiles, the run dir itself for --fleet)")
     pr.set_defaults(fn=cmd_prof)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")

@@ -10,6 +10,10 @@ Three coordinated parts:
   * ``obs.health`` — optional jit-compatible training-health signals
     (grad/param/update norms, embedding magnitude, mined-pair hardness)
     gated by ``HealthConfig``;
+  * ``obs.fleet`` — the multi-rank layer: rank-stamped telemetry with
+    per-rank file streams, collective/comms attribution, offline
+    straggler/skew aggregation (``prof --fleet``), and merged
+    cross-rank Perfetto timelines;
 
 tied together per run by ``obs.run.RunTelemetry`` (run dir with
 ``manifest.json`` + ``metrics.jsonl`` + ``trace.json``).
@@ -19,10 +23,12 @@ processes (bench.py's parent) load them by file path to avoid this
 package's jax-importing ``__init__``.
 """
 
+from npairloss_tpu.obs.fleet.stamp import FleetStamp, fleet_stamp
 from npairloss_tpu.obs.health import HealthConfig
 from npairloss_tpu.obs.manifest import RunManifest
 from npairloss_tpu.obs.run import RunTelemetry
 from npairloss_tpu.obs.sinks import (
+    FLEET_KEYS,
     REQUIRED_KEYS,
     CsvSink,
     JsonlSink,
@@ -36,6 +42,8 @@ __all__ = [
     "HealthConfig",
     "RunManifest",
     "RunTelemetry",
+    "FleetStamp",
+    "fleet_stamp",
     "MetricLogger",
     "JsonlSink",
     "CsvSink",
@@ -44,4 +52,5 @@ __all__ = [
     "SpanTracer",
     "validate_chrome_trace",
     "REQUIRED_KEYS",
+    "FLEET_KEYS",
 ]

@@ -1,0 +1,80 @@
+"""Fleet observatory (docs/OBSERVABILITY.md §Fleet observatory).
+
+The multi-rank observability layer — the instrumentation side of the
+pod-scale roadmap item, landed ahead of the mesh refactor it will
+debug.  Four coordinated parts:
+
+  * ``fleet.stamp`` — rank identity (``FleetStamp``) stamped on every
+    metric row / trace / manifest, plus the rank-aware path scheme
+    (``telemetry.r<k>.jsonl``) that keeps concurrent ranks from
+    interleaving a stream;
+  * ``fleet.comms`` — collective attribution: the ``comm/<kind>``
+    scope claims joined with the HLO-priced collective bytes
+    (``obs.perf.hlo``) into per-kind effective-bandwidth rows checked
+    against the roofline interconnect specs (ICI vs DCN);
+  * ``fleet.aggregate`` — offline straggler/skew analysis over all
+    ranks' streams, emitting the versioned
+    ``npairloss-fleet-report-v1`` artifact
+    (``validate_fleet_report`` IS the contract);
+  * ``fleet.merge_traces`` — per-rank Chrome traces folded into one
+    Perfetto file with rank-numbered process lanes and a clock-offset
+    estimate.
+
+All modules are stdlib-only at import time (the obs rule): ``prof
+--fleet`` and jax-free harness processes use them without touching a
+backend.  Entry point: ``python -m npairloss_tpu prof --fleet RUNDIR``.
+"""
+
+from npairloss_tpu.obs.fleet.aggregate import (
+    FLEET_REPORT_SCHEMA,
+    build_fleet_report,
+    load_rank_streams,
+    render_fleet_table,
+    validate_fleet_report,
+    write_fleet_report,
+)
+from npairloss_tpu.obs.fleet.comms import (
+    KIND_OF_OPCODE,
+    comm_rows_from_hlo,
+    effective_bandwidth,
+    grad_sync_claim_bytes,
+)
+from npairloss_tpu.obs.fleet.merge_traces import (
+    MERGED_TRACE_FILENAME,
+    merge_chrome_traces,
+    merge_run_traces,
+)
+from npairloss_tpu.obs.fleet.stamp import (
+    FLEET_PROCESS_ENV,
+    STAMP_KEYS,
+    FleetStamp,
+    discover_ranks,
+    fleet_stamp,
+    rank_metrics_name,
+    rank_trace_name,
+    resolve_fleet,
+)
+
+__all__ = [
+    "FLEET_REPORT_SCHEMA",
+    "build_fleet_report",
+    "load_rank_streams",
+    "render_fleet_table",
+    "validate_fleet_report",
+    "write_fleet_report",
+    "KIND_OF_OPCODE",
+    "comm_rows_from_hlo",
+    "effective_bandwidth",
+    "grad_sync_claim_bytes",
+    "MERGED_TRACE_FILENAME",
+    "merge_chrome_traces",
+    "merge_run_traces",
+    "FLEET_PROCESS_ENV",
+    "STAMP_KEYS",
+    "FleetStamp",
+    "discover_ranks",
+    "fleet_stamp",
+    "rank_metrics_name",
+    "rank_trace_name",
+    "resolve_fleet",
+]

@@ -1,0 +1,163 @@
+"""Collective/comms attribution — what does the interconnect carry per step?
+
+The source paper's step is two collectives (MPI_Allgather of embeddings,
+MPI_Allreduce of gradients — PAPER.md §0), and at pod scale the TPU-v4
+paper's lesson is that those wires, not per-chip FLOPs, set throughput.
+This module joins three honest sources into per-step comms rows:
+
+  * **scope claims** — the loss-engine exchange paths are wrapped in
+    ``jax.named_scope("comm/<kind>")`` (dense all_gather and the grad
+    allreduce in ``ops/npair_loss.py``, the ring's ppermute hops in
+    ``parallel/ring.py``), so the compiled HLO's collective
+    instructions carry the marker in their ``op_name`` metadata;
+  * **HLO pricing** — ``obs.perf.hlo.collective_bytes_by_opcode``
+    prices EVERY collective in the compiled step (output-shape bytes ×
+    trip count), including the implicit all-reduces XLA's SPMD
+    partitioner inserts for replicated-parameter gradients, which no
+    source-level scope can mark;
+  * **measured step time** — the per-rank step cadence from the fleet
+    telemetry streams, giving each kind an *effective bandwidth
+    demand* ``bytes_per_step / step_time``: the rate the link must
+    sustain if the collective were perfectly overlapped.  The host
+    cannot time an in-graph collective (that would require the device
+    trace this observatory exists to avoid), so no per-collective
+    latency is fabricated — the demand figure is checked against the
+    roofline interconnect peak (ICI within a host, DCN across hosts)
+    and a demand above peak means the step is interconnect-bound.
+
+Reconciliation contract: every HLO-priced collective byte must belong
+to a *claimed kind* — a kind some ``comm/<kind>`` scope (or the
+solver's grad-sync claim for SPMD-inserted all-reduces) vouches for.
+``unattributed_bytes`` sums the kinds nobody claims; the ci gate holds
+it at zero, so adding a new exchange path without instrumenting it
+fails CI instead of silently vanishing from the fleet report.  Within
+a claimed kind, ``scope_coverage`` reports the fraction of its bytes
+that sit inside an explicit ``comm/`` scope — honesty about how much
+is marker-attributed vs. merely claimed.
+
+Stdlib-only (dicts in, dicts out) — loadable from jax-free processes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+# HLO collective opcode -> the comm kind the fleet report speaks in.
+KIND_OF_OPCODE = {
+    "all-gather": "all_gather",
+    "all-gather-start": "all_gather",
+    "collective-permute": "ppermute",
+    "collective-permute-start": "ppermute",
+    "all-reduce": "allreduce",
+    "all-reduce-start": "allreduce",
+    "reduce-scatter": "reduce_scatter",
+    "all-to-all": "all_to_all",
+    "collective-broadcast": "broadcast",
+}
+
+# The scope marker the exchange paths carry (``jax.named_scope``).
+COMM_SCOPE_MARKER = "comm/"
+
+
+def _scoped_bytes(regions: Dict[str, float]) -> float:
+    """Bytes of one opcode's instructions whose full scope path carries
+    the ``comm/`` marker."""
+    return float(sum(
+        b for region, b in regions.items() if COMM_SCOPE_MARKER in region
+    ))
+
+
+def comm_rows_from_hlo(
+    per_opcode: Dict[str, Dict[str, Any]],
+    extra_claims: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Fold ``collective_bytes_by_opcode`` output into per-KIND rows
+    plus the reconciliation verdict.
+
+    ``extra_claims``: kind -> analytic bytes claimed by instrumentation
+    that cannot mark scopes (the solver's grad-sync claim: XLA inserts
+    the replicated-parameter all-reduce itself, so the claim is the
+    param-tree byte size, priced host-side).  A kind counts as claimed
+    when it has scope-marked bytes OR an extra claim.
+    """
+    extra_claims = dict(extra_claims or {})
+    kinds: Dict[str, Dict[str, Any]] = {}
+    for opcode, row in per_opcode.items():
+        kind = KIND_OF_OPCODE.get(opcode, opcode)
+        k = kinds.setdefault(kind, {
+            "kind": kind, "bytes_per_step": 0.0, "count_per_step": 0.0,
+            "scope_bytes": 0.0, "opcodes": [],
+        })
+        k["bytes_per_step"] += float(row.get("bytes", 0.0))
+        k["count_per_step"] += float(row.get("count", 0.0))
+        k["scope_bytes"] += _scoped_bytes(row.get("regions", {}))
+        k["opcodes"].append(opcode)
+    unattributed = 0.0
+    for kind, k in sorted(kinds.items()):
+        claimed_extra = float(extra_claims.get(kind, 0.0))
+        k["claimed"] = bool(k["scope_bytes"] > 0.0 or claimed_extra > 0.0)
+        k["claim_bytes"] = k["scope_bytes"] + claimed_extra
+        k["scope_coverage"] = (
+            round(k["scope_bytes"] / k["bytes_per_step"], 4)
+            if k["bytes_per_step"] > 0 else None
+        )
+        k["opcodes"] = sorted(set(k["opcodes"]))
+        if not k["claimed"]:
+            unattributed += k["bytes_per_step"]
+    return {
+        "kinds": [kinds[k] for k in sorted(kinds)],
+        "unattributed_bytes": unattributed,
+        "total_bytes_per_step": float(
+            sum(k["bytes_per_step"] for k in kinds.values())),
+    }
+
+
+def grad_sync_claim_bytes(param_bytes: float,
+                          process_count: int) -> Dict[str, float]:
+    """The solver's analytic claim for the SPMD-inserted gradient
+    all-reduce: with replicated parameters, XLA all-reduces one
+    gradient tree per step — output bytes = the param tree's own size
+    (the output-shape convention the HLO pricing uses).  Claimed only
+    when there is more than one shard to reduce over."""
+    if process_count <= 0:
+        raise ValueError(f"process_count must be positive: {process_count}")
+    return {"allreduce": float(param_bytes)} if param_bytes > 0 else {}
+
+
+def effective_bandwidth(
+    comm: Dict[str, Any],
+    ms_per_step: Optional[float],
+    device_kind: str,
+    link: str,
+) -> Dict[str, Any]:
+    """Attach the per-kind effective-bandwidth-demand columns and the
+    roofline interconnect check to a ``comm_rows_from_hlo`` result
+    (mutates a copy; the input is not changed).
+
+    ``link``: ``"ici"`` (single-host mesh) or ``"dcn"`` (collectives
+    crossing host processes) — resolved against
+    ``obs.perf.roofline.interconnect_peak``.
+    """
+    from npairloss_tpu.obs.perf.roofline import chip_peaks, interconnect_peak
+
+    spec = chip_peaks(device_kind)
+    peak = interconnect_peak(spec, link)
+    out = {
+        **{k: v for k, v in comm.items() if k != "kinds"},
+        "link": link,
+        "peak_bytes_per_s": peak,
+        "peak_known": spec.known,
+        "ms_per_step": ms_per_step,
+        "kinds": [],
+    }
+    for k in comm["kinds"]:
+        row = dict(k)
+        if ms_per_step and ms_per_step > 0:
+            bps = row["bytes_per_step"] / (ms_per_step * 1e-3)
+            row["effective_bytes_per_s"] = bps
+            row["link_utilization"] = round(bps / peak, 4) if peak else None
+        else:
+            row["effective_bytes_per_s"] = None
+            row["link_utilization"] = None
+        out["kinds"].append(row)
+    return out

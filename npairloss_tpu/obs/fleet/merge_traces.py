@@ -1,0 +1,166 @@
+"""Merge per-rank Chrome traces into one fleet timeline.
+
+Each rank's ``trace.r<k>.json`` is a self-consistent host timeline with
+timestamps relative to ITS tracer's creation.  Loaded separately in
+Perfetto they answer nothing about the fleet — the question is always
+cross-rank ("rank 3's dispatch starts 40 ms after everyone else's").
+This module folds them into ONE Perfetto-loadable file:
+
+  * every rank becomes its own numbered process lane (``pid = rank``,
+    with ``process_name``/``process_sort_index`` metadata events, so
+    the UI shows ``rank 0`` .. ``rank G-1`` top-to-bottom);
+  * timestamps are re-based onto a common origin using the
+    **clock-offset estimate** from each trace's absolute
+    ``wall_time_origin`` (falling back to the rank manifest's
+    ``created`` stamp): ``offset_k = origin_k - min(origins)``.  On one
+    host this is exact (one clock); across hosts it is as good as the
+    hosts' wall-clock sync — the per-rank offsets are recorded in the
+    merged trace's metadata so a reader can judge.
+
+Torn/unreadable per-rank traces are skipped with a note in the
+metadata, never fatal.  Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from npairloss_tpu.obs.fleet.stamp import (
+    discover_ranks,
+    load_json as _load_json,
+    rank_manifest_name,
+    rank_trace_name,
+)
+
+MERGED_TRACE_FILENAME = "fleet_trace.json"
+
+
+def collect_rank_traces(
+    run_dir: str,
+) -> Tuple[Dict[int, Dict[str, Any]], Dict[int, Optional[float]], List[str]]:
+    """(traces by rank, wall-time origin by rank, notes).  The origin
+    prefers the trace's own ``wall_time_origin`` (stamped at tracer
+    creation) and falls back to the rank manifest's ``created``."""
+    run_dir = os.path.abspath(run_dir)
+    traces: Dict[int, Dict[str, Any]] = {}
+    origins: Dict[int, Optional[float]] = {}
+    notes: List[str] = []
+    ranks = discover_ranks(run_dir)
+    layouts = (
+        [(r, rank_trace_name(r), rank_manifest_name(r)) for r in ranks]
+        if ranks else [(0, "trace.json", "manifest.json")]
+    )
+    for rank, trace_name, manifest_name in layouts:
+        path = os.path.join(run_dir, trace_name)
+        trace = _load_json(path)
+        if trace is None or not isinstance(trace.get("traceEvents"), list):
+            if os.path.exists(path):
+                notes.append(f"rank {rank}: unreadable trace {trace_name}")
+            else:
+                notes.append(f"rank {rank}: no trace file")
+            continue
+        traces[rank] = trace
+        origin = (trace.get("otherData", {}) or {}).get("wall_time_origin")
+        if not isinstance(origin, (int, float)):
+            man = _load_json(os.path.join(run_dir, manifest_name)) or {}
+            origin = man.get("created")
+            if isinstance(origin, (int, float)):
+                notes.append(
+                    f"rank {rank}: clock offset estimated from manifest "
+                    "created time (trace carried no wall_time_origin)")
+            else:
+                origin = None
+                notes.append(
+                    f"rank {rank}: no clock reference — events kept on "
+                    "the rank's own relative timeline")
+        origins[rank] = origin
+    return traces, origins, notes
+
+
+def merge_chrome_traces(
+    traces: Dict[int, Dict[str, Any]],
+    origins: Optional[Dict[int, Optional[float]]] = None,
+    notes: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """Per-rank trace objects -> one merged Chrome-trace object with
+    rank-numbered process lanes and clock-offset-aligned timestamps."""
+    origins = origins or {}
+    known = [o for o in origins.values() if isinstance(o, (int, float))]
+    base = min(known) if known else None
+    events: List[Dict[str, Any]] = []
+    offsets_us: Dict[str, float] = {}
+    dropped: Dict[str, int] = {}
+    for rank in sorted(traces):
+        trace = traces[rank]
+        origin = origins.get(rank)
+        offset_us = ((origin - base) * 1e6
+                     if base is not None
+                     and isinstance(origin, (int, float)) else 0.0)
+        offsets_us[str(rank)] = round(offset_us, 1)
+        # Perfetto lane naming: ts=0 keeps the metadata events valid
+        # under validate_chrome_trace (which requires numeric ts).
+        events.append({"name": "process_name", "ph": "M", "ts": 0,
+                       "pid": rank, "tid": 0,
+                       "args": {"name": f"rank {rank}"}})
+        events.append({"name": "process_sort_index", "ph": "M", "ts": 0,
+                       "pid": rank, "tid": 0,
+                       "args": {"sort_index": rank}})
+        meta = trace.get("otherData", {}) or {}
+        if meta.get("dropped_events"):
+            dropped[str(rank)] = int(meta["dropped_events"])
+        for ev in trace.get("traceEvents", []):
+            # Malformed events (no name/ph, non-numeric ts, an "X"
+            # without a numeric dur) are dropped here, per the
+            # never-fatal contract: one rank's damaged trace must not
+            # invalidate the merged timeline of the whole fleet.
+            if not isinstance(ev, dict) \
+                    or not isinstance(ev.get("ts"), (int, float)) \
+                    or "name" not in ev or "ph" not in ev:
+                continue
+            if ev["ph"] == "X" and not isinstance(
+                    ev.get("dur"), (int, float)):
+                continue
+            out = dict(ev)
+            out["ts"] = ev["ts"] + offset_us
+            out["pid"] = rank
+            events.append(out)
+    merged_meta: Dict[str, Any] = {
+        "merged_ranks": sorted(traces),
+        "clock_offsets_us": offsets_us,
+        "clock_note": (
+            "offsets estimated from per-rank wall-clock origins; exact "
+            "on one host, host-clock-sync-accurate across hosts"),
+    }
+    if base is not None:
+        merged_meta["wall_time_origin"] = base
+    if dropped:
+        merged_meta["dropped_events_by_rank"] = dropped
+    if notes:
+        merged_meta["notes"] = list(notes)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": merged_meta,
+    }
+
+
+def merge_run_traces(
+    run_dir: str, out_path: Optional[str] = None
+) -> Tuple[Optional[str], Dict[str, Any]]:
+    """Merge every per-rank trace under ``run_dir`` and write the
+    result (atomic tmp+rename); returns ``(path, merged_trace)`` —
+    path None when no rank left a readable trace."""
+    traces, origins, notes = collect_rank_traces(run_dir)
+    merged = merge_chrome_traces(traces, origins, notes)
+    if not traces:
+        return None, merged
+    if out_path is None:
+        out_path = os.path.join(os.path.abspath(run_dir),
+                                MERGED_TRACE_FILENAME)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out_path)
+    return out_path, merged

@@ -115,6 +115,13 @@ class RunManifest:
     git_sha: Optional[str] = None
     argv: Optional[list] = None
     host: Optional[Dict[str, Any]] = None
+    # Fleet identity of the WRITING process (obs.fleet):
+    # {process_index, process_count, local_device_ids}.  ``topology``
+    # above records what jax sees; this records what the telemetry
+    # layer stamped — in a harness-declared fleet (no jax.distributed
+    # cluster) the two legitimately differ, and the aggregator trusts
+    # this one.
+    fleet: Optional[Dict[str, Any]] = None
     extra: Optional[Dict[str, Any]] = None
 
     @classmethod
@@ -123,6 +130,7 @@ class RunManifest:
         run_id: str,
         config: Optional[Dict[str, Any]] = None,
         mesh: Optional[Dict[str, Any]] = None,
+        fleet: Optional[Dict[str, Any]] = None,
         extra: Optional[Dict[str, Any]] = None,
     ) -> "RunManifest":
         """Gather the ambient provenance (version/sha/topology/host)
@@ -140,6 +148,7 @@ class RunManifest:
                 "python": platform.python_version(),
                 "pid": os.getpid(),
             },
+            fleet=fleet,
             extra=extra,
         )
 

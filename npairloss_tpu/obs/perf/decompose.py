@@ -29,6 +29,12 @@ from typing import Any, Dict, List, Optional, Sequence
 SPAN_CATEGORIES = [
     ("data/next_batch", "data_wait"),
     ("pipeline/stage", "h2d"),
+    ("comm/", "comms"),
+    # comm/price is the fleet observatory's AOT collective-pricing
+    # compile — obs overhead, NOT interconnect time; the longer prefix
+    # outranks the comm/ rule above so seconds of XLA compile can't
+    # masquerade as a comms share.
+    ("comm/price", "compile"),
     ("step/compile", "compile"),
     ("eval/compile", "compile"),
     ("step/recompile", "compile"),
@@ -46,7 +52,7 @@ SPAN_CATEGORIES = [
 ]
 
 STEP_CATEGORIES = (
-    "data_wait", "h2d", "compile", "dispatch", "device_compute",
+    "data_wait", "h2d", "comms", "compile", "dispatch", "device_compute",
     "window_sync", "eval", "snapshot", "other_span",
 )
 

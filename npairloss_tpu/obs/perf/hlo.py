@@ -74,8 +74,13 @@ _INSTR_RE = re.compile(
     # layouts like f32[8,16]{1,0:T(8,128)(2,1)} on non-tuple results,
     # and a charset without ( ) fails to match every such instruction —
     # invisible on CPU (no tiling), empty region tables on the chip.
+    # Tuple types match LAZILY up to the ` opcode(` anchor (not
+    # ``[^=]*?``): XLA comments element indices past 4 as /*index=5*/,
+    # and an =-excluding charset fails on every 6+-element tuple — so
+    # a ``while`` with a large carry (the ring engine's scan) never
+    # parsed and its whole body went unwalked.
     r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
-    r"(?P<type>\([^=]*?\)|[\w\[\]{},:#*\.()]+)\s+"
+    r"(?P<type>\(.*?\)|[\w\[\]{},:#*\.()]+)\s+"
     r"(?P<opcode>[\w\-]+)\(",
 )
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
@@ -427,6 +432,56 @@ def attribute_regions(
     if notes:
         regions["_notes"] = notes  # type: ignore[assignment]
     return regions
+
+
+def collective_bytes_by_opcode(
+    hlo_text: str,
+) -> Dict[str, Dict[str, object]]:
+    """Per-collective-opcode wire accounting for the fleet comms join
+    (obs.fleet.comms): ``{opcode: {"bytes", "count", "regions":
+    {full_scope_path: bytes}}}`` with ``while`` bodies multiplied by
+    their trip count exactly like :func:`attribute_regions`.
+
+    Bytes are the OUTPUT shape of each collective (the convention
+    ``attribute_regions`` prices ``collective_bytes`` with), so the two
+    views reconcile by construction.  Regions here are FULL scope paths
+    (``region_of(..., depth=0)``): the comm attribution needs to see
+    the ``comm/<kind>`` scope markers wherever they sit in the stack,
+    which a report-depth truncation would cut off.
+    """
+    entry, comps = parse_hlo_computations(hlo_text)
+    out: Dict[str, Dict[str, object]] = {}
+
+    def account(instr: Instr, mult: float) -> None:
+        b = _shape_bytes(instr.out_shapes) * mult
+        row = out.setdefault(instr.opcode, {
+            "bytes": 0.0, "count": 0.0, "regions": {},
+        })
+        row["bytes"] += b
+        row["count"] += mult
+        region = region_of(instr.op_name, depth=0)
+        row["regions"][region] = row["regions"].get(region, 0.0) + b
+
+    def walk(comp_name: str, mult: float, seen: Tuple[str, ...]) -> None:
+        if comp_name not in comps or comp_name in seen:
+            return
+        for instr in comps[comp_name]:
+            if instr.opcode in _COLLECTIVE_OPS:
+                account(instr, mult)
+                continue
+            if instr.opcode == "while":
+                trip = _while_trip_count(instr, comps) or 1
+                for callee in instr.called:
+                    walk(callee, mult * trip, seen + (comp_name,))
+                continue
+            if instr.called:
+                # fusion/call/conditional/map bodies can all contain
+                # collectives after SPMD partitioning; count each body
+                # once at the caller's multiplicity.
+                for callee in instr.called:
+                    walk(callee, mult, seen + (comp_name,))
+    walk(entry, 1.0, ())
+    return out
 
 
 def stage_hlo_text(stage) -> str:

@@ -40,12 +40,17 @@ BOUND_CLASSES = ("compute", "memory", "collective", "unknown")
 @dataclasses.dataclass(frozen=True)
 class ChipSpec:
     """Per-chip peaks: dense bf16 FLOP/s, HBM bytes/s, interconnect
-    bytes/s (aggregate per chip, coarse)."""
+    bytes/s (aggregate per chip, coarse).  ``ici`` is the intra-slice
+    chip fabric; ``dcn`` the per-host data-center network crossed by
+    multi-host (multi-process) collectives — the TPU-v4 paper's point
+    is that the two differ by ~an order of magnitude, so a fleet
+    bandwidth check against the wrong one is off by that factor."""
 
     device_kind: str
     flops: float
     hbm_bytes_per_s: float
     ici_bytes_per_s: float
+    dcn_bytes_per_s: float = 25.0e9
     known: bool = True
 
     @property
@@ -54,22 +59,29 @@ class ChipSpec:
         return self.flops / self.hbm_bytes_per_s
 
 
-# (device_kind substring, HBM GB/s, ICI GB/s) — peak FLOP/s rides
-# costs.PEAK_FLOPS so the two tables can never disagree on a kind.
+# Interconnect link kinds a collective can ride (fleet comms rows carry
+# one of these; pinned by tests).
+LINK_KINDS = ("ici", "dcn")
+
+# (device_kind substring, HBM GB/s, ICI GB/s, DCN GB/s per host) — peak
+# FLOP/s rides costs.PEAK_FLOPS so the two tables can never disagree on
+# a kind.  DCN figures are generation-coarse (~200 Gb/s-class NICs for
+# v4+, less for earlier): like the HBM/ICI columns, the CLASSIFICATION
+# is the product, not a promise of achievable GB/s.
 _BW_SPECS = [
-    ("v6", 1640.0, 448.0),
-    ("v5p", 2765.0, 450.0),
-    ("v5 lite", 819.0, 160.0),
-    ("v5e", 819.0, 160.0),
-    ("v4", 1228.0, 300.0),
-    ("v3", 900.0, 280.0),
-    ("v2", 700.0, 62.0),
+    ("v6", 1640.0, 448.0, 50.0),
+    ("v5p", 2765.0, 450.0, 50.0),
+    ("v5 lite", 819.0, 160.0, 25.0),
+    ("v5e", 819.0, 160.0, 25.0),
+    ("v4", 1228.0, 300.0, 25.0),
+    ("v3", 900.0, 280.0, 12.5),
+    ("v2", 700.0, 62.0, 12.5),
 ]
 
 # Unknown kinds (CPU, test doubles) classify against the v4 reference
 # roofline — deterministic output everywhere, flagged via known=False.
 DEFAULT_SPEC = ChipSpec("unknown (v4 reference roofline)", 275e12,
-                        1228e9, 300e9, known=False)
+                        1228e9, 300e9, 25e9, known=False)
 
 
 def chip_peaks(device_kind: str) -> ChipSpec:
@@ -77,10 +89,19 @@ def chip_peaks(device_kind: str) -> ChipSpec:
     or the flagged v4-reference fallback."""
     kind = (device_kind or "").lower()
     flops = {k: f for k, f in PEAK_FLOPS}
-    for key, hbm, ici in _BW_SPECS:
+    for key, hbm, ici, dcn in _BW_SPECS:
         if key in kind and key in flops:
-            return ChipSpec(device_kind, flops[key], hbm * 1e9, ici * 1e9)
+            return ChipSpec(device_kind, flops[key], hbm * 1e9,
+                            ici * 1e9, dcn * 1e9)
     return DEFAULT_SPEC
+
+
+def interconnect_peak(spec: ChipSpec, link: str) -> float:
+    """Peak bytes/s of the named link kind — the reference a fleet
+    comms row's effective bandwidth is checked against."""
+    if link not in LINK_KINDS:
+        raise ValueError(f"link must be one of {LINK_KINDS}, got {link!r}")
+    return spec.dcn_bytes_per_s if link == "dcn" else spec.ici_bytes_per_s
 
 
 def classify(

@@ -52,6 +52,18 @@ class RunTelemetry:
     ``--trace-dir``); ``trace=False`` a metrics-only one.  ``ring``
     records stay readable via ``.ring.records()`` for live
     introspection either way.
+
+    ``fleet`` (docs/OBSERVABILITY.md §Fleet observatory) opts into
+    rank-stamped multi-process telemetry: ``True`` resolves the ambient
+    rank identity (jax process topology or the harness override), an
+    explicit :class:`obs.fleet.FleetStamp` passes through.  With a
+    stamp, every metric row gains ``{process_index, process_count,
+    local_device_ids}`` and the on-disk files switch to the rank-aware
+    scheme (``telemetry.r<k>.jsonl`` / ``trace.r<k>.json`` /
+    ``manifest.r<k>.json``) so N concurrent ranks sharing one run dir
+    never interleave a stream.  With ``fleet=None`` (default) behavior
+    — file names AND stream bytes — is identical to the pre-fleet
+    layer; the parity is pinned by test.
     """
 
     def __init__(
@@ -62,10 +74,15 @@ class RunTelemetry:
         trace: bool = True,
         ring_capacity: int = 1024,
         extra_sinks: Sequence[MetricLogger] = (),
+        fleet=None,
     ):
+        from npairloss_tpu.obs.fleet.stamp import resolve_fleet
+
         self.run_dir = os.path.abspath(run_dir)
         os.makedirs(self.run_dir, exist_ok=True)
         self.run_id = run_id or _default_run_id()
+        self.fleet = resolve_fleet(fleet)
+        self._stamp = self.fleet.to_dict() if self.fleet else None
         # Consumers (Solver.train) gate their per-step emission on this:
         # a trace-only instance must not pay the per-step host sync that
         # materializing metric scalars costs — it would distort the very
@@ -75,13 +92,39 @@ class RunTelemetry:
         children: list = [self.ring]
         if metrics:
             children.insert(
-                0, JsonlSink(os.path.join(self.run_dir, METRICS_FILENAME))
+                0, JsonlSink(os.path.join(self.run_dir,
+                                          self._metrics_filename()))
             )
         children.extend(extra_sinks)
         self.sink: MetricLogger = MultiSink(children)
         self.tracer: Optional[SpanTracer] = SpanTracer() if trace else None
+        if self.tracer is not None and self._stamp is not None:
+            self.tracer.stamp = dict(self._stamp)
         self.manifest: Optional[RunManifest] = None
         self._closed = False
+
+    # -- rank-aware path scheme -------------------------------------------
+
+    def _metrics_filename(self) -> str:
+        if self.fleet is None:
+            return METRICS_FILENAME
+        from npairloss_tpu.obs.fleet.stamp import rank_metrics_name
+
+        return rank_metrics_name(self.fleet.process_index)
+
+    def _trace_filename(self) -> str:
+        if self.fleet is None:
+            return TRACE_FILENAME
+        from npairloss_tpu.obs.fleet.stamp import rank_trace_name
+
+        return rank_trace_name(self.fleet.process_index)
+
+    def _manifest_filename(self) -> str:
+        if self.fleet is None:
+            return MANIFEST_FILENAME
+        from npairloss_tpu.obs.fleet.stamp import rank_manifest_name
+
+        return rank_manifest_name(self.fleet.process_index)
 
     # -- manifest ---------------------------------------------------------
 
@@ -91,12 +134,14 @@ class RunTelemetry:
         mesh: Optional[Dict[str, Any]] = None,
         extra: Optional[Dict[str, Any]] = None,
     ) -> str:
-        """Collect + write ``manifest.json``; call once at run start."""
+        """Collect + write ``manifest.json`` (``manifest.r<k>.json``
+        under a fleet stamp); call once at run start."""
         self.manifest = RunManifest.collect(
-            self.run_id, config=config, mesh=mesh, extra=extra
+            self.run_id, config=config, mesh=mesh, fleet=self._stamp,
+            extra=extra,
         )
         return self.manifest.write(
-            os.path.join(self.run_dir, MANIFEST_FILENAME)
+            os.path.join(self.run_dir, self._manifest_filename())
         )
 
     # -- metric records ---------------------------------------------------
@@ -122,6 +167,11 @@ class RunTelemetry:
             wall_time=time.time(),
             phase=phase,
         )
+        if self._stamp is not None:
+            # Fleet identity on EVERY row: offline aggregation must be
+            # able to attribute a row found anywhere (a copied stream, a
+            # fan-out sink) without trusting its file name.
+            record.update(self._stamp)
         self.sink.log(record)
         return record
 
@@ -143,7 +193,8 @@ class RunTelemetry:
     def flush(self) -> None:
         self.sink.flush()
         if self.tracer is not None:
-            self.tracer.write(os.path.join(self.run_dir, TRACE_FILENAME))
+            self.tracer.write(
+                os.path.join(self.run_dir, self._trace_filename()))
 
     def close(self) -> None:
         if self._closed:

@@ -29,6 +29,13 @@ from typing import Any, Dict, List, Optional, Protocol, Sequence, runtime_checka
 # validated by tests and by downstream consumers of metrics.jsonl).
 REQUIRED_KEYS = ("run_id", "step", "wall_time", "phase")
 
+# The ADDITIONAL envelope of fleet-stamped records (docs/OBSERVABILITY.md
+# §Fleet observatory): rank identity on every row of a multi-process
+# run.  Spelled out here (not imported from obs.fleet.stamp, which pins
+# the same tuple by test) because THIS module is the one jax-free
+# processes load by file path — it must not drag the package in.
+FLEET_KEYS = ("process_index", "process_count", "local_device_ids")
+
 
 @runtime_checkable
 class MetricLogger(Protocol):

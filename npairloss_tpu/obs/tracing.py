@@ -44,6 +44,20 @@ class SpanTracer:
         self._max_events = max_events
         self._dropped = 0
         self._pid = os.getpid()
+        # Fleet identity (obs.fleet.stamp): stamped into the trace
+        # metadata so every span in a per-rank trace file is
+        # attributable to its rank; None = no fleet block in the
+        # output (byte-identical to the pre-fleet trace).
+        self.stamp: Optional[Dict[str, Any]] = None
+
+    @property
+    def dropped(self) -> int:
+        """Events the ``max_events`` cap has eaten so far — consumers
+        (solver window rows, serve window rows, the fleet aggregator)
+        surface this instead of silently averaging a truncated
+        stream."""
+        with self._lock:
+            return self._dropped
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
@@ -129,6 +143,12 @@ class SpanTracer:
         }
         if dropped:
             meta["dropped_events"] = dropped
+        if self.stamp:
+            # Rank identity for every span in this stream: the trace
+            # file is per-rank under the fleet path scheme, so a
+            # file-level stamp makes each event unambiguous without
+            # paying ~30 bytes of args on all 200k of them.
+            meta["fleet"] = dict(self.stamp)
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",

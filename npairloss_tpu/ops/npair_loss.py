@@ -540,8 +540,13 @@ def _forward_core(
     else:
         # MPI_Allgather of features and labels (cu:17-43) as in-graph ICI
         # collectives; rank-r block lands at rows [r*N, (r+1)*N) exactly as
-        # MPI_Allgather orders recvbuf.
-        with jax.named_scope("npair/gather"):
+        # MPI_Allgather orders recvbuf.  The nested comm/ scope is the
+        # fleet observatory's exchange-path marker (obs.fleet.comms):
+        # collective bytes whose op_name carries it are attributed to a
+        # declared exchange path; metadata-only, the program is
+        # unchanged.
+        with jax.named_scope("npair/gather"), \
+                jax.named_scope("comm/all_gather"):
             total_features = jax.lax.all_gather(
                 features, axis_name, axis=0, tiled=True
             )
@@ -666,7 +671,10 @@ def _reference_backward(
     )
 
     if axis_name is not None:
-        grad_db = jax.lax.psum(grad_db, axis_name)
+        # MPI_Allreduce of the database-role grads (cu:462-489); the
+        # comm/ scope marks the exchange path for fleet attribution.
+        with jax.named_scope("comm/allreduce"):
+            grad_db = jax.lax.psum(grad_db, axis_name)
     grad_db = grad_db / jnp.float32(res["num_shards"])
 
     own_rows = jax.lax.dynamic_slice_in_dim(

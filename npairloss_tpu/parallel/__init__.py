@@ -5,10 +5,12 @@ from npairloss_tpu.parallel._compat import shard_map
 from npairloss_tpu.parallel.distributed import (
     initialize_distributed,
     process_local_batch,
+    process_topology,
 )
 from npairloss_tpu.parallel.mesh import (
     DEFAULT_AXIS,
     data_parallel_mesh,
+    mesh_topology,
     shard_batch,
     sharded_npair_loss_fn,
 )
@@ -21,7 +23,9 @@ __all__ = [
     "DEFAULT_AXIS",
     "data_parallel_mesh",
     "initialize_distributed",
+    "mesh_topology",
     "process_local_batch",
+    "process_topology",
     "shard_batch",
     "sharded_npair_loss_fn",
     "ring_npair_loss_and_metrics",

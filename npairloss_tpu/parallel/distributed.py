@@ -57,6 +57,20 @@ def initialize_distributed(
     )
 
 
+def process_topology() -> dict:
+    """This process's fleet identity as jax sees it:
+    ``{process_index, process_count, local_device_ids}`` — the
+    jax-backed source ``obs.fleet.fleet_stamp`` resolves when no
+    harness override is declared."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_ids": [d.id for d in jax.local_devices()],
+    }
+
+
 def process_local_batch(mesh, batch, axis: str = "dp"):
     """Assemble a global sharded array from THIS process's batch shard.
 

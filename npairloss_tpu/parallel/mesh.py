@@ -32,6 +32,23 @@ def data_parallel_mesh(
     return Mesh(np.array(devices), (axis,))
 
 
+def mesh_topology(mesh: Mesh, axis: str = DEFAULT_AXIS) -> dict:
+    """JSON-able description of a mesh for run manifests (the fleet
+    observatory's "what topology produced these streams?" record):
+    axis/size plus the device→process placement, so an offline reader
+    can tell which shards were local to which rank without a live
+    backend."""
+    devices = list(mesh.devices.flatten())
+    return {
+        "axis": axis,
+        "devices": len(devices),
+        "device_ids": [d.id for d in devices],
+        "device_process": [getattr(d, "process_index", 0) for d in devices],
+        "process_count": len({getattr(d, "process_index", 0)
+                              for d in devices}),
+    }
+
+
 def shard_batch(mesh: Mesh, batch, axis: str = DEFAULT_AXIS):
     """Place a host batch with its leading dim sharded over ``axis``."""
     sharding = NamedSharding(mesh, P(axis))

@@ -159,9 +159,13 @@ def _ring_scan(axis_name: str, body, carry, rotating):
     def step_fn(state, step):
         carry, rotating = state
         carry, rotating = body(carry, rotating, step)
-        rotating = jax.tree_util.tree_map(
-            lambda x: jax.lax.ppermute(x, axis_name, perm), rotating
-        )
+        # comm/ scope = the fleet observatory's exchange-path marker
+        # (obs.fleet.comms): the hop's collective-permutes carry it in
+        # their HLO op_name metadata; the program itself is unchanged.
+        with jax.named_scope("comm/ppermute"):
+            rotating = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, axis_name, perm), rotating
+            )
         return (carry, rotating), None
 
     (carry, rotating), _ = jax.lax.scan(

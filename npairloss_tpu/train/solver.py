@@ -193,6 +193,15 @@ class Solver:
         self._perf_last: Optional[Tuple[float, int]] = None
         self._last_batch_size: Optional[int] = None
         self._dev_kind: Optional[str] = None
+        # Fleet observatory state (docs/OBSERVABILITY.md §Fleet): under
+        # fleet-stamped telemetry on a mesh, the first dispatch prices
+        # the step's collectives from its HLO (written to
+        # fleet_comms.json for `prof --fleet`) and per-step comm marks
+        # carry the per-kind payload bytes; ``_step_seq`` numbers the
+        # dispatch spans so the offline aggregator can join the i-th
+        # span across ranks without trusting ordinal position.
+        self._comm_kinds: Optional[list] = None
+        self._step_seq: int = 0
         # The loss top's `loss_weight` (reference: cu:435 scales the
         # whole backward by top[0]'s weight; Caffe's objective is the
         # weighted loss).  The shipped template uses 1.
@@ -611,9 +620,11 @@ class Solver:
         if self.mesh is not None and jax.process_count() > 1:
             from npairloss_tpu.parallel.distributed import process_local_batch
 
-            return process_local_batch(
-                self.mesh, (np.asarray(inputs), np.asarray(labels)), self.axis
-            )
+            with self._span("comm/assemble", staged=True):
+                return process_local_batch(
+                    self.mesh, (np.asarray(inputs), np.asarray(labels)),
+                    self.axis,
+                )
         inputs = np.asarray(inputs)
         labels = np.asarray(labels)
         if inputs.dtype == np.float64:
@@ -681,11 +692,13 @@ class Solver:
         return (self.perf_metrics and tel is not None
                 and tel.metrics_enabled and not self._telemetry_failed)
 
-    def _capture_step_flops(self, fn, args) -> None:
+    def _capture_step_flops(self, fn, args):
         """XLA's analytic per-step FLOPs of the program about to
         dispatch (client-side lowering, no extra compile) — feeds the
         continuous ``perf`` rows' MFU.  Best-effort: a backend without
-        cost analysis just means MFU-less rows."""
+        cost analysis just means MFU-less rows.  Returns the Lowered
+        (or None) so a same-signature fleet-comms capture can reuse it
+        instead of paying a second re-trace."""
         from npairloss_tpu.obs.perf.costs import cost_flops
 
         try:
@@ -693,14 +706,117 @@ class Solver:
             # (once per signature) and must show in the host timeline
             # as obs overhead, not as unattributed wall time.
             with self._span("step/cost_analysis"):
-                self._step_flops = cost_flops(fn.lower(*args))
+                lowered = fn.lower(*args)
+                self._step_flops = cost_flops(lowered)
+            return lowered
         except Exception as e:  # noqa: BLE001 — perf rows are optional
             log.debug("step flops estimate unavailable: %s", e)
+            return None
 
     def _device_kind(self) -> str:
         if self._dev_kind is None:
             self._dev_kind = jax.devices()[0].device_kind
         return self._dev_kind
+
+    # -- fleet observatory hooks (docs/OBSERVABILITY.md §Fleet) -----------
+
+    def _fleet_stamp(self):
+        """The attached telemetry's FleetStamp, or None — every fleet
+        hook below gates on this, so non-fleet runs keep byte-identical
+        telemetry streams and span timelines."""
+        tel = self.telemetry
+        return getattr(tel, "fleet", None) if tel is not None else None
+
+    def _step_span_args(self, batch: int) -> Dict[str, Any]:
+        """step/dispatch|compile span args: fleet runs additionally
+        stamp the step number so the cross-rank aggregator can join the
+        same step's spans across ranks."""
+        args: Dict[str, Any] = {"batch": batch}
+        if self._fleet_stamp() is not None:
+            args["step"] = self._step_seq + 1
+        return args
+
+    def _capture_fleet_comms(self, fn, args, lowered=None) -> None:
+        """Collective pricing at FIRST DISPATCH under fleet telemetry
+        on a mesh (not first compile: telemetry attached after the
+        step already compiled — a warmed solver, the mp harness — must
+        still capture): extract the compiled step's HLO, price every
+        collective per opcode
+        (``obs.perf.hlo.collective_bytes_by_opcode``), add the
+        analytic grad-sync claim for the SPMD-inserted parameter
+        all-reduce, and leave ``fleet_comms.json`` in the run dir for
+        ``prof --fleet`` (rank 0 writes; the pricing is identical on
+        every rank of an SPMD program).  Costs one extra AOT compile
+        of the step — spanned, fleet-opt-in only; ``lowered`` reuses a
+        just-captured perf lowering instead of re-tracing.
+        Best-effort: a backend that cannot re-lower just means a
+        comms-less fleet report."""
+        stamp = self._fleet_stamp()
+        if stamp is None or self.mesh is None \
+                or self._comm_kinds is not None:
+            return
+        try:
+            from npairloss_tpu.obs.fleet import comms as comms_mod
+            from npairloss_tpu.obs.fleet.aggregate import COMMS_FILENAME
+            from npairloss_tpu.obs.perf.hlo import (
+                collective_bytes_by_opcode,
+                stage_hlo_text,
+            )
+
+            with self._span("comm/price", aot=True):
+                per_opcode = collective_bytes_by_opcode(
+                    stage_hlo_text(
+                        lowered if lowered is not None
+                        else fn.lower(*args)))
+            param_bytes = float(sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(self.state["params"])
+            ))
+            extra = (comms_mod.grad_sync_claim_bytes(
+                param_bytes, stamp.process_count)
+                if self.mesh.size > 1 else {})
+            payload = {
+                "per_opcode": per_opcode,
+                "extra_claims": extra,
+                "device_kind": self._device_kind(),
+                # Collectives crossing host processes ride DCN; a
+                # single-process mesh keeps them on-chip/ICI.
+                "link": "dcn" if stamp.process_count > 1 else "ici",
+                "batch": self._last_batch_size,
+                "engine": self.engine,
+                "mesh_devices": int(self.mesh.size),
+            }
+            rows = comms_mod.comm_rows_from_hlo(per_opcode, extra)
+            self._comm_kinds = [
+                (k["kind"], k["bytes_per_step"], k["claimed"])
+                for k in rows["kinds"]
+            ]
+            if stamp.process_index == 0 and self.telemetry is not None:
+                import json as _json
+                import os as _os
+
+                path = _os.path.join(self.telemetry.run_dir,
+                                     COMMS_FILENAME)
+                tmp = path + f".tmp-{_os.getpid()}"
+                with open(tmp, "w") as f:
+                    _json.dump(payload, f)
+                _os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001 — comms rows are optional
+            self._comm_kinds = []
+            log.debug("fleet comm pricing unavailable: %s", e)
+
+    def _emit_comm_marks(self, step_num: int) -> None:
+        """Per-step ``comm/<kind>`` marks carrying the HLO-priced
+        payload bytes — the host cannot time an in-graph collective, so
+        these are zero-duration accounting marks on the timeline, not
+        fabricated durations (the bandwidth math lives offline in
+        ``obs.fleet.comms``)."""
+        tel = self.telemetry
+        if tel is None or not self._comm_kinds:
+            return
+        for kind, nbytes, claimed in self._comm_kinds:
+            tel.instant(f"comm/{kind}", bytes=nbytes, claimed=claimed,
+                        step=step_num)
 
     def _emit_perf_row(self, step_num: int) -> None:
         """One ``phase="perf"`` row per display window: wall clock
@@ -752,9 +868,16 @@ class Solver:
         if self.mesh is not None and jax.process_count() > 1:
             from npairloss_tpu.parallel.distributed import process_local_batch
 
-            return process_local_batch(
-                self.mesh, (np.asarray(inputs), np.asarray(labels)), self.axis
-            )
+            # The one HOST-side exchange path: assembling this
+            # process's rows into the global batch.  Unlike the
+            # in-graph collectives (accounting marks only), this has a
+            # real host duration — spanned as comm/ so the fleet
+            # decomposition sees it.
+            with self._span("comm/assemble"):
+                return process_local_batch(
+                    self.mesh, (np.asarray(inputs), np.asarray(labels)),
+                    self.axis,
+                )
         return jnp.asarray(inputs), jnp.asarray(labels)
 
     def step(self, inputs: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
@@ -778,13 +901,28 @@ class Solver:
                 and len(self._seen_step_shapes) > 1:
             self.telemetry.instant("step/recompile", batch=int(np.shape(x)[0]))
         self._last_batch_size = int(np.shape(x)[0])
+        lowered = None
         if compiling and self._want_perf():
-            self._capture_step_flops(self._step_fn, (self.state, x, lab))
+            lowered = self._capture_step_flops(
+                self._step_fn, (self.state, x, lab))
+        if compiling:
+            # A new signature is a NEW program with new collective
+            # payloads (the dynamic-batch tail step is smaller):
+            # invalidate so the pricing below re-captures; marks then
+            # always carry the CURRENT program's bytes.
+            self._comm_kinds = None
+        # Self-gated: fleet comms must also capture at the first
+        # dispatch AFTER telemetry attaches, which need not be a
+        # compile (a warmed solver re-dispatches the same signature).
+        self._capture_fleet_comms(self._step_fn, (self.state, x, lab),
+                                  lowered=lowered)
         with self._span(
             "step/compile" if compiling else "step/dispatch",
-            batch=int(np.shape(x)[0]),
+            **self._step_span_args(int(np.shape(x)[0])),
         ):
             self.state, metrics = self._step_fn(self.state, x, lab)
+        self._step_seq += 1
+        self._emit_comm_marks(self._step_seq)
         if debug_checks_enabled():
             # utils.debug switch: validate every step's scalars on host
             # (SURVEY.md §5.2 — the reference had no numeric checks).
@@ -910,6 +1048,9 @@ class Solver:
         iteration-0 TEST pass.  Returns the start iteration."""
         cfg = self.cfg
         start = self.iteration
+        # Fleet dispatch spans number steps from the resume point so
+        # span step args and row step numbers agree across a restart.
+        self._step_seq = start
         if start:
             log_fn(f"resuming from iteration {start}")
             if start >= num_iters:
@@ -944,8 +1085,18 @@ class Solver:
         tel = self.telemetry
         if tel is not None and tel.metrics_enabled \
                 and not self._telemetry_failed:
+            extra: Dict[str, Any] = {}
+            if cfg.display and step_num % cfg.display == 0 \
+                    and tel.tracer is not None and tel.tracer.dropped:
+                # The tracer cap is eating spans: surface the drop
+                # count in the display-window row (the serve window
+                # rows' spans_dropped contract, uniform for training)
+                # instead of letting the host timeline silently go
+                # partial.  Absent unless drops happened, so ordinary
+                # runs keep byte-identical streams.
+                extra["spans_dropped"] = tel.tracer.dropped
             self._tel_log("train", step_num,
-                          {k: float(v) for k, v in row.items()})
+                          {k: float(v) for k, v in row.items()}, **extra)
         if self._want_perf() and cfg.display \
                 and step_num % cfg.display == 0:
             # Continuous perf/mfu rows at display cadence (a pending-
@@ -1117,15 +1268,24 @@ class Solver:
                         tel.instant("step/recompile",
                                     batch=int(np.shape(x)[0]))
                     self._last_batch_size = int(np.shape(x)[0])
+                    lowered = None
                     if compiling and self._want_perf():
-                        self._capture_step_flops(
+                        lowered = self._capture_step_flops(
                             self._pipe_step_fn, (self.state, ring, x, lab))
+                    if compiling:
+                        # New signature = new collective payloads;
+                        # re-price (see the sync loop).
+                        self._comm_kinds = None
+                    self._capture_fleet_comms(
+                        self._pipe_step_fn, (self.state, ring, x, lab),
+                        lowered=lowered)
                     cache_size = getattr(self._pipe_step_fn,
                                          "_cache_size", lambda: None)
                     n_before = cache_size()
                     with self._span(
                         "step/compile" if compiling else "step/dispatch",
-                        batch=int(np.shape(x)[0]), pipeline=True,
+                        pipeline=True,
+                        **self._step_span_args(int(np.shape(x)[0])),
                     ):
                         self.state, ring, tick = self._pipe_step_fn(
                             self.state, ring, x, lab
@@ -1142,6 +1302,8 @@ class Solver:
                                     keyed="sharding")
                     controller.admit(tick)
                     step_num = int(it) + 1
+                    self._step_seq = step_num
+                    self._emit_comm_marks(step_num)
                     if failpoints.should_fire("step.nan_loss"):
                         # The sync loop poisons the OBSERVED loss on
                         # host (state untouched); here the observation
@@ -1292,6 +1454,9 @@ class Solver:
             # cfg unchanged: clear the NaN-poisoned loss window by hand.
             self._loss_window.clear()
         resumed = self.iteration
+        # Fleet span numbering follows the rollback: the next dispatch
+        # is step resumed+1 again.
+        self._step_seq = resumed
         msg = (f"divergence: {reason}; rolled back to iteration {resumed} "
                f"({restored}), lr={self.cfg.base_lr:.6g} "
                f"[rollback {guard.rollbacks}/{dcfg.max_rollbacks}]")

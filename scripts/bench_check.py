@@ -150,6 +150,74 @@ def load_history_records(path: str) -> List[Tuple[str, Dict[str, Any]]]:
     return records
 
 
+# -- fleet-report gate --------------------------------------------------------
+
+def _load_fleet_aggregate():
+    """File-path-load ``obs.fleet.aggregate`` (and its ``stamp``
+    dependency) WITHOUT importing the package — the jax-free contract.
+    Pre-seeding the dotted names in sys.modules makes aggregate's own
+    ``from npairloss_tpu.obs.fleet.stamp import ...`` resolve against
+    the seeded module instead of triggering the jax-importing package
+    ``__init__``."""
+    import importlib.util
+
+    base = os.path.join(REPO, "npairloss_tpu", "obs", "fleet")
+    for name, fname in (
+        ("npairloss_tpu.obs.fleet.stamp", "stamp.py"),
+        ("npairloss_tpu.obs.fleet.aggregate", "aggregate.py"),
+    ):
+        if name in sys.modules:
+            continue
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(base, fname))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["npairloss_tpu.obs.fleet.aggregate"]
+
+
+def check_fleet_report(path: str) -> List[str]:
+    """Gate one fleet report artifact: schema-valid per the one
+    contract (validate_fleet_report), per-rank step counts in
+    agreement (ranks not training in lockstep is a broken fleet, not a
+    measurement), and zero unattributed collective bytes when the
+    comms join ran (an unclaimed collective kind means an exchange
+    path went uninstrumented)."""
+    try:
+        with open(path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"fleet report {path} unreadable: {e}"]
+    agg = _load_fleet_aggregate()
+    err = agg.validate_fleet_report(report)
+    if err is not None:
+        return [f"fleet report schema-invalid: {err}"]
+    violations: List[str] = []
+    counts = {r["rank"]: r["steps"] for r in report["ranks"]}
+    if len(set(counts.values())) > 1:
+        violations.append(
+            f"per-rank step counts disagree: {counts} — refusing the "
+            "fleet report (ranks did not train in lockstep, or a "
+            "stream was truncated)")
+    elif not any(counts.values()):
+        # All-zero counts AGREE, but a fleet that measured nothing is
+        # a dead run (streams lost before the first flush), not a
+        # passing one.
+        violations.append(
+            f"every rank reports 0 steps: {counts} — the fleet "
+            "measured nothing (streams lost or training never ran)")
+    comms = report.get("comms", {})
+    if comms.get("available") and comms.get("unattributed_bytes", 0) > 0:
+        violations.append(
+            f"{comms['unattributed_bytes']:.0f} collective bytes "
+            "unattributed — an exchange path is missing its comm/ "
+            "instrumentation")
+    if not violations:
+        _log(f"fleet report OK ({len(counts)} rank(s), "
+             f"{next(iter(counts.values()))} steps each)")
+    return violations
+
+
 # -- the gate -----------------------------------------------------------------
 
 def _spread(rec: Dict[str, Any]) -> float:
@@ -279,7 +347,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="base relative tolerance before the per-record window "
         "spread widens it (default 0.05)",
     )
+    ap.add_argument(
+        "--fleet-report", dest="fleet_report", metavar="PATH",
+        help="gate a fleet report artifact instead of the bench "
+        "trajectory: schema-valid (npairloss-fleet-report-v1), "
+        "per-rank step counts agree, zero unattributed collective "
+        "bytes — the ci.sh fleet-smoke wiring",
+    )
     args = ap.parse_args(argv)
+
+    if args.fleet_report:
+        violations = check_fleet_report(args.fleet_report)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (fleet report {args.fleet_report})")
+        return 0
 
     records = load_offline_records()
     if not args.offline:

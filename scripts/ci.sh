@@ -281,6 +281,100 @@ print(f"policy prof guard OK (top trunk region {top['region']} "
       f"bound {lrn[0]['bound']})")
 EOF
 
+echo "== fleet observatory smoke (docs/OBSERVABILITY.md §Fleet) =="
+# Two cooperating CPU processes train a short run under the strict sync
+# guard, each writing its own rank-stamped telemetry stream into ONE
+# shared run dir; then `prof --fleet` must aggregate them into a
+# schema-valid npairloss-fleet-report-v1 with both ranks present, skew
+# computed, and ZERO unattributed collective bytes, and bench_check
+# must accept the report (it refuses per-rank step-count disagreement).
+#
+# Real multi-controller (jax.distributed) CPU collectives are an env
+# capability — some jaxlib CPU backends form the cluster and then
+# refuse to EXECUTE a cross-process computation.  Probe first
+# (tests/mp_probe.py); fall back to the declared-rank harness mode
+# (NPAIRLOSS_FLEET_PROCESS=<rank>/<count>) where the env can't, so the
+# whole fleet observability path is smoked on every box either way.
+fleet_dir="$smoke_dir/fleet"
+mkdir -p "$fleet_dir"
+cat > "$fleet_dir/solver.prototxt" <<EOF
+net: "examples/tiny_net.prototxt"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+max_iter: 8
+display: 4
+test_interval: 0
+test_iter: 0
+snapshot: 0
+snapshot_prefix: "$fleet_dir/f_"
+EOF
+probe_port=$(python -c 'import socket; s=socket.socket(); s.bind(("localhost",0)); print(s.getsockname()[1])')
+probe_ok=1
+for i in 0 1; do
+    JAX_PLATFORMS=cpu XLA_FLAGS= PYTHONPATH=. \
+        python tests/mp_probe.py "$i" 2 "$probe_port" \
+        > "$fleet_dir/probe$i.log" 2>&1 &
+    eval "ppid$i=\$!"
+done
+wait "$ppid0" || probe_ok=0
+wait "$ppid1" || probe_ok=0
+grep -q PROBE_OK "$fleet_dir/probe0.log" || probe_ok=0
+
+if [[ "$probe_ok" -eq 1 ]]; then
+    echo "fleet smoke: real jax.distributed 2-process mode"
+    mp_port=$(python -c 'import socket; s=socket.socket(); s.bind(("localhost",0)); print(s.getsockname()[1])')
+    for i in 0 1; do
+        JAX_PLATFORMS=cpu XLA_FLAGS= NPAIRLOSS_PIPELINE_SYNC_GUARD=strict \
+            python -m npairloss_tpu train --solver "$fleet_dir/solver.prototxt" \
+            --model mlp --synthetic --engine ring --pipeline \
+            --coordinator "localhost:$mp_port" --num-processes 2 --process-id "$i" \
+            --telemetry-dir "$fleet_dir/run" > "$fleet_dir/train$i.log" 2>&1 &
+        eval "tpid$i=\$!"
+    done
+else
+    echo "fleet smoke: declared-rank harness mode (env cannot execute" \
+         "multi-process CPU collectives: $(tail -1 "$fleet_dir/probe0.log" | cut -c1-120))"
+    for i in 0 1; do
+        JAX_PLATFORMS=cpu NPAIRLOSS_FLEET_PROCESS="$i/2" \
+            NPAIRLOSS_PIPELINE_SYNC_GUARD=strict \
+            python -m npairloss_tpu train --solver "$fleet_dir/solver.prototxt" \
+            --model mlp --synthetic --engine ring --mesh 1 --pipeline \
+            --telemetry-dir "$fleet_dir/run" > "$fleet_dir/train$i.log" 2>&1 &
+        eval "tpid$i=\$!"
+    done
+fi
+for i in 0 1; do
+    eval "pid=\$tpid$i"
+    wait "$pid" \
+        || { echo "fleet smoke: rank $i training failed"; cat "$fleet_dir/train$i.log"; exit 1; }
+done
+for i in 0 1; do
+    [[ -f "$fleet_dir/run/telemetry.r$i.jsonl" ]] \
+        || { echo "fleet smoke: rank $i left no stream"; ls "$fleet_dir/run"; exit 1; }
+done
+JAX_PLATFORMS=cpu python -m npairloss_tpu prof --fleet "$fleet_dir/run" \
+    > "$fleet_dir/prof.log" 2>&1 \
+    || { echo "fleet smoke: prof --fleet failed"; cat "$fleet_dir/prof.log"; exit 1; }
+python - "$fleet_dir/run/fleet_report.json" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["ranks_present"] == [0, 1], rep["ranks_present"]
+assert rep["skew"]["steps_analyzed"] > 0, rep["skew"]
+assert rep["skew"]["slowest"]["rank"] in (0, 1), rep["skew"]
+comms = rep["comms"]
+assert comms["available"], comms
+assert comms["unattributed_bytes"] == 0, comms
+assert all(k["claimed"] for k in comms["kinds"]), comms
+counts = {r["rank"]: r["steps"] for r in rep["ranks"]}
+print(f"fleet smoke OK (ranks {sorted(counts)}, {counts[0]} steps each, "
+      f"dispatch skew p50 {rep['skew']['dispatch_spread_ms_p50']} ms, "
+      f"slowest rank {rep['skew']['slowest']['rank']}, "
+      f"0 unattributed collective bytes)")
+EOF
+python scripts/bench_check.py --fleet-report "$fleet_dir/run/fleet_report.json" \
+    || { echo "fleet smoke: bench_check refused the fleet report"; exit 1; }
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

@@ -1,0 +1,58 @@
+"""Capability probe: can THIS box execute a multi-process CPU
+collective?  (test_multiprocess.py's skip fixture.)
+
+Forming the jax.distributed cluster is not the hard part — some jaxlib
+CPU backends form it fine and then refuse to RUN a cross-process
+computation ("Multiprocess computations aren't implemented on the CPU
+backend").  The probe does the minimal end-to-end thing: join the
+cluster, build the global mesh, and run one jitted psum across it.  It
+uses only jax + the repo's version-compat shims (no loss code), so a
+probe failure is an environment limit, never a framework bug — exactly
+the distinction the skip fixture needs.
+
+Usage: mp_probe.py <process_id> <num_processes> <port>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from npairloss_tpu.parallel import (
+        data_parallel_mesh,
+        initialize_distributed,
+        process_local_batch,
+        shard_map,
+    )
+
+    initialize_distributed(f"localhost:{port}", nproc, proc_id)
+    assert jax.process_count() == nproc, jax.process_count()
+    mesh = data_parallel_mesh()
+    x = np.full((jax.local_device_count(),), float(proc_id + 1), np.float32)
+    (gx,) = process_local_batch(mesh, (x,))
+    out = jax.jit(
+        shard_map(
+            lambda v: jax.lax.psum(v.sum(), "dp")[None],
+            mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"),
+        )
+    )(gx)
+    got = float(np.asarray(out.addressable_shards[0].data)[0])
+    want = sum(
+        (p + 1) * jax.local_device_count() for p in range(nproc)
+    )
+    assert got == want, (got, want)
+    sys.stdout.write("PROBE_OK\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,6 +145,29 @@ def main() -> int:
     m = solver.step(f_mine, l_mine)
     assert np.isfinite(float(m["loss"])), m
 
+    # Fleet observatory leg (obs.fleet): every rank opens rank-stamped
+    # telemetry on the SAME shared run dir and trains a few more steps
+    # — rank-disjoint streams, step-numbered dispatch spans, per-step
+    # comm marks, and rank 0's fleet_comms.json all land for the
+    # parent test to aggregate with `build_fleet_report`.
+    from npairloss_tpu.obs import RunTelemetry
+
+    fleet_dir = os.path.join(out_dir, "fleet_run")
+    tel = RunTelemetry(fleet_dir, fleet=True)
+    tel.write_manifest(config={"harness": "mp_worker"})
+    assert tel.fleet is not None and tel.fleet.process_count == nproc
+    solver.telemetry = tel
+
+    def batches():
+        while True:
+            yield f_mine, l_mine
+
+    solver.train(batches(), num_iters=5, log_fn=lambda s: None)
+    tel.close()
+    assert os.path.exists(
+        os.path.join(fleet_dir, f"telemetry.r{proc_id}.jsonl")
+    ), "rank stream missing"
+
     with open(os.path.join(out_dir, f"ok_{proc_id}"), "w") as fh:
         fh.write(f"loss={float(m['loss']):.6f} pool={len(pool)}\n")
     return 0
