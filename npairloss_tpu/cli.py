@@ -250,6 +250,14 @@ def _build_solver(args):
 
 
 def cmd_train(args) -> int:
+    if getattr(args, "metrics_port", None) and \
+            not getattr(args, "live_obs", False):
+        # The exporter serves the live registry; without --live-obs
+        # there is none — refuse up front rather than train for hours
+        # while the scraper gets connection-refused.
+        log.error("--metrics-port needs --live-obs (there is no "
+                  "metric registry to export without it)")
+        return 2
     # The MPI_COMM_WORLD replacement: must run before the first backend
     # query (exactly as MPI_Init precedes any communicator use).
     from npairloss_tpu.parallel import initialize_distributed
@@ -370,10 +378,51 @@ def cmd_train(args) -> int:
         solver.preempt = preempt
 
     telemetry = None
+    live = None
+    exporter = None
     tel_dir = getattr(args, "telemetry_dir", None)
     trace_dir = getattr(args, "trace_dir", None)
     record_fn, log_file = None, None
     try:
+        if getattr(args, "live_obs", False):
+            # Live observatory (docs/OBSERVABILITY.md §Live): watchdog
+            # SLOs over the run's own telemetry rows, alerts.jsonl in
+            # the run dir, optional /metrics on --metrics-port.
+            if not tel_dir:
+                log.error("--live-obs needs --telemetry-dir (the "
+                          "registry is fed by the run's metric rows)")
+                return 2
+            from npairloss_tpu.obs.live import (
+                LiveObservatory,
+                default_watchdogs,
+                load_slo_config,
+            )
+
+            if getattr(args, "slo_config", None):
+                specs = load_slo_config(args.slo_config)
+            else:
+                specs = default_watchdogs("train")
+            live = LiveObservatory(specs, out_dir=tel_dir)
+
+            def _snapshot_age_probe():
+                # Newest committed snapshot's manifest age — state the
+                # process already has on disk, polled per tick.
+                from npairloss_tpu.resilience.snapshot import (
+                    list_snapshots,
+                )
+                from npairloss_tpu.train import snapshot_info
+
+                snaps = list_snapshots(solver.cfg.snapshot_prefix)
+                if not snaps:
+                    return
+                created = snapshot_info(snaps[-1][1])["created"]
+                if created is not None:
+                    import time as _time
+
+                    live.registry.set("train_snapshot_age_s",
+                                      max(_time.time() - created, 0.0))
+
+            live.add_probe(_snapshot_age_probe)
         if tel_dir or trace_dir:
             import dataclasses
 
@@ -401,6 +450,7 @@ def cmd_train(args) -> int:
                 telemetry = RunTelemetry(
                     tel_dir or trace_dir, metrics=bool(tel_dir),
                     fleet=fleet_on,
+                    extra_sinks=(live.sink,) if live is not None else (),
                 )
                 if tel_dir:
                     from npairloss_tpu.parallel import mesh_topology
@@ -439,6 +489,19 @@ def cmd_train(args) -> int:
                 log_file = JsonlSink(args.log_json)
                 record_fn = log_file.log
 
+        if live is not None:
+            live.start(period_s=args.slo_tick)
+            if getattr(args, "metrics_port", None):
+                from npairloss_tpu.obs.live import start_http_exporter
+
+                # Train has no HTTP surface of its own — an opt-in
+                # localhost exporter serves /metrics (+ /healthz with
+                # SLO status) for scrapers.
+                exporter = start_http_exporter(
+                    live.registry, args.metrics_port,
+                    health_fn=lambda: {"ok": True, **live.health()},
+                )
+
         # max_iter override was already baked into solver.cfg by
         # _build_solver; train() falls back to it — one source of truth.
         preempted = None
@@ -465,6 +528,17 @@ def cmd_train(args) -> int:
         # propagating past this finally.
         if preempt is not None:
             preempt.uninstall()
+        if exporter is not None:
+            try:
+                exporter.shutdown()
+                exporter.server_close()
+            except Exception as e:
+                log.error("metrics exporter shutdown failed: %s", e)
+        if live is not None:
+            try:
+                live.stop()  # final tick lands pending alert transitions
+            except Exception as e:
+                log.error("live-obs stop failed: %s", e)
         if log_file is not None:
             try:
                 log_file.close()
@@ -915,9 +989,10 @@ def cmd_serve(args) -> int:
         if found is None:
             log.error("no valid index under prefix %r", args.index_prefix)
             return 2
-        path, index = found
-        log.info("serving index %s", path)
+        index_path, index = found
+        log.info("serving index %s", index_path)
     else:
+        index_path = os.path.abspath(args.index)
         index = GalleryIndex.load(args.index, mesh=mesh)
 
     model = state = None
@@ -933,12 +1008,35 @@ def cmd_serve(args) -> int:
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
     telemetry = None
+    live = None
     tel_dir = getattr(args, "telemetry_dir", None)
     trace_dir = getattr(args, "trace_dir", None)
+    if getattr(args, "live_obs", False):
+        # Live observatory (docs/OBSERVABILITY.md §Live): the registry
+        # is FED by the telemetry rows, so live obs without a metrics
+        # stream would silently watch nothing — refuse loudly.
+        if not tel_dir:
+            log.error("--live-obs needs --telemetry-dir (the registry "
+                      "is fed by the run's metric rows)")
+            return 2
+        from npairloss_tpu.obs.live import (
+            LiveObservatory,
+            default_watchdogs,
+            load_slo_config,
+        )
+
+        if getattr(args, "slo_config", None):
+            specs = load_slo_config(args.slo_config)
+        else:
+            specs = default_watchdogs("serve", max_queue=args.max_queue)
+        live = LiveObservatory(specs, out_dir=tel_dir)
     if tel_dir or trace_dir:
         from npairloss_tpu.obs import RunTelemetry
 
-        telemetry = RunTelemetry(tel_dir or trace_dir, metrics=bool(tel_dir))
+        telemetry = RunTelemetry(
+            tel_dir or trace_dir, metrics=bool(tel_dir),
+            extra_sinks=(live.sink,) if live is not None else (),
+        )
         if tel_dir:
             telemetry.write_manifest(config={
                 "serve": True,
@@ -947,6 +1045,8 @@ def cmd_serve(args) -> int:
                 "buckets": list(buckets),
                 "deadline_ms": args.deadline_ms,
                 "max_queue": args.max_queue,
+                "live_obs": live is not None,
+                "slo_config": getattr(args, "slo_config", None),
             })
 
     preempt = PreemptionSignal().install()
@@ -959,6 +1059,12 @@ def cmd_serve(args) -> int:
         )
         if not args.no_warmup:
             engine.warmup(input_shape)
+        from npairloss_tpu.serve import Freshness
+
+        freshness = Freshness.collect(
+            index=index, index_path=index_path,
+            snapshot_path=args.snapshot or None,
+        )
         server = RetrievalServer(
             engine,
             BatcherConfig(max_batch=buckets[-1],
@@ -966,17 +1072,91 @@ def cmd_serve(args) -> int:
                           max_queue=args.max_queue),
             ServerConfig(metrics_window=args.metrics_window),
             telemetry=telemetry, preempt=preempt,
+            freshness=freshness, live=live,
         )
+        if live is not None:
+            # Freshness probe: ages are server state, not metric rows —
+            # each evaluator tick republishes them so the staleness
+            # watchdogs see a continuous stream.
+            def _freshness_probe():
+                for key, v in freshness.ages().items():
+                    live.registry.set(f"serve_{key}", v)
+
+            live.add_probe(_freshness_probe)
+            # Started AFTER warmup: the first windows must reflect
+            # serving, not seconds-long XLA compiles.
+            live.start(period_s=args.slo_tick)
         if args.http is not None:
             return server.run_http(args.http)
         return server.run_jsonl(_sys.stdin, _sys.stdout)
     finally:
         preempt.uninstall()
+        if live is not None:
+            try:
+                # Final tick inside: an alert state that changed right
+                # before the drain still reaches alerts.jsonl.
+                live.stop()
+            except Exception as e:  # noqa: BLE001
+                log.error("live-obs stop failed: %s", e)
         if telemetry is not None:
             try:
                 telemetry.close()
             except Exception as e:  # noqa: BLE001
                 log.error("telemetry close failed: %s", e)
+
+
+def cmd_watch(args) -> int:
+    """``watch RUNDIR`` — the live observatory's OFFLINE feed
+    (docs/OBSERVABILITY.md §Live): tail a run directory's telemetry
+    streams (legacy metrics.jsonl and the fleet per-rank
+    telemetry.r<k>.jsonl alike) through the SAME SLO engine the
+    in-process path runs, each record evaluated at its own wall_time —
+    one evaluator, two feeds.  Backend-free: no jax object is ever
+    built, so it runs on any box that can read the artifacts."""
+    from npairloss_tpu.obs.live import (
+        default_watchdogs,
+        load_slo_config,
+        watch_run_dir,
+    )
+
+    if args.slo_config:
+        specs = load_slo_config(args.slo_config)
+    else:
+        specs = []
+        seen = set()
+        for kind in args.watchdogs.split(","):
+            kind = kind.strip()
+            if not kind:
+                continue
+            for spec in default_watchdogs(kind):
+                if spec.name not in seen:
+                    seen.add(spec.name)
+                    specs.append(spec)
+        if not specs:
+            log.error("--watchdogs %r names no presets", args.watchdogs)
+            return 2
+
+    def emit(event) -> None:
+        print(json.dumps(event), flush=True)
+
+    try:
+        summary = watch_run_dir(
+            args.run_dir, specs,
+            follow=args.follow, poll_s=args.poll_s,
+            out_path=args.out, emit=emit,
+            stop_after_s=getattr(args, "for_s", None),
+        )
+    except FileNotFoundError as e:
+        log.error("%s", e)
+        return 2
+    except KeyboardInterrupt:
+        print("", file=sys.stderr)
+        return 0
+    print(json.dumps(summary, default=str))
+    # Exit code mirrors the bench_check --alerts gate: an SLO still
+    # burning when the watch ends is an actionable state for scripts.
+    return 1 if any(a["severity"] == "critical"
+                    for a in summary["active"].values()) else 0
 
 
 def cmd_parse(args) -> int:
@@ -1669,6 +1849,31 @@ def main(argv: Optional[list] = None) -> int:
         "— needs --telemetry-dir; docs/OBSERVABILITY.md §Perf",
     )
     t.add_argument(
+        "--live-obs", dest="live_obs", action="store_true",
+        help="live observatory (docs/OBSERVABILITY.md §Live): feed this "
+        "run's telemetry rows into the in-process metric registry, "
+        "evaluate SLO watchdogs continuously, and append firing/resolved "
+        "alerts to <telemetry-dir>/alerts.jsonl (npairloss-alerts-v1); "
+        "needs --telemetry-dir; the telemetry streams on disk stay "
+        "byte-identical",
+    )
+    t.add_argument(
+        "--slo-config", dest="slo_config", metavar="PATH",
+        help="SLO config (JSON; TOML on tomllib-equipped interpreters): "
+        "watchdog presets by name plus explicit SLO entries — default: "
+        "the standard train watchdogs",
+    )
+    t.add_argument(
+        "--slo-tick", dest="slo_tick", type=float, default=1.0,
+        metavar="S",
+        help="live-obs evaluation period in seconds (default 1.0)",
+    )
+    t.add_argument(
+        "--metrics-port", dest="metrics_port", type=int, metavar="PORT",
+        help="with --live-obs: serve Prometheus /metrics (+ /healthz "
+        "with SLO status) on this localhost port",
+    )
+    t.add_argument(
         "--debug-checks", dest="debug_checks", action="store_true",
         help="validate every step's loss/metric scalars are finite on "
         "host (utils.debug.enable_debug_checks; also settable via "
@@ -1848,6 +2053,24 @@ def main(argv: Optional[list] = None) -> int:
         "--compile-cache): replica restarts deserialize the warmed "
         "buckets instead of recompiling",
     )
+    sv.add_argument(
+        "--live-obs", dest="live_obs", action="store_true",
+        help="live observatory (docs/OBSERVABILITY.md §Live): SLO "
+        "watchdogs over the serve window rows, alerts.jsonl in the "
+        "telemetry dir, /metrics + SLO-enriched /healthz on the --http "
+        "front end; needs --telemetry-dir",
+    )
+    sv.add_argument(
+        "--slo-config", dest="slo_config", metavar="PATH",
+        help="SLO config (JSON/TOML) — default: the standard serve "
+        "watchdogs (p99, queue saturation, post-warmup compiles, "
+        "index/model staleness)",
+    )
+    sv.add_argument(
+        "--slo-tick", dest="slo_tick", type=float, default=1.0,
+        metavar="S",
+        help="live-obs evaluation period in seconds (default 1.0)",
+    )
     sv_tel = sv.add_mutually_exclusive_group()
     sv_tel.add_argument(
         "--telemetry-dir", dest="telemetry_dir", metavar="DIR",
@@ -2018,6 +2241,43 @@ def main(argv: Optional[list] = None) -> int:
                     help="report output directory (default: perf_reports "
                     "for live profiles, the run dir itself for --fleet)")
     pr.set_defaults(fn=cmd_prof)
+
+    w = sub.add_parser(
+        "watch",
+        help="evaluate SLO watchdogs over a run directory's telemetry "
+        "offline (the live observatory's second feed; no backend)",
+    )
+    w.add_argument("run_dir", metavar="RUNDIR",
+                   help="run directory holding metrics.jsonl or "
+                   "per-rank telemetry.r<k>.jsonl streams")
+    w.add_argument(
+        "--slo-config", dest="slo_config", metavar="PATH",
+        help="SLO config (JSON/TOML); default: the --watchdogs presets",
+    )
+    w.add_argument(
+        "--watchdogs", default="train,serve",
+        help="comma-separated watchdog preset kinds when no --slo-config "
+        "(default train,serve — a kind whose metrics never appear "
+        "just stays ok)",
+    )
+    w.add_argument(
+        "--follow", action="store_true",
+        help="keep tailing the streams instead of one replay pass",
+    )
+    w.add_argument(
+        "--poll-s", dest="poll_s", type=float, default=1.0,
+        help="--follow poll period (default 1.0)",
+    )
+    w.add_argument(
+        "--for", dest="for_s", type=float, default=None, metavar="S",
+        help="stop --follow after S seconds (default: until interrupted)",
+    )
+    w.add_argument(
+        "--out", metavar="PATH",
+        help="alert JSONL output (default RUNDIR/alerts.watch.jsonl — "
+        "never the in-process engine's alerts.jsonl)",
+    )
+    w.set_defaults(fn=cmd_watch)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")
     pp.add_argument("file")

@@ -14,6 +14,11 @@ Three coordinated parts:
     per-rank file streams, collective/comms attribution, offline
     straggler/skew aggregation (``prof --fleet``), and merged
     cross-rank Perfetto timelines;
+  * ``obs.live`` — the ONLINE layer (§Live observatory): in-process
+    metric registry fed by the telemetry streams, declarative SLOs
+    with burn-rate alerting, Prometheus ``/metrics``, and per-answer
+    freshness — imported explicitly (``npairloss_tpu.obs.live``), not
+    re-exported here, so the no-live-obs path pays nothing;
 
 tied together per run by ``obs.run.RunTelemetry`` (run dir with
 ``manifest.json`` + ``metrics.jsonl`` + ``trace.json``).

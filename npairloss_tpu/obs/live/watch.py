@@ -1,0 +1,185 @@
+"""``watch RUNDIR`` — the offline feed of the ONE SLO engine.
+
+A live process evaluates SLOs over rows as they are emitted; ``watch``
+evaluates the SAME specs over the rows a run directory already holds
+(and, with ``follow=True``, keeps tailing as ranks append) — one
+evaluator, two feeds.  Replay is deterministic: each record's own
+``wall_time`` drives the evaluation clock, so re-running watch over the
+same stream produces the same alert sequence the in-process engine
+would have produced from those rows (pinned by tests/test_live.py).
+
+Reads both telemetry layouts: the legacy ``metrics.jsonl`` and the
+fleet observatory's rank-suffixed ``telemetry.r<k>.jsonl`` files —
+per-rank streams merge by ``wall_time`` so the fleet straggler watchdog
+sees the interleaved frontier.  Torn tail lines (a rank mid-write) are
+skipped, never fatal — the fleet aggregator's contract.
+
+Stdlib-only and backend-free: watch must run on the box where the
+artifacts are, whether or not jax can even initialize there.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from npairloss_tpu.obs.live.live import LiveObservatory
+from npairloss_tpu.obs.live.slo import SLOSpec
+
+WATCH_ALERTS_FILENAME = "alerts.watch.jsonl"
+
+
+def telemetry_paths(run_dir: str) -> List[str]:
+    """The run dir's metric streams: legacy + rank-suffixed layouts."""
+    paths = []
+    legacy = os.path.join(run_dir, "metrics.jsonl")
+    if os.path.exists(legacy):
+        paths.append(legacy)
+    paths.extend(sorted(glob.glob(
+        os.path.join(run_dir, "telemetry.r*.jsonl"))))
+    return paths
+
+
+class _Tail:
+    """Byte-offset tailer for one JSONL stream: each poll returns the
+    newly-completed lines; a torn final line stays buffered until its
+    newline arrives (counted, never parsed half-written)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.torn = 0
+
+    def poll(self) -> List[Dict[str, Any]]:
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                chunk = f.read()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        # Only consume up to the last newline: the tail beyond it is a
+        # line still being written.
+        cut = chunk.rfind(b"\n")
+        if cut < 0:
+            return []
+        self.offset += cut + 1
+        records = []
+        for line in chunk[:cut + 1].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line.decode("utf-8", "replace")))
+            except ValueError:
+                self.torn += 1
+        return records
+
+
+def replay_records(
+    records: Sequence[Dict[str, Any]],
+    specs: Sequence[SLOSpec],
+    out_path: Optional[str] = None,
+    min_ticks: int = 1,
+) -> Tuple[LiveObservatory, List[Dict[str, Any]]]:
+    """Deterministic offline evaluation: feed ``records`` (already
+    merged, ``wall_time``-ascending) through a fresh observatory,
+    ticking at every record's own wall_time.  Returns the observatory
+    and the full alert-event list — the function BOTH ``watch`` and the
+    in-process-agreement test call, so the two feeds cannot drift."""
+    obs = LiveObservatory(specs, out_dir=None, min_ticks=min_ticks)
+    if out_path:
+        from npairloss_tpu.obs.live.alerts import AlertEngine
+
+        obs.alerts = AlertEngine(out_path, min_ticks=min_ticks)
+    events: List[Dict[str, Any]] = []
+    for rec in records:
+        obs.sink.log(rec)
+        t = rec.get("wall_time")
+        if isinstance(t, (int, float)):
+            events.extend(obs.tick(now=float(t)))
+    return obs, events
+
+
+def watch_run_dir(
+    run_dir: str,
+    specs: Sequence[SLOSpec],
+    follow: bool = False,
+    poll_s: float = 1.0,
+    out_path: Optional[str] = None,
+    emit=None,
+    stop_after_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Evaluate ``specs`` over a run directory's telemetry.
+
+    One-shot (``follow=False``): replay everything on disk, return the
+    summary.  Follow mode: keep tailing all streams, ticking each new
+    record at its wall_time, until ``stop_after_s`` (None = until
+    interrupted).  ``emit`` (callable) receives each alert event as it
+    happens — the CLI prints them.  Alert events land in ``out_path``
+    (default ``<run_dir>/alerts.watch.jsonl`` — NOT alerts.jsonl, so
+    watching a live run never interleaves with the in-process engine's
+    own log).
+    """
+    run_dir = os.path.abspath(run_dir)
+    paths = telemetry_paths(run_dir)
+    if not paths:
+        raise FileNotFoundError(
+            f"{run_dir}: no metrics.jsonl or telemetry.r*.jsonl stream")
+    if out_path is None:
+        out_path = os.path.join(run_dir, WATCH_ALERTS_FILENAME)
+    obs = LiveObservatory(specs, out_dir=None)
+    from npairloss_tpu.obs.live.alerts import AlertEngine
+
+    obs.alerts = AlertEngine(out_path)
+    tails = [_Tail(p) for p in paths]
+    rows = 0
+    last_t: List[Optional[float]] = [None]
+    events: List[Dict[str, Any]] = []
+
+    def drain_once() -> int:
+        nonlocal rows
+        fresh: List[Dict[str, Any]] = []
+        for tail in tails:
+            fresh.extend(tail.poll())
+        fresh.sort(key=lambda r: r.get("wall_time", 0))
+        for rec in fresh:
+            obs.sink.log(rec)
+            t = rec.get("wall_time")
+            if isinstance(t, (int, float)):
+                last_t[0] = float(t)
+                for ev in obs.tick(now=float(t)):
+                    events.append(ev)
+                    if emit is not None:
+                        emit(ev)
+        rows += len(fresh)
+        return len(fresh)
+
+    t0 = time.time()
+    drain_once()
+    while follow:
+        if stop_after_s is not None and time.time() - t0 >= stop_after_s:
+            break
+        time.sleep(poll_s)
+        drain_once()
+    obs.alerts.close()
+    active = obs.alerts.active()
+    return {
+        "run_dir": run_dir,
+        "streams": paths,
+        "rows": rows,
+        "torn_lines": sum(t.torn for t in tails),
+        "alerts_log": out_path,
+        "events": len(events),
+        "alerts_active": len(active),
+        "active": active,
+        # Status as of the LAST ingested record's wall time — a replay
+        # of a long-finished run evaluated at real now would see an
+        # empty window and print every SLO as ok right next to an
+        # active alert in the same summary.
+        "slo": obs.evaluator.status_dict(last_t[0]),
+    }

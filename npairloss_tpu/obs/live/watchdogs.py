@@ -1,0 +1,226 @@
+"""Domain watchdogs — named SLO presets wired to signals the repo
+already computes.
+
+Each builder returns an :class:`slo.SLOSpec` targeting a metric the
+:class:`registry.RegistrySink` (or a freshness probe) already
+publishes from the EXISTING telemetry streams — no new instrumentation
+call sites.  ``default_watchdogs(kind)`` bundles the standard set per
+run kind; an SLO config pulls them in by name (``"watchdogs":
+["serve"]``) and can override any of them by restating the name
+(docs/OBSERVABILITY.md §Live observatory has the runbook: which
+watchdog means what, and what to do when it fires).
+
+Stdlib-only, like the whole package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from npairloss_tpu.obs.live.slo import SLOSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+LAST_GOOD = os.path.join(REPO, "bench_cache", "last_good.json")
+
+
+# -- serve watchdogs ----------------------------------------------------------
+
+
+def serve_p99(target_ms: float = 250.0, window_s: float = 30.0,
+              severity: str = "critical") -> SLOSpec:
+    """Tail latency: the serve window rows' p99 (the Gemma-serving
+    operating target).  Fires when half the recent windows blow the
+    bar — one slow window is noise, a burning half-minute is an
+    incident."""
+    return SLOSpec(
+        name="serve_p99", metric="serve_p99_ms", op="<=",
+        target=target_ms, window_s=window_s, burn_threshold=0.5,
+        min_samples=2, severity=severity,
+        description="serve p99 latency over the rolling window",
+    )
+
+
+def serve_queue_saturation(max_queue: int = 256,
+                           fraction: float = 0.8,
+                           window_s: float = 30.0) -> SLOSpec:
+    """Admission-queue depth approaching the backpressure bound: the
+    engine is falling behind offered load.  Past the bound, submits
+    reject — this fires BEFORE clients start seeing QueueFullError."""
+    return SLOSpec(
+        name="serve_queue_saturation", metric="serve_queue_depth",
+        op="<=", target=float(max_queue) * fraction, window_s=window_s,
+        burn_threshold=0.5, min_samples=2, severity="warning",
+        description="admission queue depth vs the backpressure bound",
+    )
+
+
+def post_warmup_compile(window_s: float = 3600.0) -> SLOSpec:
+    """The strict serve compile guard's counting twin, non-fatal: ANY
+    post-warmup XLA compile in the serving hot path is an SLO burn
+    (the window row carries ``compiles_after_warmup`` only when > 0).
+    Where ``NPAIRLOSS_SERVE_COMPILE_GUARD=strict`` would kill the
+    server, this pages instead — the production posture."""
+    return SLOSpec(
+        name="serve_post_warmup_compile",
+        metric="serve_compiles_after_warmup", op="<=", target=0.0,
+        window_s=window_s, burn_threshold=0.01, min_samples=1,
+        severity="warning",
+        description="post-warmup XLA compiles in the serving hot path",
+    )
+
+
+def index_staleness(max_age_s: float = 3600.0,
+                    severity: str = "warning") -> SLOSpec:
+    """Gallery freshness (ROADMAP item 4): the served index's commit
+    age.  A retrieval tier answering from an hour-old gallery is the
+    recommendation-system failure mode (Tensor Casting, PAPERS.md)."""
+    return SLOSpec(
+        name="index_staleness", metric="serve_index_age_s", op="<=",
+        target=max_age_s, window_s=max(max_age_s / 4, 60.0),
+        burn_threshold=0.5, min_samples=1, severity=severity,
+        description="age of the served gallery index commit",
+    )
+
+
+def model_staleness(max_age_s: float = 4 * 3600.0,
+                    severity: str = "warning") -> SLOSpec:
+    """Model freshness: wall age of the restored snapshot behind the
+    encode path (absent-metric = ok for embedding-only serving)."""
+    return SLOSpec(
+        name="model_staleness", metric="serve_model_age_s", op="<=",
+        target=max_age_s, window_s=max(max_age_s / 4, 60.0),
+        burn_threshold=0.5, min_samples=1, severity=severity,
+        description="wall age of the restored model snapshot",
+    )
+
+
+# -- train watchdogs ----------------------------------------------------------
+
+
+def nonfinite_loss_streak(window_s: float = 120.0) -> SLOSpec:
+    """Consecutive non-finite losses — the divergence guard's
+    pre-rollback early warning: the guard acts at ``patience``; this
+    pages at the FIRST streak so a human sees the run destabilizing
+    before params are rolled back."""
+    return SLOSpec(
+        name="train_nonfinite_streak", metric="train_nonfinite_streak",
+        op="<=", target=0.0, window_s=window_s, burn_threshold=0.25,
+        min_samples=1, severity="critical",
+        description="consecutive non-finite training losses",
+    )
+
+
+def train_throughput_floor(floor_emb_per_sec: float,
+                           window_s: float = 600.0) -> SLOSpec:
+    """Throughput vs the committed BENCH bar (needs ``--perf-metrics``
+    rows): a multi-day run silently degrading to half its benched
+    emb/s is exactly the regression the post-hoc gate catches a round
+    too late.  Pass :func:`bench_floor_emb_per_sec` (with margin) as
+    the floor — on hardware that never benched, don't arm this."""
+    return SLOSpec(
+        name="train_throughput_floor", metric="perf_emb_per_sec",
+        op=">=", target=floor_emb_per_sec, window_s=window_s,
+        burn_threshold=0.5, min_samples=2, severity="warning",
+        description="training emb/s vs the committed bench floor",
+    )
+
+
+def snapshot_staleness(max_age_s: float = 1800.0) -> SLOSpec:
+    """Time since the newest committed snapshot (fed by the snapshot
+    probe): a stalled snapshot cadence silently converts the next
+    preemption from a resume into lost hours."""
+    return SLOSpec(
+        name="snapshot_staleness", metric="train_snapshot_age_s",
+        op="<=", target=max_age_s, window_s=max(max_age_s / 4, 60.0),
+        burn_threshold=0.5, min_samples=1, severity="warning",
+        description="age of the newest committed training snapshot",
+    )
+
+
+def embedding_collapse(threshold: float = 0.98,
+                       window_s: float = 600.0) -> SLOSpec:
+    """Embedding-space collapse from the PR 2 health signals (needs
+    ``--health-metrics`` rows): the mean negative-mining threshold
+    (mean pairwise cosine of the mined frontier) trending to ~1 means
+    every pair looks alike — the space is degenerating.  The
+    companion norm-spread signal is ``train_emb_mag_spread`` (max/mean
+    row norm, derived by the sink)."""
+    return SLOSpec(
+        name="embedding_collapse", metric="train_an_threshold_mean",
+        op="<=", target=threshold, window_s=window_s,
+        burn_threshold=0.5, min_samples=3, severity="warning",
+        description="mean pairwise cosine of mined negatives "
+                    "trending degenerate",
+    )
+
+
+def fleet_straggler(max_step_lag: float = 2.0,
+                    window_s: float = 300.0) -> SLOSpec:
+    """Persistent straggler lag across rank-stamped streams (the fleet
+    observatory's offline skew report, live): max-minus-min of the
+    per-rank step frontier.  Transient jitter self-heals; a rank
+    persistently N steps behind is a sick host."""
+    return SLOSpec(
+        name="fleet_straggler", metric="fleet_step_lag", op="<=",
+        target=max_step_lag, window_s=window_s, burn_threshold=0.5,
+        min_samples=3, severity="warning",
+        description="per-rank step-frontier lag (straggler persistence)",
+    )
+
+
+# -- presets ------------------------------------------------------------------
+
+
+def bench_floor_emb_per_sec(margin: float = 0.5,
+                            last_good_path: str = LAST_GOOD
+                            ) -> Optional[float]:
+    """The committed bench headline (bench_cache/last_good.json) scaled
+    by ``margin`` — the default train-throughput floor.  None when no
+    committed measurement exists (fresh checkout, new hardware):
+    DON'T arm the throughput watchdog on a floor you never measured."""
+    try:
+        with open(last_good_path) as f:
+            payload = json.load(f).get("payload") or {}
+    except (OSError, ValueError):
+        return None
+    value = payload.get("value")
+    if isinstance(value, (int, float)) and value > 0:
+        return float(value) * float(margin)
+    return None
+
+
+def default_watchdogs(kind: str, max_queue: int = 256,
+                      bench_floor: Optional[float] = None
+                      ) -> List[SLOSpec]:
+    """The standard watchdog set for a run kind.
+
+    ``serve``: p99, queue saturation, post-warmup compiles, index +
+    model staleness.  ``train``: non-finite streak, snapshot staleness,
+    embedding collapse, fleet straggler lag, plus the throughput floor
+    when ``bench_floor`` is given (see :func:`bench_floor_emb_per_sec`
+    — never armed implicitly, a CPU box must not page against a TPU
+    bar).
+    """
+    if kind == "serve":
+        return [
+            serve_p99(),
+            serve_queue_saturation(max_queue=max_queue),
+            post_warmup_compile(),
+            index_staleness(),
+            model_staleness(),
+        ]
+    if kind == "train":
+        specs = [
+            nonfinite_loss_streak(),
+            snapshot_staleness(),
+            embedding_collapse(),
+            fleet_straggler(),
+        ]
+        if bench_floor is not None:
+            specs.append(train_throughput_floor(bench_floor))
+        return specs
+    raise ValueError(
+        f"unknown watchdog kind {kind!r} (expected 'train' or 'serve')")

@@ -36,6 +36,15 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               the divergence guard; in the pipelined loop
                               the poison lands in the metric window at
                               the next boundary read)
+  ``serve.latency``           sleep ``SERVE_LATENCY_FAULT_S`` inside the
+                              serving dispatch (after warmup's path, so
+                              warmed compiles stay fast) — deterministic
+                              p99 spikes for driving the live-obs alert
+                              lifecycle (docs/OBSERVABILITY.md §Live)
+  ``serve.queue_stall``       stall the micro-batcher's dispatcher thread
+                              before it drains the queue, so admissions
+                              pile up — drives the queue-saturation
+                              watchdog and the backpressure path
   ==========================  =============================================
 
 ``times`` counts fires: an armed point fires its next ``times`` checks
@@ -53,6 +62,14 @@ from typing import Callable, Dict, Iterator, Optional
 log = logging.getLogger("npairloss_tpu.resilience")
 
 ENV_VAR = "NPAIRLOSS_FAILPOINTS"
+
+# Injected stall durations for the serving failpoints (seconds).  Module
+# constants rather than per-arm parameters: the env-arming syntax only
+# carries a count, and the alert-lifecycle tests need ONE deterministic
+# magnitude comfortably above any real dispatch (0.25 s >> a warmed
+# CPU top-k) yet short enough that a counted burst clears in seconds.
+SERVE_LATENCY_FAULT_S = 0.25
+SERVE_QUEUE_STALL_S = 0.25
 
 
 class InjectedFault(OSError):

@@ -28,11 +28,12 @@ from npairloss_tpu.serve.batcher import (
 )
 from npairloss_tpu.serve.engine import EngineConfig, QueryEngine
 from npairloss_tpu.serve.index import GalleryIndex
-from npairloss_tpu.serve.server import RetrievalServer, ServerConfig
+from npairloss_tpu.serve.server import Freshness, RetrievalServer, ServerConfig
 
 __all__ = [
     "BatcherConfig",
     "EngineConfig",
+    "Freshness",
     "GalleryIndex",
     "MicroBatcher",
     "QueryEngine",

@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from npairloss_tpu.resilience import failpoints
+
 log = logging.getLogger("npairloss_tpu.serve")
 
 _STOP = object()
@@ -178,6 +180,13 @@ class MicroBatcher:
                 continue
             if head is _STOP:
                 return
+            if failpoints.should_fire("serve.queue_stall"):
+                # Deterministic dispatcher stall (docs/RESILIENCE.md):
+                # admissions pile up behind the held queue, driving the
+                # queue-saturation watchdog and, past max_queue, the
+                # QueueFullError backpressure path — without touching
+                # the dispatch math.
+                time.sleep(failpoints.SERVE_QUEUE_STALL_S)
             batch = [head]
             deadline = head[2] + delay
             stop_after = False

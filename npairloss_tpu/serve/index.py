@@ -83,6 +83,12 @@ class GalleryIndex:
     size: int
     mesh: Optional[Mesh] = None
     axis: str = "dp"
+    # Freshness identity (docs/OBSERVABILITY.md §Live observatory): the
+    # wall time this gallery's content was committed/assembled —
+    # ``load`` takes it from the commit manifest, ``build``/``add``
+    # stamp now.  ``index_age_s`` on /healthz and per-answer freshness
+    # stamps derive from it.
+    created: Optional[float] = None
     # Host master copy (unpadded, normalized): add() re-pads + re-places
     # from here instead of pulling the gallery back off the mesh.
     _host_emb: Optional[np.ndarray] = None
@@ -122,9 +128,12 @@ class GalleryIndex:
                 raise ValueError(
                     f"ids {ids.shape} / embeddings {emb.shape} mismatch"
                 )
+        import time
+
         idx = cls(
             emb=None, labels=None, valid=None, ids=ids,  # type: ignore
             size=int(emb.shape[0]), mesh=mesh, axis=axis,
+            created=time.time(),
             _host_emb=emb, _host_labels=lab,
         )
         idx._place()
@@ -201,10 +210,15 @@ class GalleryIndex:
                 raise ValueError(
                     f"ids {ids.shape} / embeddings {emb.shape} mismatch"
                 )
+        import time
+
         self._host_emb = np.concatenate([self._host_emb, emb])
         self._host_labels = np.concatenate([self._host_labels, lab])
         self.ids = np.concatenate([self.ids, ids])
         self._place()
+        # Incremental content refresh IS a freshness event: the gallery
+        # now reflects this wall time, and index_age_s restarts from it.
+        self.created = time.time()
         return self.size
 
     # -- persistence (resilience.snapshot commit path) --------------------
@@ -287,10 +301,13 @@ class GalleryIndex:
                     f"unreadable index array {p}: {e}"
                 ) from e
         verify_restored(tree, manifest)
+        created = manifest.get("created")
         idx = cls(
             emb=None, labels=None, valid=None,  # type: ignore
             ids=np.asarray(tree["ids"], np.int64),
             size=int(tree["emb"].shape[0]), mesh=mesh, axis=axis,
+            created=(float(created)
+                     if isinstance(created, (int, float)) else None),
             _host_emb=np.asarray(tree["emb"], np.float32),
             _host_labels=np.asarray(tree["labels"], np.int32),
         )

@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from npairloss_tpu.resilience import failpoints
 from npairloss_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionSignal
 from npairloss_tpu.serve.batcher import (
     BatcherConfig,
@@ -51,6 +52,71 @@ from npairloss_tpu.serve.batcher import (
 from npairloss_tpu.serve.engine import QueryEngine
 
 log = logging.getLogger("npairloss_tpu.serve")
+
+
+@dataclasses.dataclass(frozen=True)
+class Freshness:
+    """What the serving tier is answering FROM, and how old it is
+    (ROADMAP item 4 first slice; docs/OBSERVABILITY.md §Live
+    observatory).  ``snapshot_*`` identify the restored model behind
+    the encode path (None for embedding-only serving);
+    ``index_created`` is the gallery's commit/assembly wall time
+    (``GalleryIndex.created``).  ``ages()`` turns both into seconds —
+    stamped on every answer, on ``/healthz``, and on the drain
+    summary, live-obs on or off."""
+
+    index_path: Optional[str] = None
+    index_created: Optional[float] = None
+    snapshot_path: Optional[str] = None
+    snapshot_step: Optional[int] = None
+    snapshot_created: Optional[float] = None
+
+    @classmethod
+    def collect(cls, index=None, index_path: Optional[str] = None,
+                snapshot_path: Optional[str] = None) -> "Freshness":
+        """From the served objects: the index's ``created`` attribute
+        plus the snapshot's commit manifest (``train.snapshot_info`` —
+        no array loads)."""
+        snap_step = snap_created = None
+        if snapshot_path is not None:
+            from npairloss_tpu.train import snapshot_info
+
+            info = snapshot_info(snapshot_path)
+            snapshot_path = info["path"]
+            snap_step, snap_created = info["step"], info["created"]
+        return cls(
+            index_path=index_path,
+            index_created=getattr(index, "created", None),
+            snapshot_path=snapshot_path,
+            snapshot_step=snap_step,
+            snapshot_created=snap_created,
+        )
+
+    def ages(self, now: Optional[float] = None) -> Dict[str, float]:
+        """``model_age_s``/``index_age_s`` — keys absent when the
+        corresponding identity is unknown (embedding-only serving has
+        no model age; a manifest-less index has no commit time), so a
+        consumer never mistakes "unknown" for "fresh"."""
+        now = time.time() if now is None else now
+        out: Dict[str, float] = {}
+        if self.index_created is not None:
+            out["index_age_s"] = round(max(now - self.index_created, 0.0), 3)
+        if self.snapshot_created is not None:
+            out["model_age_s"] = round(
+                max(now - self.snapshot_created, 0.0), 3)
+        return out
+
+    def identity(self) -> Dict[str, Any]:
+        """The non-age half (for /healthz + the drain summary): which
+        snapshot/index, omitting unknown fields."""
+        out: Dict[str, Any] = {}
+        if self.index_path is not None:
+            out["index_path"] = self.index_path
+        if self.snapshot_path is not None:
+            out["snapshot_path"] = self.snapshot_path
+        if self.snapshot_step is not None:
+            out["snapshot_step"] = self.snapshot_step
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,16 +141,30 @@ class RetrievalServer:
         cfg: ServerConfig = ServerConfig(),
         telemetry=None,
         preempt: Optional[PreemptionSignal] = None,
+        freshness: Optional[Freshness] = None,
+        live=None,
     ):
         self.engine = engine
         self.cfg = cfg
         self.telemetry = telemetry
         self.preempt = preempt
+        # Freshness identity (stamped on every answer + /healthz + the
+        # drain summary — live-obs on or off) and the optional
+        # LiveObservatory (obs.live): /metrics exposition + SLO status
+        # on /healthz.  Both default None: the pre-PR server shape.
+        self.freshness = freshness
+        self.live = live
         self.batcher = MicroBatcher(
             self._dispatch, batcher_cfg, span_fn=self._span,
             on_batch=self._record_batch,
         )
         self._lat = collections.deque(maxlen=max(cfg.latency_window, 1))
+        # THIS window's latencies, cleared at each emission: window rows
+        # report the window they describe (a live p99 watchdog must see
+        # recovery when behavior recovers — a 1024-deep running ring
+        # would keep an old incident's tail in every later row);
+        # the drain/healthz percentiles still read the smoothed ring.
+        self._window_lat: list = []
         self._lock = threading.Lock()
         self.queries = 0
         self.answered = 0
@@ -127,13 +207,15 @@ class RetrievalServer:
         qps, lat_snap = 0.0, None
         with self._lock:
             self._lat.append(seconds * 1e3)
+            self._window_lat.append(seconds * 1e3)
             self.answered += 1
             self._window_n += 1
             if (self.cfg.metrics_window
                     and self._window_n >= self.cfg.metrics_window):
                 now = time.perf_counter()
                 qps = self._window_n / max(now - self._window_t0, 1e-9)
-                lat_snap = list(self._lat)
+                lat_snap = self._window_lat
+                self._window_lat = []
                 self._window_t0 = now
                 self._window_n = 0
         if lat_snap is not None:
@@ -217,6 +299,12 @@ class RetrievalServer:
             **{f"batch_{k}": round(v, 3) if isinstance(v, float) else v
                for k, v in self._last_batch.items()},
         }
+        if self.engine.compiles_after_warmup:
+            # The strict guard's counting twin, in-row (the
+            # spans_dropped contract: present only when > 0, so clean
+            # streams stay byte-identical to pre-PR) — the live-obs
+            # post-warmup-compile watchdog reads exactly this key.
+            row["compiles_after_warmup"] = self.engine.compiles_after_warmup
         if self.telemetry is not None and self.telemetry.metrics_enabled:
             try:
                 self.telemetry.log("serve", self.answered, row)
@@ -236,6 +324,12 @@ class RetrievalServer:
         merge with the embedding records for one top-k dispatch."""
         from npairloss_tpu.serve.engine import ServeCompileError
 
+        if failpoints.should_fire("serve.latency"):
+            # Deterministic latency fault (docs/RESILIENCE.md): every
+            # query in this batch pays the stall — the p99 spike the
+            # live-obs alert lifecycle is tested against.  Sited here
+            # (not in the engine) so warmup's dispatches stay fast.
+            time.sleep(failpoints.SERVE_LATENCY_FAULT_S)
         dim = self.engine.index.dim
         answers: List[Optional[Dict[str, Any]]] = [None] * len(items)
         emb_rows: List[tuple] = []  # (item position, (D,) query row)
@@ -276,9 +370,14 @@ class RetrievalServer:
                                   "error": str(e)}
         if emb_rows:
             out = self.engine.query(np.stack([x for _, x in emb_rows]))
+            ages = (self.freshness.ages()
+                    if self.freshness is not None else {})
             for j, (i, _) in enumerate(emb_rows):
                 answers[i] = {
                     "id": items[i].get("id"),
+                    # Per-answer freshness stamp (ROADMAP item 4): how
+                    # old the model/index behind THIS answer is.
+                    **ages,
                     "neighbors": [
                         {
                             "rank": r,
@@ -347,6 +446,12 @@ class RetrievalServer:
             "errors": self.errors,
             "rejected": self.batcher.rejected,
             "batches": self.batcher.batches,
+            # Freshness identity + ages (live-obs on or off): what this
+            # run was answering from, and how stale it had become.
+            **(self.freshness.identity()
+               if self.freshness is not None else {}),
+            **(self.freshness.ages()
+               if self.freshness is not None else {}),
             **{k: round(v, 3) for k, v in self._percentiles().items()},
             # Whole-run latency split: where an answer's time went,
             # stage by stage (one read at drain, not per window; from
@@ -357,6 +462,20 @@ class RetrievalServer:
                if self._tracer() is not None else {}),
             **self.engine.compile_stats(),
         }
+
+    def healthz(self) -> Dict[str, Any]:
+        """The /healthz payload: liveness + the whole-run summary
+        (which now carries the freshness identity/ages), enriched with
+        per-SLO status and active alerts when a LiveObservatory is
+        attached — the JSON shape tests/test_live.py pins."""
+        out = {
+            "ok": True,
+            "draining": self._preempted(),
+            **self.summary(),
+        }
+        if self.live is not None:
+            out.update(self.live.health())
+        return out
 
     def _drain(self) -> Dict[str, Any]:
         """Finish in-flight batches, flush telemetry, return the
@@ -488,13 +607,28 @@ class RetrievalServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code: int, text: str, ctype: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._send(200, {
-                        "ok": True,
-                        "draining": server_ref._preempted(),
-                        **server_ref.summary(),
-                    })
+                    self._send(200, server_ref.healthz())
+                elif self.path == "/metrics":
+                    if server_ref.live is None:
+                        self._send(404, {
+                            "error": "live observatory not enabled "
+                                     "(serve --live-obs)"})
+                        return
+                    from npairloss_tpu.obs.live import prometheus_text
+
+                    self._send_text(
+                        200, prometheus_text(server_ref.live.registry),
+                        "text/plain; version=0.0.4")
                 else:
                     self._send(404, {"error": "unknown path"})
 

@@ -3,4 +3,5 @@ from npairloss_tpu.train.solver import (
     Solver,
     SolverConfig,
     restore_for_inference,
+    snapshot_info,
 )

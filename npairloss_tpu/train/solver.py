@@ -1761,5 +1761,30 @@ def restore_for_inference(
     return infer
 
 
+def snapshot_info(path: str) -> Dict[str, Any]:
+    """Freshness identity of a committed snapshot (docs/OBSERVABILITY.md
+    §Live observatory): ``{"path", "step", "created"}`` from the commit
+    manifest — no array loads, no Solver.  ``step``/``created`` are
+    None for manifest-less dirs (pre-resilience snapshots), so the
+    serving path can still report WHICH snapshot it restored even when
+    it cannot date it."""
+    import os
+
+    out: Dict[str, Any] = {
+        "path": os.path.abspath(path), "step": None, "created": None,
+    }
+    try:
+        manifest = read_manifest(path)
+    except (OSError, ValueError):
+        return out
+    step = manifest.get("step")
+    created = manifest.get("created")
+    if isinstance(step, int):
+        out["step"] = step
+    if isinstance(created, (int, float)):
+        out["created"] = float(created)
+    return out
+
+
 def _fmt(metrics: Dict[str, float]) -> str:
     return " ".join(f"{k}={float(v):.4g}" for k, v in sorted(metrics.items()))

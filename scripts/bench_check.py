@@ -218,6 +218,57 @@ def check_fleet_report(path: str) -> List[str]:
     return violations
 
 
+# -- alert-log gate -----------------------------------------------------------
+
+def _load_live_alerts():
+    """File-path-load ``obs.live.alerts`` WITHOUT importing the package
+    (the jax-free contract; same pattern as the fleet loader above —
+    alerts.py is deliberately self-contained, so no pre-seeding chain
+    is needed beyond its own name)."""
+    import importlib.util
+
+    name = "npairloss_tpu.obs.live.alerts"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO, "npairloss_tpu", "obs", "live",
+                               "alerts.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def check_alert_log(path: str) -> List[str]:
+    """Gate one ``npairloss-alerts-v1`` JSONL artifact: schema-valid
+    per the one contract (validate_alert_log), and no CRITICAL alert
+    left unresolved — a run that drained while a critical SLO was
+    still burning is a failed run, not a noisy one.  Resolved alerts
+    of any severity and unresolved warnings are evidence, not
+    failures."""
+    alerts = _load_live_alerts()
+    try:
+        records = alerts.load_alert_log(path)
+    except OSError as e:
+        return [f"alert log {path} unreadable: {e}"]
+    err = alerts.validate_alert_log(records)
+    if err is not None:
+        return [f"alert log schema-invalid: {err}"]
+    violations = []
+    for alert_id, slo, severity in alerts.unresolved_alerts(records):
+        if severity == "critical":
+            violations.append(
+                f"critical alert {alert_id!r} (SLO {slo!r}) still "
+                "firing at end of log — the run drained while burning")
+        else:
+            _log(f"unresolved {severity} alert {alert_id!r} "
+                 f"(SLO {slo!r}) — noted, not gated")
+    if not violations:
+        fired = sum(1 for r in records if r["state"] == "firing")
+        _log(f"alert log OK ({len(records)} event(s), {fired} "
+             "alert(s) fired)")
+    return violations
+
+
 # -- the gate -----------------------------------------------------------------
 
 def _spread(rec: Dict[str, Any]) -> float:
@@ -354,7 +405,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "per-rank step counts agree, zero unattributed collective "
         "bytes — the ci.sh fleet-smoke wiring",
     )
+    ap.add_argument(
+        "--alerts", metavar="PATH",
+        help="gate a live-observatory alert log instead of the bench "
+        "trajectory: schema-valid (npairloss-alerts-v1) and no "
+        "unresolved critical alert — the ci.sh live-obs-smoke wiring",
+    )
     args = ap.parse_args(argv)
+
+    if args.alerts:
+        violations = check_alert_log(args.alerts)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (alert log {args.alerts})")
+        return 0
 
     if args.fleet_report:
         violations = check_fleet_report(args.fleet_report)

@@ -375,6 +375,109 @@ EOF
 python scripts/bench_check.py --fleet-report "$fleet_dir/run/fleet_report.json" \
     || { echo "fleet smoke: bench_check refused the fleet report"; exit 1; }
 
+echo "== live observatory smoke (docs/OBSERVABILITY.md §Live) =="
+# The alert lifecycle end-to-end: a CLEAN serve run under an SLO config
+# fires ZERO alerts; a run with the serve.latency failpoint armed fires
+# the p99 alert and RESOLVES it once the injected fault clears; the
+# jax-free bench_check --alerts gate accepts that log and refuses one
+# holding an unresolved critical alert (and a schema violation).
+live_dir="$smoke_dir/live"
+mkdir -p "$live_dir"
+python - "$live_dir" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+rng = np.random.default_rng(0)
+emb = rng.standard_normal((256, 32)).astype(np.float32)
+emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+np.save(d + "/g.emb.npy", emb)
+np.save(d + "/g.labels.npy", (np.arange(256) % 16).astype(np.int32))
+with open(d + "/queries.jsonl", "w") as f:
+    for i in range(40):
+        f.write(json.dumps({"id": i, "embedding": emb[i].tolist()}) + "\n")
+json.dump({"slos": [{
+    "name": "p99", "metric": "serve_p99_ms", "op": "<=", "target": 150.0,
+    "window_s": 2.0, "burn_threshold": 0.5, "min_samples": 1,
+    "severity": "critical"}]}, open(d + "/slo.json", "w"))
+EOF
+JAX_PLATFORMS=cpu python -m npairloss_tpu index \
+    --emb "$live_dir/g.emb.npy" --labels "$live_dir/g.labels.npy" \
+    --no-normalize --out "$live_dir/g.gidx" > "$live_dir/index.log" 2>&1 \
+    || { echo "live smoke: index build failed"; cat "$live_dir/index.log"; exit 1; }
+
+run_live_serve() {  # $1 = telemetry dir, $2 = extra env (failpoints or "")
+    local tel="$1" fp="$2"
+    mkfifo "$live_dir/in.$$"
+    env JAX_PLATFORMS=cpu NPAIRLOSS_FAILPOINTS="$fp" \
+        python -m npairloss_tpu serve --index "$live_dir/g.gidx" \
+        --top-k 3 --buckets 1 --deadline-ms 1 --metrics-window 4 \
+        --telemetry-dir "$tel" --live-obs --slo-config "$live_dir/slo.json" \
+        --slo-tick 0.2 < "$live_dir/in.$$" > "$tel.answers.jsonl" \
+        2> "$tel.log" &
+    lpid=$!
+    exec 4> "$live_dir/in.$$"
+    # Throttled feed: a 40-query burst through single-query buckets
+    # would queue real ~100ms tails on a loaded CPU box — the CLEAN
+    # run must owe its p99 to dispatch alone, so the injected 250ms
+    # fault is the ONLY thing that can cross the 150ms bar.
+    head -20 "$live_dir/queries.jsonl" | while IFS= read -r ln; do
+        printf '%s\n' "$ln" >&4; sleep 0.05
+    done
+    sleep 3   # failpoint burst (if armed) fires + the alert with it
+    tail -20 "$live_dir/queries.jsonl" | while IFS= read -r ln; do
+        printf '%s\n' "$ln" >&4; sleep 0.05
+    done
+    sleep 3   # fault cleared: fast windows age the burn out -> resolve
+    kill -TERM "$lpid" 2>/dev/null || true
+    exec 4>&-
+    rc=0; wait "$lpid" || rc=$?
+    rm -f "$live_dir/in.$$"
+    [[ "$rc" -eq 75 ]] \
+        || { echo "live smoke: expected exit 75, got $rc"; cat "$tel.log"; exit 1; }
+}
+
+run_live_serve "$live_dir/clean" ""
+[[ ! -s "$live_dir/clean/alerts.jsonl" ]] \
+    || { echo "live smoke: CLEAN run fired alerts (false positives)"; cat "$live_dir/clean/alerts.jsonl"; exit 1; }
+python scripts/bench_check.py --alerts "$live_dir/clean/alerts.jsonl" \
+    || { echo "live smoke: gate refused the empty clean log"; exit 1; }
+
+run_live_serve "$live_dir/fault" "serve.latency:6"
+python - "$live_dir/fault/alerts.jsonl" <<'EOF'
+import json, sys
+records = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+states = [r["state"] for r in records]
+assert "firing" in states, f"latency failpoint never fired the p99 alert: {records}"
+assert states[-1] == "resolved", f"alert did not resolve after the fault cleared: {states}"
+assert all(r["slo"] == "p99" and r["severity"] == "critical" for r in records)
+fired = [r for r in records if r["state"] == "firing"]
+print(f"live smoke: p99 alert fired {len(fired)}x and resolved "
+      f"(worst window in message: {fired[0]['message'].split('worst ')[-1]}")
+EOF
+python scripts/bench_check.py --alerts "$live_dir/fault/alerts.jsonl" \
+    || { echo "live smoke: gate refused the resolved fire->resolve log"; exit 1; }
+# gate teeth: an unresolved critical (truncate the resolve off) and a
+# schema violation must both be refused
+head -1 "$live_dir/fault/alerts.jsonl" > "$live_dir/unresolved.jsonl"
+python scripts/bench_check.py --alerts "$live_dir/unresolved.jsonl" > /dev/null \
+    && { echo "live smoke: gate ACCEPTED an unresolved critical alert"; exit 1; }
+sed 's/npairloss-alerts-v1/npairloss-alerts-v0/' \
+    "$live_dir/fault/alerts.jsonl" > "$live_dir/badschema.jsonl"
+python scripts/bench_check.py --alerts "$live_dir/badschema.jsonl" > /dev/null \
+    && { echo "live smoke: gate ACCEPTED a schema violation"; exit 1; }
+# the offline feed agrees: watch over the fault run's telemetry must
+# reproduce a fire->resolve sequence through the SAME engine
+JAX_PLATFORMS=cpu python -m npairloss_tpu watch "$live_dir/fault" \
+    --slo-config "$live_dir/slo.json" > "$live_dir/watch.log" 2>&1 \
+    || { echo "live smoke: watch refused the run dir"; cat "$live_dir/watch.log"; exit 1; }
+python - "$live_dir/fault/alerts.watch.jsonl" <<'EOF'
+import json, sys
+states = [json.loads(ln)["state"] for ln in open(sys.argv[1]) if ln.strip()]
+assert "firing" in states and states[-1] == "resolved", states
+print(f"watch feed agrees: {states}")
+EOF
+echo "live observatory smoke OK (0 false positives, fire->resolve, gate teeth, watch agreement)"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

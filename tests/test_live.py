@@ -1,0 +1,839 @@
+"""Live observatory tests (docs/OBSERVABILITY.md §Live observatory).
+
+Covers the whole chain the ci.sh smoke drives end-to-end: registry /
+histogram semantics, the sink adapter's zero-footprint contract
+(byte-parity pin), SLO burn-rate math and hysteresis on hand-crafted
+fixtures, the npairloss-alerts-v1 validator's teeth, the
+watch-vs-in-process evaluator agreement, /metrics exposition format,
+freshness ages, the serve failpoints, and the bench_check --alerts
+gate.  Most tests are stdlib-only and sub-millisecond; the few that
+build a QueryEngine use tiny galleries.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from npairloss_tpu.obs.live import (
+    ALERTS_SCHEMA,
+    AlertEngine,
+    LiveObservatory,
+    MetricRegistry,
+    RegistrySink,
+    SLOEvaluator,
+    SLOSpec,
+    default_watchdogs,
+    load_alert_log,
+    load_slo_config,
+    prometheus_text,
+    replay_records,
+    start_http_exporter,
+    unresolved_alerts,
+    validate_alert_log,
+    watch_run_dir,
+)
+from npairloss_tpu.obs.live.registry import DEFAULT_BOUNDS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(**kw):
+    base = dict(name="s", metric="m", op="<=", target=10.0,
+                window_s=10.0, burn_threshold=0.5, min_samples=1)
+    base.update(kw)
+    return SLOSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_semantics():
+    reg = MetricRegistry()
+    reg.inc("c")
+    reg.inc("c", 2.5)
+    assert reg.get("c").value == 3.5
+    with pytest.raises(ValueError):
+        reg.counter("c").inc(-1)
+    reg.set("g", 1.0, t=100.0)
+    reg.set("g", 2.0, t=101.0)
+    assert reg.get("g").value == 2.0
+    assert reg.samples_since("g", 100.5) == [(101.0, 2.0)]
+    assert reg.samples_since("g", 0.0) == [(100.0, 1.0), (101.0, 2.0)]
+    # counters have no sample window
+    assert reg.samples_since("c", 0.0) == []
+    # kind collision is a programming error, loudly
+    with pytest.raises(ValueError):
+        reg.gauge("c")
+    with pytest.raises(ValueError):
+        reg.counter("g")
+
+
+def test_registry_histogram_semantics():
+    reg = MetricRegistry()
+    h = reg.histogram("h", bounds=(1.0, 5.0, 10.0))
+    # boundary value lands IN its bucket (le semantics), overflow in +Inf
+    for v in (0.5, 1.0, 3.0, 10.0, 11.0):
+        h.observe(v, t=50.0)
+    assert h.bucket_counts == [2, 1, 1, 1]
+    assert h.cumulative_counts() == [2, 3, 4, 5]
+    assert h.count == 5
+    assert h.sum == pytest.approx(25.5)
+    # histograms feed the SLO sample window like gauges
+    assert len(reg.samples_since("h", 0.0)) == 5
+    # re-registration with different bounds is loud
+    with pytest.raises(ValueError):
+        reg.histogram("h", bounds=(1.0, 2.0))
+    with pytest.raises(ValueError):
+        MetricRegistry().histogram("bad", bounds=(5.0, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# sink adapter
+# ---------------------------------------------------------------------------
+
+
+def test_sink_maps_rows_to_metrics():
+    sink = RegistrySink()
+    reg = sink.registry
+    sink.log({"phase": "train", "step": 3, "wall_time": 100.0,
+              "loss": 1.5, "lr": 0.01, "run_id": "r"})
+    assert reg.get("train_rows").value == 1
+    assert reg.get("train_loss").value == 1.5  # generic gauge mapping
+    assert reg.get("train_loss_hist").count == 1  # histogram observation
+    assert reg.get("train_lr").value == 0.01
+    assert reg.get("train_step").value == 3
+    # strings / bools / envelope keys never become gauges
+    assert reg.get("train_run_id") is None
+    sink.log({"phase": "serve", "wall_time": 101.0, "step": 0,
+              "p99_ms": 42.0, "qps": 10.0})
+    assert reg.get("serve_p99_ms").value == 42.0
+    assert reg.get("serve_latency_ms").count == 1
+
+
+def test_sink_nonfinite_streak_and_spread():
+    sink = RegistrySink()
+    reg = sink.registry
+    for loss in (1.0, float("nan"), float("inf"), 2.0):
+        sink.log({"phase": "train", "step": 1, "wall_time": 1.0,
+                  "loss": loss})
+    assert reg.get("train_nonfinite_loss").value == 2
+    # streak reset by the final finite loss
+    assert reg.get("train_nonfinite_streak").value == 0.0
+    # mid-stream the streak reached 2 (sample history shows it)
+    vals = [v for _, v in reg.get("train_nonfinite_streak").samples]
+    assert vals == [0.0, 1.0, 2.0, 0.0]
+    # NaN never lands in a gauge or a histogram
+    assert all(math.isfinite(v)
+               for _, v in reg.get("train_loss").samples)
+    assert reg.get("train_loss_hist").count == 2  # the finite two
+    sink.log({"phase": "train", "step": 2, "wall_time": 2.0,
+              "emb_mag_mean": 1.0, "emb_mag_max": 1.5})
+    assert reg.get("train_emb_mag_spread").value == pytest.approx(1.5)
+
+
+def test_sink_fleet_step_lag():
+    sink = RegistrySink()
+    reg = sink.registry
+    sink.log({"phase": "train", "step": 10, "wall_time": 1.0,
+              "loss": 1.0, "process_index": 0, "process_count": 2})
+    assert reg.get("fleet_step_lag") is None  # one rank = no lag yet
+    sink.log({"phase": "train", "step": 7, "wall_time": 1.1,
+              "loss": 1.0, "process_index": 1, "process_count": 2})
+    assert reg.get("fleet_step_lag").value == 3.0
+
+
+def test_sink_event_rows_count_but_never_gauge():
+    """The drain summary carries WHOLE-RUN percentiles under the same
+    keys as window rows — ingesting it as samples would re-fire a
+    resolved p99 alert at the final tick (regression pin)."""
+    sink = RegistrySink()
+    reg = sink.registry
+    sink.log({"phase": "serve", "step": 0, "wall_time": 5.0,
+              "event": "serve_drain", "p99_ms": 5000.0, "answered": 10})
+    assert reg.get("serve_event_serve_drain").value == 1
+    assert reg.get("serve_p99_ms") is None
+    assert reg.get("serve_answered") is None
+
+
+def test_sink_never_mutates_never_raises():
+    sink = RegistrySink()
+    rec = {"phase": "train", "step": 1, "wall_time": 1.0, "loss": 1.0,
+           "nested": {"x": 1}}
+    snapshot = dict(rec)
+    sink.log(rec)
+    assert rec == snapshot
+    # a poisoned registry must not propagate out of log()
+    class Boom:
+        def __getattr__(self, name):
+            raise RuntimeError("boom")
+
+    sink.registry = Boom()
+    sink.log({"phase": "train", "step": 1, "wall_time": 1.0})  # no raise
+
+
+def test_sink_byte_parity_of_jsonl_stream(tmp_path, monkeypatch):
+    """Attaching the RegistrySink as an extra sink must not change ONE
+    byte of the on-disk telemetry stream — the zero-footprint half of
+    the live-obs parity contract (the other half is that no sink is
+    attached at all when --live-obs is off)."""
+    from npairloss_tpu.obs import run as obs_run
+
+    rows = [
+        ("train", 1, {"loss": 1.25, "lr": 0.01}),
+        ("train", 2, {"loss": float("nan"), "lr": 0.01}),
+        ("serve", 0, {"qps": 10.0, "p99_ms": 3.25}),
+        ("eval", 2, {"loss": 0.5}),
+    ]
+    monkeypatch.setattr(obs_run.time, "time", lambda: 1234.5)
+    streams = {}
+    for variant in ("plain", "with_sink"):
+        d = tmp_path / variant
+        extra = (RegistrySink(),) if variant == "with_sink" else ()
+        tel = obs_run.RunTelemetry(str(d), run_id="fixed", trace=False,
+                                   extra_sinks=extra)
+        for phase, step, metrics in rows:
+            tel.log(phase, step, metrics)
+        tel.close()
+        streams[variant] = (d / "metrics.jsonl").read_bytes()
+    assert streams["plain"] == streams["with_sink"]
+    assert len(streams["plain"].splitlines()) == len(rows)
+
+
+# ---------------------------------------------------------------------------
+# SLO math
+# ---------------------------------------------------------------------------
+
+
+def test_slo_spec_validation_loud():
+    with pytest.raises(ValueError):
+        _spec(op="<")
+    with pytest.raises(ValueError):
+        _spec(severity="page")
+    with pytest.raises(ValueError):
+        _spec(burn_threshold=0.0)
+    with pytest.raises(ValueError):
+        _spec(burn_threshold=1.5)
+    with pytest.raises(ValueError):
+        _spec(window_s=0)
+    with pytest.raises(ValueError):
+        _spec(min_samples=0)
+    with pytest.raises(ValueError):
+        _spec(clear_threshold=0.9, burn_threshold=0.5)  # clears above fire
+    assert _spec().resolved_clear_threshold() == 0.25
+
+
+def test_slo_burn_rate_math():
+    reg = MetricRegistry()
+    spec = _spec(window_s=10.0, burn_threshold=0.5, min_samples=2)
+    ev = SLOEvaluator([spec], reg)
+    # 4 bad of 10 -> 0.4 < 0.5: ok
+    for i in range(10):
+        reg.set("m", 20.0 if i < 4 else 5.0, t=100.0 + i)
+    st = ev.evaluate(now=110.0)[0]
+    assert not st.burning and st.bad_fraction == pytest.approx(0.4)
+    assert st.samples == 10
+    # one more bad sample -> 5/11 ~ 0.45: still ok; then window slides
+    # past the good prefix and the fraction crosses the threshold
+    reg.set("m", 30.0, t=110.0)
+    assert not ev.evaluate(now=110.0)[0].burning
+    st = ev.evaluate(now=114.5)[0]  # window [104.5, 114.5]: bad 1 of 6...
+    assert st.samples == 6
+    # worst violator is reported for the alert message
+    assert st.worst == 30.0
+
+
+def test_slo_min_samples_and_ops():
+    reg = MetricRegistry()
+    lo = _spec(name="lo", op=">=", target=100.0, min_samples=3)
+    ev = SLOEvaluator([lo], reg)
+    reg.set("m", 1.0, t=10.0)
+    st = ev.evaluate(now=11.0)[0]
+    assert not st.burning and st.samples == 1  # below min_samples: ok
+    reg.set("m", 2.0, t=10.5)
+    reg.set("m", 3.0, t=10.6)
+    st = ev.evaluate(now=11.0)[0]
+    assert st.burning and st.bad_fraction == 1.0
+    assert st.worst == 1.0  # op=">=": the SMALLEST violator is worst
+
+
+def test_slo_hysteresis_no_flap():
+    """bad_fraction dancing between clear (0.25) and burn (0.5) must
+    not flap: it fires crossing 0.5, then stays firing until the
+    fraction drops BELOW 0.25."""
+    reg = MetricRegistry()
+    spec = _spec(window_s=4.0, burn_threshold=0.5, clear_threshold=0.25,
+                 min_samples=1)
+    ev = SLOEvaluator([spec], reg)
+
+    def window(t0, n_bad, n_total):
+        for i in range(n_total):
+            reg.set("m", 99.0 if i < n_bad else 1.0,
+                    t=t0 + i / n_total)
+
+    states = []
+    for k, (bad, total) in enumerate(
+            [(3, 6), (2, 6), (1, 6), (2, 6), (3, 6)]):
+        t0 = 100.0 + 10.0 * k  # windows far apart: each eval sees one
+        window(t0, bad, total)
+        states.append(ev.evaluate(now=t0 + 1.0)[0].burning)
+    # 0.5 fires; 0.33 sits INSIDE the hysteresis band (above clear,
+    # below burn) so the alert neither clears nor re-fires; 0.17
+    # clears; 0.33 now stays CLEAR (below burn); 0.5 fires again.
+    assert states == [True, True, False, False, True]
+
+
+# ---------------------------------------------------------------------------
+# alert engine + contract
+# ---------------------------------------------------------------------------
+
+
+def _status(spec, burning, frac=1.0, samples=4):
+    from npairloss_tpu.obs.live.slo import SLOStatus
+
+    return SLOStatus(spec, burning, frac, samples, worst=99.0)
+
+
+def test_slo_scrape_never_advances_hysteresis():
+    """A /healthz poll (evaluate commit=False / status_dict) must not
+    open or close hysteresis state a tick-driven evaluation alone
+    would not have (review-round regression pin)."""
+    reg = MetricRegistry()
+    spec = _spec(window_s=10.0, burn_threshold=0.5, clear_threshold=0.25)
+    ev = SLOEvaluator([spec], reg)
+    for i in range(2):
+        reg.set("m", 99.0, t=100.0 + i)  # 100% bad: would fire
+    # scrapes see it burning but never commit
+    assert ev.status_dict(now=102.0)["s"]["burning"] is True
+    assert ev._burning["s"] is False
+    # now good samples dilute to 0.4 — inside the band: a committed
+    # tick from the NON-burning state must stay ok (the scrape above
+    # must not have latched burning=True, which would hold at 0.4)
+    for i in range(3):
+        reg.set("m", 1.0, t=103.0 + i)
+    assert not ev.evaluate(now=106.0)[0].burning
+
+
+def test_slo_burning_holds_through_silence():
+    """Silence is not recovery: a burning SLO stays burning when the
+    window empties (a wedged server emitting nothing must not stand
+    the pager down); resolution needs good samples."""
+    reg = MetricRegistry()
+    spec = _spec(window_s=5.0, min_samples=1, severity="critical")
+    ev = SLOEvaluator([spec], reg)
+    reg.set("m", 99.0, t=100.0)
+    assert ev.evaluate(now=101.0)[0].burning
+    st = ev.evaluate(now=200.0)[0]  # window long empty
+    assert st.burning and st.samples == 0
+    reg.set("m", 1.0, t=300.0)  # recovery evidence
+    assert not ev.evaluate(now=301.0)[0].burning
+    # and an SLO that never burned stays ok through silence
+    st = ev.evaluate(now=400.0)[0]
+    assert not st.burning
+
+
+def test_alert_engine_resumes_appended_log(tmp_path):
+    """A restarted process appending to an existing alerts.jsonl (the
+    preempt-and-resume flow) must continue alert ids past the old
+    segment and ADOPT its open alert instead of double-firing — the
+    concatenated log stays validator-clean (review-round pin)."""
+    spec = _spec(name="p99", severity="critical")
+    path = str(tmp_path / "alerts.jsonl")
+    first = AlertEngine(path)
+    first.update([_status(spec, True)], now=10.0)  # left FIRING
+    first.close()
+
+    second = AlertEngine(path)  # process restart
+    # the SLO recovered across the restart: resolve under the OLD id
+    ev = second.update([_status(spec, False)], now=20.0)
+    assert ev[0]["state"] == "resolved" and ev[0]["alert_id"] == "p99-1"
+    assert ev[0]["fired_at"] == 10.0
+    # a NEW incident gets a seq past everything the log ever used
+    ev = second.update([_status(spec, True)], now=30.0)
+    assert ev[0]["alert_id"] == "p99-2"
+    second.close()
+    records = load_alert_log(path)
+    assert validate_alert_log(records) is None
+    assert [(r["alert_id"], r["state"]) for r in records] == [
+        ("p99-1", "firing"), ("p99-1", "resolved"), ("p99-2", "firing")]
+
+    # still-burning across the restart: adopted silently, ONE firing
+    third = AlertEngine(path)
+    assert third.update([_status(spec, True)], now=40.0) == []
+    assert third.active()["p99"]["alert_id"] == "p99-2"
+    third.close()
+    assert validate_alert_log(load_alert_log(path)) is None
+
+
+def test_alert_lifecycle_dedup_and_debounce():
+    spec = _spec(severity="critical")
+    eng = AlertEngine(min_ticks=1)
+    assert eng.update([_status(spec, True)], now=10.0)[0]["state"] == "firing"
+    # still burning: dedup, no second event
+    assert eng.update([_status(spec, True)], now=11.0) == []
+    assert list(eng.active()) == ["s"]
+    ev = eng.update([_status(spec, False)], now=12.0)[0]
+    assert ev["state"] == "resolved" and ev["duration_s"] == 2.0
+    assert eng.active() == {}
+    # a later burn is a NEW alert id
+    assert eng.update([_status(spec, True)], now=13.0)[0]["alert_id"] != \
+        eng.history[0]["alert_id"]
+
+    # debounce: one burning tick among quiet ones never fires
+    eng2 = AlertEngine(min_ticks=2)
+    assert eng2.update([_status(spec, True)], now=1.0) == []
+    assert eng2.update([_status(spec, False)], now=2.0) == []
+    assert eng2.update([_status(spec, True)], now=3.0) == []
+    assert eng2.update([_status(spec, True)], now=4.0) != []
+
+
+def test_alert_log_roundtrip_and_validator(tmp_path):
+    spec = _spec(severity="warning")
+    path = str(tmp_path / "alerts.jsonl")
+    eng = AlertEngine(path)
+    eng.update([_status(spec, True)], now=10.0)
+    eng.update([_status(spec, False)], now=20.0)
+    eng.close()
+    records = load_alert_log(path)
+    assert validate_alert_log(records) is None
+    assert [r["state"] for r in records] == ["firing", "resolved"]
+    assert all(r["schema"] == ALERTS_SCHEMA for r in records)
+    assert unresolved_alerts(records) == []
+    # torn tail line (killed writer) is tolerated by the loader
+    with open(path, "a") as f:
+        f.write('{"schema": "npairloss-aler')
+    assert validate_alert_log(load_alert_log(path)) is None
+
+
+def test_alert_validator_teeth():
+    good = {
+        "schema": ALERTS_SCHEMA, "alert_id": "a-1", "slo": "a",
+        "metric": "m", "severity": "critical", "state": "firing",
+        "ts": 1.0, "fired_at": 1.0, "bad_fraction": 1.0, "samples": 3,
+        "target": 5.0, "op": "<=", "message": "x",
+    }
+    assert validate_alert_log([good]) is None
+    assert "schema" in validate_alert_log([{**good, "schema": "v0"}])
+    missing = dict(good)
+    del missing["message"]
+    assert "message" in validate_alert_log([missing])
+    assert "state" in validate_alert_log([{**good, "state": "open"}])
+    assert "severity" in validate_alert_log(
+        [{**good, "severity": "fatal"}])
+    # resolve without its firing
+    assert "lifecycle" in validate_alert_log(
+        [{**good, "state": "resolved", "resolved_at": 2.0}])
+    # duplicate firing for one alert id
+    assert "duplicate" in validate_alert_log([good, dict(good)])
+    # second active alert for the same SLO violates dedup
+    assert "dedup" in validate_alert_log(
+        [good, {**good, "alert_id": "a-2"}])
+    # resolved before fired
+    resolved = {**good, "state": "resolved", "resolved_at": 0.5}
+    assert "precedes" in validate_alert_log([good, resolved])
+    # a SECOND resolve for one incident violates the lifecycle
+    ok_resolve = {**good, "state": "resolved", "resolved_at": 2.0}
+    assert validate_alert_log([good, ok_resolve]) is None
+    assert "lifecycle" in validate_alert_log(
+        [good, ok_resolve, dict(ok_resolve)])
+    # unresolved report
+    assert unresolved_alerts([good]) == [("a-1", "a", "critical")]
+
+
+def test_bench_check_alerts_gate(tmp_path):
+    """The jax-free gate: accepts a resolved log, refuses an unresolved
+    CRITICAL and a schema violation (exit != 0)."""
+    gate = os.path.join(REPO, "scripts", "bench_check.py")
+    fire = {
+        "schema": ALERTS_SCHEMA, "alert_id": "p99-1", "slo": "p99",
+        "metric": "serve_p99_ms", "severity": "critical",
+        "state": "firing", "ts": 1.0, "fired_at": 1.0,
+        "bad_fraction": 1.0, "samples": 3, "target": 100.0, "op": "<=",
+        "message": "x",
+    }
+    resolve = {**fire, "state": "resolved", "ts": 2.0,
+               "resolved_at": 2.0, "duration_s": 1.0}
+
+    def run(records):
+        p = tmp_path / "log.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in records))
+        return subprocess.run(
+            [sys.executable, gate, "--alerts", str(p)],
+            capture_output=True, text=True)
+
+    ok = run([fire, resolve])
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = run([fire])  # unresolved critical
+    assert bad.returncode == 1 and "still firing" in bad.stdout
+    # unresolved WARNING is noted, not gated
+    warn = run([{**fire, "alert_id": "w-1", "slo": "w",
+                 "severity": "warning"}])
+    assert warn.returncode == 0, warn.stdout + warn.stderr
+    schema = run([{**fire, "schema": "nope"},
+                  {**resolve, "schema": "nope"}])
+    assert schema.returncode == 1 and "schema-invalid" in schema.stdout
+
+
+# ---------------------------------------------------------------------------
+# one evaluator, two feeds
+# ---------------------------------------------------------------------------
+
+
+def _serve_stream():
+    """Synthetic serve window rows: fast, then an incident, then
+    recovery — wall_times drive the replay clock."""
+    rows = []
+    t = 1000.0
+    for p99 in [10, 12, 11, 500, 600, 550, 9, 8, 10, 11]:
+        rows.append({"run_id": "r", "step": len(rows), "phase": "serve",
+                     "wall_time": t, "p99_ms": float(p99), "qps": 50.0})
+        t += 5.0
+    return rows
+
+
+def test_watch_vs_in_process_agreement():
+    """The same stream through the offline replay and through a
+    hand-driven in-process observatory must produce the SAME alert
+    sequence — one engine, two feeds."""
+    spec = _spec(name="p99", metric="serve_p99_ms", target=100.0,
+                 window_s=12.0, burn_threshold=0.5, min_samples=1,
+                 severity="critical")
+    rows = _serve_stream()
+    _, replay_events = replay_records(rows, [spec])
+
+    inproc = LiveObservatory([spec])
+    inproc_events = []
+    for rec in rows:
+        inproc.sink.log(rec)
+        inproc_events.extend(inproc.tick(now=rec["wall_time"]))
+
+    key = [(e["alert_id"], e["state"], e["ts"]) for e in replay_events]
+    assert key == [(e["alert_id"], e["state"], e["ts"])
+                   for e in inproc_events]
+    assert [s for _, s, _ in key] == ["firing", "resolved"]
+
+
+def test_watch_run_dir_offline(tmp_path):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    rows = _serve_stream()
+    # split across the legacy stream and a rank stream, with a torn
+    # tail: watch must merge by wall_time and never die on the tear
+    with open(run_dir / "metrics.jsonl", "w") as f:
+        for r in rows[::2]:
+            f.write(json.dumps(r) + "\n")
+    with open(run_dir / "telemetry.r1.jsonl", "w") as f:
+        for r in rows[1::2]:
+            f.write(json.dumps(r) + "\n")
+        f.write('{"torn')  # no newline: still being written
+    spec = _spec(name="p99", metric="serve_p99_ms", target=100.0,
+                 window_s=12.0, burn_threshold=0.5, min_samples=1)
+    out = str(tmp_path / "alerts.watch.jsonl")
+    summary = watch_run_dir(str(run_dir), [spec], out_path=out)
+    assert summary["rows"] == len(rows)
+    assert summary["events"] == 2
+    assert summary["alerts_active"] == 0
+    records = load_alert_log(out)
+    assert validate_alert_log(records) is None
+    assert [r["state"] for r in records] == ["firing", "resolved"]
+    # the summary's SLO block is evaluated at the STREAM's last wall
+    # time, not real now — a finished run must not read as an empty
+    # (hence falsely-ok) window next to its own alert history
+    assert summary["slo"]["p99"]["samples"] > 0
+    with pytest.raises(FileNotFoundError):
+        watch_run_dir(str(tmp_path / "empty"), [spec])
+
+
+# ---------------------------------------------------------------------------
+# config + watchdogs
+# ---------------------------------------------------------------------------
+
+
+def test_load_slo_config(tmp_path):
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps({
+        "watchdogs": ["serve"],
+        "slos": [
+            {"name": "serve_p99", "metric": "serve_p99_ms",
+             "op": "<=", "target": 42.0, "window_s": 5.0},
+            {"name": "mine", "metric": "x", "op": ">=", "target": 1.0},
+        ],
+    }))
+    specs = {s.name: s for s in load_slo_config(str(cfg))}
+    # preset pulled in, explicit entry OVERRIDES the preset by name
+    assert specs["serve_p99"].target == 42.0
+    assert "serve_queue_saturation" in specs
+    assert specs["mine"].op == ">="
+    for bad in (
+        {"slos": [{"name": "x"}]},                      # missing keys
+        {"slos": [{"name": "x", "metric": "m", "op": "<=",
+                   "target": 1.0, "typo_key": 2}]},     # unknown key
+        {"nope": []},                                   # unknown top level
+        {},                                             # no SLOs at all
+        {"watchdogs": ["serve"], "unknown": 1},
+    ):
+        cfg.write_text(json.dumps(bad))
+        with pytest.raises(ValueError):
+            load_slo_config(str(cfg))
+
+
+def test_default_watchdogs():
+    serve = {s.name for s in default_watchdogs("serve", max_queue=64)}
+    assert {"serve_p99", "serve_queue_saturation",
+            "serve_post_warmup_compile", "index_staleness",
+            "model_staleness"} == serve
+    train = {s.name for s in default_watchdogs("train")}
+    assert "train_nonfinite_streak" in train
+    assert "train_throughput_floor" not in train  # only with a real bar
+    train_bar = {s.name
+                 for s in default_watchdogs("train", bench_floor=100.0)}
+    assert "train_throughput_floor" in train_bar
+    with pytest.raises(ValueError):
+        default_watchdogs("pod")
+    # severity twin pin: alerts.py spells slo.SEVERITIES out (jax-free
+    # file-path-load contract) — drift is a test failure
+    from npairloss_tpu.obs.live.alerts import ALERT_SEVERITIES
+    from npairloss_tpu.obs.live.slo import SEVERITIES
+
+    assert ALERT_SEVERITIES == SEVERITIES
+
+
+# ---------------------------------------------------------------------------
+# exposition + exporter
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_exposition_format():
+    reg = MetricRegistry()
+    reg.inc("serve_rows", 7)
+    reg.set("serve_p99_ms", 12.5, t=1.0)
+    reg.observe("serve_latency_ms", 3.0, t=1.0)
+    reg.gauge("never_set")
+    text = prometheus_text(reg)
+    lines = text.splitlines()
+    assert "# TYPE npairloss_serve_rows_total counter" in lines
+    assert "npairloss_serve_rows_total 7" in lines
+    assert "# TYPE npairloss_serve_p99_ms gauge" in lines
+    assert "npairloss_serve_p99_ms 12.5" in lines
+    assert "# TYPE npairloss_serve_latency_ms histogram" in lines
+    assert 'npairloss_serve_latency_ms_bucket{le="2.5"} 0' in lines
+    assert 'npairloss_serve_latency_ms_bucket{le="5"} 1' in lines
+    assert 'npairloss_serve_latency_ms_bucket{le="+Inf"} 1' in lines
+    assert "npairloss_serve_latency_ms_sum 3" in lines
+    assert "npairloss_serve_latency_ms_count 1" in lines
+    # histogram buckets are cumulative and ordered
+    buckets = [ln for ln in lines if "_bucket" in ln]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)
+    assert len(buckets) == len(DEFAULT_BOUNDS) + 1
+    # an unset gauge exposes nothing
+    assert "never_set" not in text
+
+
+def test_http_exporter_and_health():
+    reg = MetricRegistry()
+    reg.set("g", 1.25, t=1.0)
+    httpd = start_http_exporter(reg, 0, health_fn=lambda: {"ok": True})
+    try:
+        port = httpd.server_address[1]
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "npairloss_g 1.25" in text
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert health == {"ok": True}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_live_observatory_probe_and_final_tick(tmp_path):
+    spec = _spec(name="age", metric="age_s", target=5.0,
+                 window_s=60.0, severity="critical")
+    obs = LiveObservatory([spec], out_dir=str(tmp_path))
+    age = [0.0]
+    obs.add_probe(lambda: obs.registry.set("age_s", age[0]))
+    assert obs.tick(now=1.0) == []
+    age[0] = 99.0
+    # stop() runs one final tick: the transition that happened right
+    # before shutdown still lands in alerts.jsonl
+    obs.stop()
+    records = load_alert_log(str(tmp_path / "alerts.jsonl"))
+    assert validate_alert_log(records) is None
+    assert [r["state"] for r in records] == ["firing"]
+    assert obs.health()["alerts_active"] == 1
+
+
+# ---------------------------------------------------------------------------
+# freshness + serve integration (tiny jax work)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_serve():
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        Freshness,
+        GalleryIndex,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+
+    rng = np.random.default_rng(0)
+    emb = rng.standard_normal((32, 8)).astype(np.float32)
+    index = GalleryIndex.build(emb, (np.arange(32) % 4).astype(np.int32))
+    engine = QueryEngine(index, EngineConfig(top_k=3, buckets=(1, 4)))
+    engine.warmup()
+    freshness = Freshness.collect(index=index, index_path="/tmp/fake.gidx")
+    server = RetrievalServer(
+        engine, BatcherConfig(max_batch=4, max_delay_ms=1.0),
+        ServerConfig(metrics_window=0), freshness=freshness,
+    )
+    server.batcher.start()
+    yield emb, server
+    server.batcher.close(drain=True)
+
+
+def test_index_created_roundtrip(tmp_path):
+    from npairloss_tpu.serve import GalleryIndex
+
+    rng = np.random.default_rng(1)
+    idx = GalleryIndex.build(
+        rng.standard_normal((8, 4)).astype(np.float32),
+        np.arange(8, dtype=np.int32))
+    assert idx.created is not None and idx.created <= time.time()
+    path = str(tmp_path / "g.gidx")
+    idx.save(path)
+    loaded = GalleryIndex.load(path)
+    # load dates the gallery by its COMMIT manifest
+    assert loaded.created is not None
+    assert abs(loaded.created - time.time()) < 60.0
+    before = loaded.created
+    time.sleep(0.01)
+    loaded.add(rng.standard_normal((2, 4)).astype(np.float32),
+               np.array([8, 9], np.int32))
+    assert loaded.created > before  # add() is a freshness event
+
+
+def test_freshness_shapes_and_answer_stamp(tiny_serve):
+    """The satellite's JSON-shape regression test: /healthz, the drain
+    summary, and every answer report the freshness ages WITHOUT
+    --live-obs."""
+    emb, server = tiny_serve
+    answer = server.handle({"id": 7, "embedding": emb[7].tolist()})
+    assert answer["neighbors"][0]["row"] == 7
+    assert "index_age_s" in answer and answer["index_age_s"] >= 0.0
+    assert "model_age_s" not in answer  # embedding-only serving
+    s = server.summary()
+    assert s["index_path"] == "/tmp/fake.gidx"
+    assert "index_age_s" in s and "snapshot_step" not in s
+    h = server.healthz()
+    assert h["ok"] is True and "index_age_s" in h
+    assert "slo" not in h  # no live observatory attached
+    # error answers carry no stale stamp confusion: still answered
+    err = server.handle({"id": 8, "embedding": [1.0]})
+    assert "error" in err
+
+
+def test_snapshot_info_manifestless(tmp_path):
+    from npairloss_tpu.train import snapshot_info
+
+    d = tmp_path / "old.ckpt"
+    d.mkdir()
+    info = snapshot_info(str(d))
+    assert info["step"] is None and info["created"] is None
+    assert info["path"] == str(d)
+
+
+def test_serve_latency_failpoint(tiny_serve):
+    from npairloss_tpu.resilience import failpoints
+
+    emb, server = tiny_serve
+    before = time.perf_counter()
+    with failpoints.armed("serve.latency", times=1):
+        a = server.handle({"id": 1, "embedding": emb[1].tolist()})
+    assert a["neighbors"][0]["row"] == 1
+    assert time.perf_counter() - before >= failpoints.SERVE_LATENCY_FAULT_S
+    # disarmed: fast again
+    before = time.perf_counter()
+    server.handle({"id": 2, "embedding": emb[2].tolist()})
+    assert time.perf_counter() - before < failpoints.SERVE_LATENCY_FAULT_S
+
+
+def test_serve_queue_stall_failpoint(tiny_serve):
+    from npairloss_tpu.resilience import failpoints
+
+    emb, server = tiny_serve
+    with failpoints.armed("serve.queue_stall", times=1):
+        t0 = time.perf_counter()
+        answers = server.handle_many(
+            [{"id": i, "embedding": emb[i].tolist()} for i in range(3)])
+    assert all("neighbors" in a for a in answers)
+    assert time.perf_counter() - t0 >= failpoints.SERVE_QUEUE_STALL_S
+
+
+def test_window_rows_are_per_window_and_clean(tiny_serve):
+    """Window rows describe THEIR window (a live p99 watchdog must see
+    recovery), and a clean engine's rows carry NO
+    compiles_after_warmup key (the absent-when-zero stream-parity
+    contract).  A FRESH server around the shared warmed engine: the
+    window alignment under test must not inherit another test's
+    half-filled window."""
+    from npairloss_tpu.obs.sinks import RingBufferSink
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        RetrievalServer,
+        ServerConfig,
+    )
+
+    emb, shared = tiny_serve
+    ring = RingBufferSink(16)
+
+    class Tel:
+        metrics_enabled = True
+        tracer = None
+
+        def log(self, phase, step, metrics, **extra):
+            rec = {**metrics, "phase": phase, "step": step}
+            ring.log(rec)
+            return rec
+
+        def span(self, name, **args):
+            import contextlib
+
+            return contextlib.nullcontext()
+
+    server = RetrievalServer(
+        shared.engine, BatcherConfig(max_batch=4, max_delay_ms=1.0),
+        ServerConfig(metrics_window=2), telemetry=Tel(),
+    )
+    server.batcher.start()
+    try:
+        # window 1: two slow answers; window 2: two fast ones
+        from npairloss_tpu.resilience import failpoints
+
+        with failpoints.armed("serve.latency", times=2):
+            for i in (1, 2):
+                server.handle({"id": i, "embedding": emb[i].tolist()})
+        for i in (3, 4):
+            server.handle({"id": i, "embedding": emb[i].tolist()})
+        rows = [r for r in ring.records() if r.get("phase") == "serve"]
+        assert len(rows) == 2
+        slow, fast = rows
+        assert slow["p99_ms"] >= 250.0
+        # the fast window's p99 must NOT remember the slow window
+        assert fast["p99_ms"] < 250.0
+        assert all("compiles_after_warmup" not in r for r in rows)
+    finally:
+        server.batcher.close(drain=True)
