@@ -512,6 +512,7 @@ ENGINE_ROWS = (
     "blockwise_flagship_bf16matmul", "dense_flagship_bf16matmul",
     "ring_abs", "ring_flagship", "ring_flagship_nocache",
     "ring_flagship_bf16matmul", "serve_qps",
+    "flat_qps_1m", "ivf_qps_1m",
 )
 
 
@@ -849,6 +850,168 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
         except Exception as e:  # the serve row must not void the rest
             _log(f"extras: {name} FAILED: {e}")
             extras[name] = {"error": str(e)[:300]}
+        flush()
+
+    # flat_qps_1m / ivf_qps_1m: production-gallery-scale serving
+    # (ISSUE 11 / ROADMAP item 2).  A 1M x 128 synthetic gallery served
+    # through the flat exact scan (the recall oracle — untenable at
+    # this size, which is the point being measured) and through the IVF
+    # probe path (serve/ivf.py: k-means clusters, probe-top-C, bf16
+    # cluster-scan scoring).  The IVF row carries build time and
+    # recall@1/@10 against the flat ground truth computed on IDENTICAL
+    # queries — bench_check holds a HARD recall floor and a minimum
+    # ivf-vs-flat speedup on it, not just the noise-aware p99 gate.
+    # Rows are stamped with the measuring platform: gallery-scale rows
+    # may be captured on CPU during tunnel outages, and that provenance
+    # must ride the row, not the record headline.
+    def _serve_scale_rows(want_flat, want_ivf):
+        import gc
+
+        from npairloss_tpu.serve import (
+            EngineConfig,
+            GalleryIndex,
+            QueryEngine,
+        )
+        from npairloss_tpu.serve.ivf import IVFIndex, topk_recall
+
+        n1, d1, kc, probes = 1_000_000, 128, 1024, 32
+        bucket, trials, top_k = 8, 12, 10
+        platform = jax.devices()[0].platform
+        # The cluster-scan matmul dtype: bf16 is the MXU-headroom mode
+        # (the ring bf16 row's ~6.7x), but XLA *CPU* scalarizes bf16
+        # (measured ~13x SLOWER than the Eigen f32 path) — an outage-
+        # round CPU measurement must not pay an emulation tax the row
+        # exists to disprove.  The recall-parity gates for bf16/int8
+        # live in tests/test_ivf.py either way.
+        scoring = "fp32" if platform == "cpu" else "bf16"
+        # Clustered synthetic gallery — the geometry a trained
+        # metric-learning gallery actually has (4096 classes, tight
+        # class clusters), and the structure IVF's probe-recall story
+        # is ABOUT.  An isotropic-gaussian pool is the adversarial
+        # no-structure case: true neighbors scatter uniformly over
+        # clusters and no sublinear index can hold recall there.
+        classes = 4096
+        rng1 = np.random.default_rng(11)
+        centers = rng1.standard_normal(
+            (classes, d1), dtype=np.float32)
+        centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+        plab = (np.arange(n1) % classes).astype(np.int32)
+        pool = centers[plab] + 0.045 * rng1.standard_normal(
+            (n1, d1), dtype=np.float32)
+        pool /= np.linalg.norm(pool, axis=1, keepdims=True)
+        sel_rows = rng1.choice(n1, bucket * trials, replace=False)
+        qs = pool[sel_rows] + 0.045 * rng1.standard_normal(
+            (bucket * trials, d1), dtype=np.float32)
+        qs /= np.linalg.norm(qs, axis=1, keepdims=True)
+
+        def timed(engine):
+            lats, rows_out = [], []
+            for t in range(trials):
+                q = qs[t * bucket:(t + 1) * bucket]
+                t0 = time.perf_counter()
+                out = engine.query(q, normalize=False)
+                lats.append(
+                    max(time.perf_counter() - t0 - floor, 1e-9) * 1e3
+                )
+                rows_out.append(out["rows"][:, :top_k])
+            lats.sort()
+            return lats, np.concatenate(rows_out)
+
+        def base_row(lats, warm_s, engine):
+            return {
+                "gallery": n1, "dim": d1, "top_k": top_k,
+                "bucket": bucket, "platform": platform,
+                "warmup_s": round(warm_s, 2),
+                "p50_ms": round(lats[len(lats) // 2], 2),
+                "p99_ms": round(lats[min(int(len(lats) * 0.99),
+                                         len(lats) - 1)], 2),
+                "qps": round(bucket * trials / (sum(lats) / 1e3), 1),
+                "compiles_after_warmup":
+                    engine.compile_stats()["compiles_after_warmup"],
+            }
+
+        _log(f"extras: building 1M x {d1} gallery (flat oracle pass)...")
+        idx_f = GalleryIndex.build(pool, plab, normalize=False)
+        eng_f = QueryEngine(idx_f, EngineConfig(
+            top_k=top_k, buckets=(bucket,), gallery_block=131072))
+        warm_f = eng_f.warmup()
+        flat_lats, flat_rows = timed(eng_f)
+        if want_flat:
+            extras["flat_qps_1m"] = base_row(flat_lats, warm_f, eng_f)
+            _log(f"extras: flat_qps_1m: {extras['flat_qps_1m']}")
+        # Free the flat device residency before the IVF build doubles it
+        # (the flat answers — the recall ground truth — are host-side).
+        del eng_f
+        idx_f.emb = idx_f.labels = idx_f.valid = None
+        gc.collect()
+        if not want_ivf:
+            return
+        t0 = time.perf_counter()
+        idx_i = IVFIndex.build_ivf(
+            pool, plab, normalize=False, clusters=kc, iters=8,
+            train_size=65536)
+        build_s = time.perf_counter() - t0
+        eng_i = QueryEngine(idx_i, EngineConfig(
+            top_k=top_k, buckets=(bucket,), probes=probes,
+            scoring=scoring))
+        warm_i = eng_i.warmup()
+        ivf_lats, ivf_rows = timed(eng_i)
+        row = base_row(ivf_lats, warm_i, eng_i)
+        row.update({
+            "clusters": kc, "probes": probes, "scoring": scoring,
+            "cap": idx_i.layout.cap,
+            "build_s": round(build_s, 1),
+            "recall_at_1": round(topk_recall(ivf_rows, flat_rows, k=1), 4),
+            "recall_at_10": round(
+                topk_recall(ivf_rows, flat_rows, k=10), 4),
+            "speedup_vs_flat_p50": round(
+                flat_lats[len(flat_lats) // 2]
+                / max(row["p50_ms"], 1e-9), 1),
+        })
+        extras["ivf_qps_1m"] = row
+        _log(f"extras: ivf_qps_1m: {row}")
+
+    scale_names = ("flat_qps_1m", "ivf_qps_1m")
+    wants = {}
+    for name in scale_names:
+        if selected is not None and name not in selected:
+            extras[name] = {"skipped": "not selected (--rows)"}
+            wants[name] = False
+        elif deadline is not None and time.time() > deadline:
+            _log(f"extras: skipping {name} (soft time budget reached)")
+            extras[name] = {"skipped": "soft time budget reached"}
+            wants[name] = False
+        elif _quarantined(name):
+            q = _quarantined(name)
+            _log(f"extras: skipping {name} (quarantined: {q})")
+            extras[name] = {"skipped": f"quarantined: {q}"}
+            wants[name] = False
+        else:
+            wants[name] = True
+    # The IVF row's recall ground truth IS the flat oracle pass, so a
+    # QUARANTINED flat row (it wedged a previous child) must also stand
+    # the IVF row down — re-running the wedging code to feed the other
+    # row defeats the quarantine.  A merely-deselected flat row still
+    # permits the (unmeasured) oracle pass.
+    if wants["ivf_qps_1m"] and _quarantined("flat_qps_1m"):
+        reason = _quarantined("flat_qps_1m")
+        _log("extras: skipping ivf_qps_1m (flat oracle quarantined: "
+             f"{reason})")
+        extras["ivf_qps_1m"] = {
+            "skipped": f"flat oracle quarantined: {reason}"}
+        wants["ivf_qps_1m"] = False
+    if wants["flat_qps_1m"] or wants["ivf_qps_1m"]:
+        flush("serve_scale_1m")
+        try:
+            _serve_scale_rows(wants["flat_qps_1m"], wants["ivf_qps_1m"])
+        except Exception as e:  # scale rows must not void the rest
+            _log(f"extras: serve scale rows FAILED: {e}")
+            for name in scale_names:
+                # Never clobber a half-pass's MEASURED row (the flat
+                # oracle may have landed minutes of work before the IVF
+                # build raised): only still-pending rows get the marker.
+                if wants[name] and not _row_measured(extras.get(name)):
+                    extras[name] = {"error": str(e)[:300]}
         flush()
     return extras
 
@@ -1296,6 +1459,13 @@ def _headline_measured(rec) -> bool:
     return bool(rec.get("value")) and not rec.get("headline_reused")
 
 
+def _row_measured(v) -> bool:
+    """A dict row holding a real number: engine rows carry emb_per_sec,
+    serving rows carry p99_ms/qps (the serve_qps + *_qps_1m shapes)."""
+    return isinstance(v, dict) and any(
+        key in v for key in ("emb_per_sec", "p99_ms", "qps"))
+
+
 def _measured_row_names(rec):
     """Names of FRESHLY MEASURED rows in a full-mode record: "headline",
     engine-extras names, and "batch_scaling/<key>"s.  Skip/error markers
@@ -1309,7 +1479,7 @@ def _measured_row_names(rec):
             for bk, bv in (v or {}).items():
                 if isinstance(bv, dict) and "emb_per_sec" in bv:
                     names.add(f"batch_scaling/{bk}")
-        elif isinstance(v, dict) and "emb_per_sec" in v:
+        elif _row_measured(v):
             names.add(k)
     return names
 
@@ -1350,9 +1520,8 @@ def _merge_rows(base, donor, prefer=frozenset()):
                     bbs[bk] = copy.deepcopy(bv)
         elif isinstance(v, dict):
             cur = be.get(k)
-            if "emb_per_sec" in v and (
-                k in prefer
-                or not (isinstance(cur, dict) and "emb_per_sec" in cur)
+            if _row_measured(v) and (
+                k in prefer or not _row_measured(cur)
             ):
                 be[k] = copy.deepcopy(v)
         elif k not in be:  # scalar context keys (pool/steps/deltas)

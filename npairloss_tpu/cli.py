@@ -901,12 +901,15 @@ def cmd_eval(args) -> int:
 def cmd_index(args) -> int:
     """Build (or inspect) a committed gallery index from the ``extract``
     subcommand's .npy pair — the offline half of the serving path
-    (docs/SERVING.md).  ``--add-to`` appends to an existing index
-    instead of building fresh (the incremental ``GalleryIndex.add``
-    path); commits are atomic either way."""
+    (docs/SERVING.md).  ``--kind ivf`` clusters the gallery (shared
+    k-means, ops/kmeans.py) and commits the IVF index; ``--add-to``
+    appends to an existing index of EITHER kind (an IVF add re-assigns
+    the new rows into the existing clusters); commits are atomic
+    either way."""
     import numpy as np
 
-    from npairloss_tpu.serve.index import GalleryIndex, index_info
+    from npairloss_tpu.serve.index import index_info, load_index
+    from npairloss_tpu.serve.ivf import IVFIndex
 
     if args.info:
         print(json.dumps(index_info(args.info)))
@@ -925,19 +928,32 @@ def cmd_index(args) -> int:
                   emb.shape, lab.shape)
         return 2
     if args.add_to:
-        idx = GalleryIndex.load(args.add_to)
+        idx = load_index(args.add_to)
         idx.add(emb, lab, normalize=not args.no_normalize)
+    elif args.kind == "ivf":
+        idx = IVFIndex.build_ivf(
+            emb, lab, normalize=not args.no_normalize,
+            clusters=args.clusters, iters=args.kmeans_iters,
+            train_size=args.train_sample,
+        )
     else:
+        from npairloss_tpu.serve.index import GalleryIndex
+
         idx = GalleryIndex.build(
             emb, lab, normalize=not args.no_normalize
         )
     out = idx.save(args.out or (args.add_to or prefix + ".gidx"))
-    print(json.dumps({
+    summary = {
         "out": out,
+        "kind": idx.KIND,
         "rows": idx.size,
         "dim": idx.dim,
         "classes": int(np.unique(idx._host_labels).shape[0]),
-    }))
+    }
+    if isinstance(idx, IVFIndex):
+        summary["clusters"] = idx.n_clusters
+        summary["cap"] = idx.layout.cap
+    print(json.dumps(summary))
     return 0
 
 
@@ -955,11 +971,12 @@ def cmd_serve(args) -> int:
         BatcherConfig,
         EngineConfig,
         GalleryIndex,
+        IVFIndex,
         QueryEngine,
         RetrievalServer,
         ServerConfig,
     )
-    from npairloss_tpu.serve.index import load_newest
+    from npairloss_tpu.serve.index import load_index, load_newest
 
     if args.compile_cache:
         from npairloss_tpu.pipeline import enable_compile_cache
@@ -993,7 +1010,21 @@ def cmd_serve(args) -> int:
         log.info("serving index %s", index_path)
     else:
         index_path = os.path.abspath(args.index)
-        index = GalleryIndex.load(args.index, mesh=mesh)
+        index = load_index(args.index, mesh=mesh)
+    # Reconcile the committed structure with the requested serving
+    # structure (docs/SERVING.md §Approximate index): a flat commit can
+    # serve through the IVF probe path (clustered in-memory at startup)
+    # and an IVF commit can serve flat (the exact-scan recall oracle) —
+    # the committed artifact never dictates the serving posture.
+    if args.index_kind == "ivf" and not isinstance(index, IVFIndex):
+        log.info("clustering flat index into IVF (%s clusters)...",
+                 args.ivf_clusters or "auto")
+        index = IVFIndex.from_gallery(index, clusters=args.ivf_clusters)
+    elif args.index_kind == "flat" and isinstance(index, IVFIndex):
+        log.info("serving ivf commit through the flat exact scan")
+        index = GalleryIndex.build(
+            index._host_emb, index._host_labels, ids=index.ids,
+            mesh=mesh, normalize=False)
 
     model = state = None
     input_shape = None
@@ -1028,7 +1059,12 @@ def cmd_serve(args) -> int:
         if getattr(args, "slo_config", None):
             specs = load_slo_config(args.slo_config)
         else:
-            specs = default_watchdogs("serve", max_queue=args.max_queue)
+            # The queue-depth gauge reports the TIER-WIDE sum across
+            # replica batchers, so the saturation bound must scale the
+            # same way — or an N-replica tier pages (and sheds) at 1/N
+            # of its real capacity.
+            specs = default_watchdogs(
+                "serve", max_queue=args.max_queue * args.replicas)
         live = LiveObservatory(specs, out_dir=tel_dir)
     if tel_dir or trace_dir:
         from npairloss_tpu.obs import RunTelemetry
@@ -1041,6 +1077,11 @@ def cmd_serve(args) -> int:
             telemetry.write_manifest(config={
                 "serve": True,
                 "index": args.index or args.index_prefix,
+                "index_kind": args.index_kind,
+                "probes": args.probes,
+                "scoring": args.scoring,
+                "replicas": args.replicas,
+                "admission": args.admission,
                 "top_k": args.top_k,
                 "buckets": list(buckets),
                 "deadline_ms": args.deadline_ms,
@@ -1049,30 +1090,58 @@ def cmd_serve(args) -> int:
                 "slo_config": getattr(args, "slo_config", None),
             })
 
+    if args.admission != "off" and live is None:
+        log.error("--admission %s needs --live-obs (admission is driven "
+                  "by the SLO burn-rate engine)", args.admission)
+        return 2
+    if args.replicas < 1:
+        log.error("--replicas must be >= 1, got %d", args.replicas)
+        return 2
+
     preempt = PreemptionSignal().install()
     try:
+        engine_cfg = EngineConfig(
+            top_k=args.top_k, buckets=buckets,
+            gallery_block=args.gallery_block,
+            probes=args.probes, scoring=args.scoring,
+        )
         engine = QueryEngine(
-            index,
-            EngineConfig(top_k=args.top_k, buckets=buckets,
-                         gallery_block=args.gallery_block),
+            index, engine_cfg,
             model=model, state=state, telemetry=telemetry,
         )
+        # Replicas share the primary's compiled programs: one warmup
+        # warms the whole tier, and with --compile-cache a restarted
+        # replica deserializes instead of recompiling.
+        engines = [engine] + [
+            QueryEngine(index, engine_cfg, model=model, state=state,
+                        telemetry=telemetry, share_compiled_with=engine)
+            for _ in range(args.replicas - 1)
+        ]
         if not args.no_warmup:
             engine.warmup(input_shape)
+            for e in engines[1:]:
+                e.warmed = True
         from npairloss_tpu.serve import Freshness
 
         freshness = Freshness.collect(
             index=index, index_path=index_path,
             snapshot_path=args.snapshot or None,
         )
+        admission = None
+        if args.admission == "slo":
+            from npairloss_tpu.serve.admission import controller_from_args
+
+            admission = controller_from_args(
+                args.admission_slos, registry=live.registry)
+            live.add_listener(admission.on_statuses)
         server = RetrievalServer(
-            engine,
+            engines,
             BatcherConfig(max_batch=buckets[-1],
                           max_delay_ms=args.deadline_ms,
                           max_queue=args.max_queue),
             ServerConfig(metrics_window=args.metrics_window),
             telemetry=telemetry, preempt=preempt,
-            freshness=freshness, live=live,
+            freshness=freshness, live=live, admission=admission,
         )
         if live is not None:
             # Freshness probe: ages are server state, not metric rows —
@@ -1988,6 +2057,26 @@ def main(argv: Optional[list] = None) -> int:
         "--info", metavar="INDEX",
         help="print an existing index's manifest summary and exit",
     )
+    ix.add_argument(
+        "--kind", choices=["flat", "ivf"], default="flat",
+        help="index structure: flat (exact brute-force scan — the "
+        "recall oracle) or ivf (k-means clustered, probe-top-C "
+        "approximate search; docs/SERVING.md §Approximate index)",
+    )
+    ix.add_argument(
+        "--clusters", type=int, default=0,
+        help="ivf cluster count (0 = ~sqrt(N), the classical balance "
+        "point)",
+    )
+    ix.add_argument(
+        "--kmeans-iters", dest="kmeans_iters", type=int, default=10,
+        help="ivf k-means Lloyd iterations (default 10)",
+    )
+    ix.add_argument(
+        "--train-sample", dest="train_sample", type=int, default=131072,
+        help="ivf k-means training subsample bound (full assignment "
+        "always streams the whole gallery; default 131072)",
+    )
     ix.set_defaults(fn=cmd_index)
 
     sv = sub.add_parser(
@@ -2011,6 +2100,48 @@ def main(argv: Optional[list] = None) -> int:
     sv.add_argument(
         "--input-size", dest="input_size", type=int, default=224,
         help="input side length for the encode path (default 224)",
+    )
+    sv.add_argument(
+        "--index-kind", dest="index_kind", choices=["flat", "ivf"],
+        default="flat",
+        help="serve the gallery flat (exact scan — the recall oracle) "
+        "or through the IVF probe path; a flat commit served with ivf "
+        "is clustered in-memory at startup (--ivf-clusters), an ivf "
+        "commit served flat falls back to the exact scan",
+    )
+    sv.add_argument(
+        "--ivf-clusters", dest="ivf_clusters", type=int, default=0,
+        help="cluster count when building IVF at startup from a flat "
+        "commit (0 = ~sqrt(N))",
+    )
+    sv.add_argument(
+        "--probes", type=int, default=8,
+        help="IVF clusters scored per query (recall-vs-latency knob; "
+        "clamped to the cluster count; default 8)",
+    )
+    sv.add_argument(
+        "--scoring", choices=["fp32", "bf16", "int8"], default="fp32",
+        help="similarity-matmul dtype: fp32 (oracle precision), bf16 "
+        "(half the scan bandwidth/MXU cost), int8 (IVF only: "
+        "per-cluster-scale quantized slab) — gate reduced modes with "
+        "the recall-parity harness (docs/SERVING.md)",
+    )
+    sv.add_argument(
+        "--replicas", type=int, default=1,
+        help="QueryEngine replicas behind this front end (shared "
+        "compiled programs; least-loaded routing; per-replica drain)",
+    )
+    sv.add_argument(
+        "--admission", choices=["off", "slo"], default="off",
+        help="admission control: 'slo' sheds load (fast-reject, "
+        "counted in rejected) while a watched SLO burns and admits "
+        "again on clear — needs --live-obs (docs/SERVING.md "
+        "§Admission-control runbook)",
+    )
+    sv.add_argument(
+        "--admission-slos", dest="admission_slos", metavar="NAMES",
+        help="comma-separated SLO names driving admission (default "
+        "serve_p99,serve_queue_saturation)",
     )
     sv.add_argument("--top-k", dest="top_k", type=int, default=10)
     sv.add_argument(

@@ -60,6 +60,7 @@ class LiveObservatory:
         self.alerts = AlertEngine(self.alerts_path, min_ticks=min_ticks,
                                   clock=clock)
         self.probes: List[Callable[[], None]] = []
+        self.listeners: List[Callable[[List[Any]], None]] = []
         self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -68,6 +69,14 @@ class LiveObservatory:
         """Register a per-tick gauge setter (freshness ages etc.); a
         probe raising is logged once per tick, never fatal."""
         self.probes.append(fn)
+
+    def add_listener(self, fn: Callable[[List[Any]], None]) -> None:
+        """Register a per-tick consumer of the COMMITTED SLO statuses —
+        the actuation hook (serve admission control sheds load on burn
+        through exactly this stream, so actuators and the pager can
+        never disagree about the burn state).  A listener raising is
+        logged, never fatal."""
+        self.listeners.append(fn)
 
     # -- evaluation --------------------------------------------------------
 
@@ -84,6 +93,11 @@ class LiveObservatory:
         events = self.alerts.update(statuses, now)
         for ev in events:
             log.warning("ALERT %s: %s", ev["state"], ev["message"])
+        for fn in self.listeners:
+            try:
+                fn(statuses)
+            except Exception as e:  # noqa: BLE001 — actuation best-effort
+                log.error("live-obs listener failed: %s", e)
         return events
 
     def health(self) -> Dict[str, Any]:

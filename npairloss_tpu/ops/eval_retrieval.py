@@ -120,71 +120,13 @@ def evaluate_embeddings(
 #
 # CUB/SOP papers report NMI alongside Recall@K: k-means over the test
 # embeddings (k = number of classes), then normalized mutual information
-# between cluster assignments and ground-truth labels.
+# between cluster assignments and ground-truth labels.  The k-means
+# itself (farthest-point seeding + Lloyd's) lives in ``ops.kmeans`` —
+# ONE implementation shared with the serving-side IVF index builder
+# (serve/ivf.py), re-exported here so the eval protocol's entry point
+# stays where the papers' metric is computed.
 
-
-@functools.partial(jax.jit, static_argnames=("k", "iters"))
-def kmeans_assign(
-    embeddings: jax.Array,
-    k: int,
-    iters: int = 20,
-    seed: int = 0,
-) -> jax.Array:
-    """Lloyd's k-means on-device; returns the (N,) cluster assignment.
-
-    Centroids init by deterministic farthest-point traversal (the
-    greedy k-means++ variant): a seeded random first point, then each
-    next centroid is the point maximizing the min distance to those
-    already chosen.  A seeded-permutation init — the obvious
-    alternative — routinely seeds one tight cluster twice and misses
-    another entirely, and Lloyd's cannot escape that local optimum
-    (a perfectly separable gallery then scores NMI ~0.9, not 1.0).
-    Ties in the argmax break to the lowest index, so the assignment
-    is deterministic for a given seed.  Empty clusters keep their
-    previous centroid.  Euclidean on L2-normalized embeddings ==
-    cosine, matching the retrieval metric.
-    """
-    n, d = embeddings.shape
-    x = embeddings.astype(jnp.float32)
-    first = jax.random.randint(jax.random.PRNGKey(seed), (), 0, n)
-    centroids0 = jnp.zeros((k, d), jnp.float32).at[0].set(x[first])
-
-    def pick(i, carry):
-        centroids, min_sq = carry
-        sq = jnp.sum((x - centroids[i - 1]) ** 2, axis=1)
-        min_sq = jnp.minimum(min_sq, sq)
-        nxt = jnp.argmax(min_sq)
-        return centroids.at[i].set(x[nxt]), min_sq
-
-    centroids, _ = jax.lax.fori_loop(
-        1, k, pick, (centroids0, jnp.full((n,), jnp.inf, jnp.float32))
-    )
-
-    def step(centroids, _):
-        # (N, k) squared distances via the expansion trick — no N x k x d
-        # intermediate.
-        sq = (
-            jnp.sum(x * x, 1, keepdims=True)
-            - 2.0 * x @ centroids.T
-            + jnp.sum(centroids * centroids, 1)[None, :]
-        )
-        assign = jnp.argmin(sq, axis=1)
-        one_hot = jax.nn.one_hot(assign, k, dtype=jnp.float32)
-        counts = one_hot.sum(0)
-        sums = one_hot.T @ x
-        new = jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0),
-            centroids,
-        )
-        return new, None
-
-    centroids, _ = jax.lax.scan(step, centroids, None, length=iters)
-    sq = (
-        jnp.sum(x * x, 1, keepdims=True)
-        - 2.0 * x @ centroids.T
-        + jnp.sum(centroids * centroids, 1)[None, :]
-    )
-    return jnp.argmin(sq, axis=1)
+from npairloss_tpu.ops.kmeans import kmeans_assign  # noqa: F401 — shared impl
 
 
 def nmi(labels_a: np.ndarray, labels_b: np.ndarray) -> float:

@@ -1,0 +1,195 @@
+"""Shared k-means: farthest-point seeding + Lloyd's — ONE implementation.
+
+Two consumers, one math (ISSUE 11 / ROADMAP item 2):
+
+  * the offline clustering-quality metric (``ops.eval_retrieval``
+    re-exports :func:`kmeans_assign` for the NMI protocol — identity-
+    pinned by tests/test_ivf.py, so the eval numbers and the serving
+    index can never drift apart);
+  * the serving-side IVF index builder (``serve.ivf``), which needs the
+    CENTROIDS (not just assignments) and must scale past the
+    N x k distance matrix a 10^6-row gallery would materialize —
+    :func:`kmeans_fit` trains on a bounded sample and
+    :func:`assign_to_centroids` streams the full assignment in fixed
+    row blocks (the ``gallery_recall_at_k`` trick applied to k-means).
+
+Centroid seeding is the deterministic farthest-point traversal (the
+greedy k-means++ variant): a seeded random first point, then each next
+centroid is the point maximizing the min distance to those already
+chosen.  A seeded-permutation init — the obvious alternative —
+routinely seeds one tight cluster twice and misses another entirely,
+and Lloyd's cannot escape that local optimum.  Ties break to the lowest
+index, so results are deterministic for a given seed.  Empty clusters
+keep their previous centroid.  Euclidean on L2-normalized embeddings ==
+cosine, matching the retrieval metric and the serving score.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sq_dists(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """(N, k) squared distances via the expansion trick — no N x k x d
+    intermediate."""
+    return (
+        jnp.sum(x * x, 1, keepdims=True)
+        - 2.0 * x @ centroids.T
+        + jnp.sum(centroids * centroids, 1)[None, :]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def farthest_point_init(x: jax.Array, k: int, seed: int = 0) -> jax.Array:
+    """Deterministic farthest-point centroid seeding; returns (k, d).
+
+    With k > N the argmax over an all-zero min-distance vector repeats
+    point 0 — duplicate centroids whose surplus clusters come out empty
+    after Lloyd's (the IVF layout masks them; see serve/ivf.py).
+    """
+    n, d = x.shape
+    x = x.astype(jnp.float32)
+    first = jax.random.randint(jax.random.PRNGKey(seed), (), 0, n)
+    centroids0 = jnp.zeros((k, d), jnp.float32).at[0].set(x[first])
+
+    def pick(i, carry):
+        centroids, min_sq = carry
+        sq = jnp.sum((x - centroids[i - 1]) ** 2, axis=1)
+        min_sq = jnp.minimum(min_sq, sq)
+        nxt = jnp.argmax(min_sq)
+        return centroids.at[i].set(x[nxt]), min_sq
+
+    centroids, _ = jax.lax.fori_loop(
+        1, k, pick, (centroids0, jnp.full((n,), jnp.inf, jnp.float32))
+    )
+    return centroids
+
+
+def _lloyd_step(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """One Lloyd iteration; empty clusters keep their centroid."""
+    k = centroids.shape[0]
+    assign = jnp.argmin(_sq_dists(x, centroids), axis=1)
+    one_hot = jax.nn.one_hot(assign, k, dtype=jnp.float32)
+    counts = one_hot.sum(0)
+    sums = one_hot.T @ x
+    return jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0),
+        centroids,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def lloyd_iterate(x: jax.Array, centroids: jax.Array,
+                  iters: int = 20) -> jax.Array:
+    """``iters`` Lloyd refinement steps on fixed data; returns (k, d)."""
+    x = x.astype(jnp.float32)
+
+    def step(c, _):
+        return _lloyd_step(x, c), None
+
+    centroids, _ = jax.lax.scan(step, centroids, None, length=iters)
+    return centroids
+
+
+@functools.partial(jax.jit, static_argnames=("k", "iters"))
+def kmeans_assign(
+    embeddings: jax.Array,
+    k: int,
+    iters: int = 20,
+    seed: int = 0,
+) -> jax.Array:
+    """Lloyd's k-means on-device; returns the (N,) cluster assignment.
+
+    The offline clustering-quality entry point (NMI protocol,
+    ``ops.eval_retrieval``): farthest-point init + ``iters`` Lloyd
+    steps + final argmin, all over the FULL point set — fine at eval
+    sizes, quadratic-memory at gallery scale (the IVF builder uses
+    :func:`kmeans_fit` + :func:`assign_to_centroids` instead, same
+    seeding and refinement math).
+    """
+    x = embeddings.astype(jnp.float32)
+    centroids = farthest_point_init(x, k, seed)
+    centroids = lloyd_iterate(x, centroids, iters)
+    return jnp.argmin(_sq_dists(x, centroids), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _assign_blocks(x: jax.Array, centroids: jax.Array,
+                   block: int) -> jax.Array:
+    """Streamed nearest-centroid assignment: row blocks through one
+    ``lax.map``, so the N x k distance matrix is never materialized.
+    The final clamped block overlaps an earlier one; overwrite
+    semantics deduplicate exactly (duplicated rows carry identical
+    assignments) — the ``gallery_recall_at_k`` pattern."""
+    n = x.shape[0]
+    b = int(min(block, n))
+    n_blocks = -(-n // b)
+    x = x.astype(jnp.float32)
+    c = centroids.astype(jnp.float32)
+
+    def one_block(start):
+        q = jax.lax.dynamic_slice_in_dim(x, start, b, axis=0)
+        a = jnp.argmin(_sq_dists(q, c), axis=1).astype(jnp.int32)
+        return start + jnp.arange(b, dtype=jnp.int32), a
+
+    starts = jnp.minimum(
+        jnp.arange(n_blocks, dtype=jnp.int32) * b, max(n - b, 0)
+    )
+    rows, assign = jax.lax.map(one_block, starts)
+    out = jnp.zeros((n,), jnp.int32)
+    return out.at[rows.reshape(-1)].set(assign.reshape(-1))
+
+
+def assign_to_centroids(
+    embeddings: np.ndarray,
+    centroids: np.ndarray,
+    block: int = 65536,
+) -> np.ndarray:
+    """Host-side full-set assignment against fixed centroids, streamed
+    in ``block``-row slabs; numpy in, (N,) int32 out."""
+    return np.asarray(_assign_blocks(
+        jnp.asarray(np.asarray(embeddings, np.float32)),
+        jnp.asarray(np.asarray(centroids, np.float32)),
+        block,
+    ))
+
+
+def kmeans_fit(
+    embeddings: np.ndarray,
+    k: int,
+    iters: int = 20,
+    seed: int = 0,
+    train_size: Optional[int] = None,
+    block: int = 65536,
+) -> np.ndarray:
+    """Fit centroids at gallery scale; returns host (k, d) float32.
+
+    Farthest-point seeding + Lloyd refinement run on a seeded
+    ``train_size``-row subsample when the set is larger (k-means
+    centroid QUALITY saturates well below gallery size, while the
+    init's k x N distance sweep does not) — the full set only pays the
+    streamed :func:`assign_to_centroids` pass, which the IVF builder
+    does anyway.  ``k`` is clamped to the training-set size.
+    """
+    x = np.asarray(embeddings, np.float32)
+    n = x.shape[0]
+    if n == 0:
+        raise ValueError("cannot fit k-means on an empty set")
+    train = x
+    if train_size is not None and n > int(train_size):
+        sel = np.random.default_rng(seed).choice(
+            n, size=int(train_size), replace=False)
+        sel.sort()
+        train = x[sel]
+    k = int(min(k, train.shape[0]))
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    xd = jnp.asarray(train)
+    centroids = farthest_point_init(xd, k, seed)
+    centroids = lloyd_iterate(xd, centroids, iters)
+    return np.asarray(centroids)

@@ -45,6 +45,13 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               before it drains the queue, so admissions
                               pile up — drives the queue-saturation
                               watchdog and the backpressure path
+  ``serve.replica_crash``     kill one serving replica mid-dispatch
+                              (serve/replicas.py): its in-flight batch
+                              fails, queued batches on it fail fast, the
+                              router stops selecting it, and the
+                              remaining replicas absorb the load — the
+                              front end's answered+errors+rejected
+                              invariant must hold through the crash
   ==========================  =============================================
 
 ``times`` counts fires: an armed point fires its next ``times`` checks

@@ -12,15 +12,29 @@ extracted gallery become a running service:
   * :mod:`.engine` — :class:`QueryEngine`, the jitted query path:
     encode -> normalize -> block-streamed sharded similarity matmul +
     merged ``lax.top_k``, warmed once per padding bucket;
+  * :mod:`.ivf` — :class:`IVFIndex`, the clustered (inverted-file)
+    approximate index: shared-``ops.kmeans`` centroids, cluster-packed
+    layout, probe-top-C query path with fp32/bf16/int8 scoring, atomic
+    add-republish — flat stays the recall oracle it is gated against;
   * :mod:`.batcher` — :class:`MicroBatcher`, deadline-bounded query
     coalescing into fixed padding buckets over a bounded admission
     queue (reject-with-backpressure);
+  * :mod:`.replicas` — :class:`ReplicaSet`, N engines behind one front
+    end: shared compiled programs, least-loaded routing, per-replica
+    drain, ``serve.replica_crash`` containment;
+  * :mod:`.admission` — :class:`AdmissionController`, SLO-burn-driven
+    load shedding (the live observatory acting on load instead of just
+    paging), counted in the ``rejected`` invariant;
   * :mod:`.server` — :class:`RetrievalServer`, the stdin/JSONL and
     localhost-HTTP front ends with graceful SIGTERM drain
     (``resilience.preempt`` semantics, exit 75) and per-request
     ``serve/*`` telemetry spans.
 """
 
+from npairloss_tpu.serve.admission import (
+    AdmissionConfig,
+    AdmissionController,
+)
 from npairloss_tpu.serve.batcher import (
     BatcherConfig,
     MicroBatcher,
@@ -28,16 +42,23 @@ from npairloss_tpu.serve.batcher import (
 )
 from npairloss_tpu.serve.engine import EngineConfig, QueryEngine
 from npairloss_tpu.serve.index import GalleryIndex
+from npairloss_tpu.serve.ivf import IVFIndex
+from npairloss_tpu.serve.replicas import ReplicaCrashError, ReplicaSet
 from npairloss_tpu.serve.server import Freshness, RetrievalServer, ServerConfig
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
     "BatcherConfig",
     "EngineConfig",
     "Freshness",
     "GalleryIndex",
+    "IVFIndex",
     "MicroBatcher",
     "QueryEngine",
     "QueueFullError",
+    "ReplicaCrashError",
+    "ReplicaSet",
     "RetrievalServer",
     "ServerConfig",
 ]

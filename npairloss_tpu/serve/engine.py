@@ -51,6 +51,7 @@ from jax.sharding import PartitionSpec as P
 from npairloss_tpu.ops.normalize import l2_normalize
 from npairloss_tpu.parallel._compat import shard_map
 from npairloss_tpu.serve.index import GalleryIndex, l2_normalize_rows
+from npairloss_tpu.serve.ivf import SCORINGS, IVFIndex
 
 log = logging.getLogger("npairloss_tpu.serve")
 
@@ -69,11 +70,23 @@ class EngineConfig:
     micro-batch pads to the smallest bucket that fits, so steady state
     dispatches only ``len(buckets)`` distinct programs.  ``top_k`` is
     the answer length; ``gallery_block`` the gallery rows streamed per
-    scan step inside a shard (bounds the similarity working set)."""
+    scan step inside a shard (bounds the similarity working set).
+
+    ``probes`` is the IVF probe width (clusters scored per query —
+    clamped to the cluster count; ignored by a flat index).
+    ``scoring`` picks the similarity-matmul dtype: ``fp32`` is the
+    oracle's HIGHEST-precision path; ``bf16`` halves the scan's
+    bandwidth/MXU cost (the ring bf16 bench row's ~6.7x headroom);
+    ``int8`` additionally quantizes the stored slab with a per-cluster
+    scale (IVF only — flat storage has no cluster to scale by).  Both
+    reduced modes are gated by the recall-parity harness
+    (docs/SERVING.md §Approximate index)."""
 
     top_k: int = 10
     buckets: Tuple[int, ...] = (1, 8, 32)
     gallery_block: int = 4096
+    probes: int = 8
+    scoring: str = "fp32"
 
     def __post_init__(self):
         if not self.buckets or list(self.buckets) != sorted(
@@ -83,9 +96,35 @@ class EngineConfig:
             )
         if self.top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.probes < 1:
+            raise ValueError(f"probes must be >= 1, got {self.probes}")
+        if self.scoring not in SCORINGS:
+            raise ValueError(
+                f"scoring must be one of {SCORINGS}, got {self.scoring!r}"
+            )
 
 
-def _stream_topk(q, emb, labels_unused, valid, k: int, block: int):
+def _scored_matmul(q, g, scoring: str):
+    """The similarity gemm in the configured dtype, fp32-accumulated:
+    ``fp32`` is the oracle's HIGHEST path; ``bf16`` casts both sides
+    (MXU-native width; the recall-parity harness gates the answer
+    drift).  ``g`` may arrive int8 (the IVF quantized slab) — the cast
+    happens AFTER the gather, so the bandwidth win is real; the caller
+    applies the per-cluster scale to the product."""
+    if scoring == "fp32":
+        return jnp.dot(
+            q, g.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    return jnp.dot(
+        q.astype(jnp.bfloat16), g.astype(jnp.bfloat16).T,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _stream_topk(q, emb, labels_unused, valid, k: int, block: int,
+                 scoring: str = "fp32"):
     """Running top-k of ``q @ emb.T`` over gallery blocks.
 
     Returns (scores, rows) of shape (B, k) with rows GLOBAL over ``emb``
@@ -108,11 +147,7 @@ def _stream_topk(q, emb, labels_unused, valid, k: int, block: int):
         # separate regions in `prof --step serve` (obs.perf) — the
         # split that decides whether bf16/int8 scoring pays.
         with jax.named_scope("serve/score"):
-            sims = jnp.dot(
-                q, g.T,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
+            sims = _scored_matmul(q, g, scoring)
         rows = start + jnp.arange(b, dtype=jnp.int32)
         # Mask padding rows AND the final block's clamped overlap (rows
         # below the unclamped start were scored by an earlier block — a
@@ -142,6 +177,106 @@ def _stream_topk(q, emb, labels_unused, valid, k: int, block: int):
     return best_s, best_r
 
 
+def _ivf_probe_topk(q, packed, rows, centroids, cvalid, scale,
+                    k: int, probes: int, scoring: str, g0):
+    """Probe-top-C clustered top-k over one shard's packed slab.
+
+    ``q`` (B, D) replicated; ``packed`` (KC_local, cap, D) this shard's
+    cluster slabs (fp32/bf16, or int8 with ``scale`` (KC_local,));
+    ``rows`` (KC_local, cap) GLOBAL gallery row ids (-1 pad);
+    ``centroids``/``cvalid`` the full replicated (KC, D)/(KC,) tables;
+    ``g0`` this shard's first global cluster id.  Returns (B, kl)
+    scores + global rows, kl = min(k, probes*cap) — all shards compute
+    the SAME global probe set from the replicated centroids, each
+    gathers only the probed clusters it owns (the rest mask to -inf),
+    and the cross-shard merge is exactly the flat engine's.
+
+    Every static extent (cap, probe width, kl) derives from the TRACED
+    shapes, so an ``add()`` that grows ``cap`` forces the retrace that
+    recomputes them — the flat path's add contract, kept.
+    """
+    kc_full = centroids.shape[0]
+    kc_local = packed.shape[0]
+    cap = packed.shape[1]
+    c = min(probes, kc_full)
+    kl = min(k, c * cap)
+    bq = q.shape[0]
+
+    with jax.named_scope("serve/probe"):
+        # Centroid scan: one small (B, KC) gemm picks the probe set.
+        # Padded/empty clusters mask out so a probe slot is never
+        # wasted on a slab of -1 rows while a real cluster waits.
+        cs = jnp.dot(
+            q, centroids.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        cs = jnp.where(cvalid[None, :], cs, jnp.float32(_NEG_FILL))
+        _, probe = jax.lax.top_k(cs, c)  # (B, c) global cluster ids
+
+    def one_probe(carry, j):
+        best_s, best_r = carry
+        cid = probe[:, j]
+        owned = (cid >= g0) & (cid < g0 + kc_local)
+        lid = jnp.where(owned, cid - g0, 0)
+        g = packed[lid]   # (B, cap, D) gather — the scan's working set
+        r = rows[lid]     # (B, cap) global row ids
+        with jax.named_scope("serve/score"):
+            if scoring == "fp32":
+                sims = jnp.einsum(
+                    "bcd,bd->bc", g, q,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+            else:
+                sims = jnp.einsum(
+                    "bcd,bd->bc",
+                    g.astype(jnp.bfloat16), q.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+                if scale is not None:
+                    sims = sims * scale[lid][:, None]
+        ok = (r >= 0) & owned[:, None]
+        with jax.named_scope("serve/merge"):
+            sims = jnp.where(ok, sims, jnp.float32(_NEG_FILL))
+            kb = min(kl, cap)
+            blk_s, blk_i = jax.lax.top_k(sims, kb)
+            blk_r = jnp.take_along_axis(r, blk_i, axis=1)
+            cand_s = jnp.concatenate([best_s, blk_s], axis=1)
+            cand_r = jnp.concatenate([best_r, blk_r], axis=1)
+            new_s, sel = jax.lax.top_k(cand_s, kl)
+            new_r = jnp.take_along_axis(cand_r, sel, axis=1)
+        return (new_s, new_r), None
+
+    init = (
+        jnp.full((bq, kl), jnp.float32(_NEG_FILL)),
+        jnp.zeros((bq, kl), jnp.int32),
+    )
+    (best_s, best_r), _ = jax.lax.scan(
+        one_probe, init, jnp.arange(c, dtype=jnp.int32)
+    )
+    return best_s, best_r
+
+
+def _finalize_topk(s, r, k: int):
+    """Clamp an IVF candidate list to the answer shape (B, k): pad with
+    -inf columns when the probe set cannot yield k candidates, and pin
+    every unfilled slot's row to 0 (a VALID gallery row — the host-side
+    label/id mapping must never index with a mask sentinel)."""
+    kl = s.shape[1]
+    if kl < k:
+        pad = k - kl
+        s = jnp.concatenate(
+            [s, jnp.full((s.shape[0], pad), jnp.float32(_NEG_FILL))], 1)
+        r = jnp.concatenate(
+            [r, jnp.zeros((r.shape[0], pad), jnp.int32)], 1)
+    else:
+        s, sel = jax.lax.top_k(s, k)
+        r = jnp.take_along_axis(r, sel, axis=1)
+    r = jnp.where(s > jnp.float32(_NEG_FILL) * 0.5, r, 0)
+    return s, r
+
+
 class QueryEngine:
     """Answers ``(B, D)`` query embeddings with the gallery's top-k.
 
@@ -160,6 +295,7 @@ class QueryEngine:
         model=None,
         state: Optional[Dict[str, Any]] = None,
         telemetry=None,
+        share_compiled_with: Optional["QueryEngine"] = None,
     ):
         if cfg.top_k > index.size:
             raise ValueError(
@@ -174,18 +310,49 @@ class QueryEngine:
         self.compiles_total = 0
         self.compiles_after_warmup = 0
         self._guard = os.environ.get(COMPILE_GUARD_ENV, "").strip().lower()
-        self._seen_sigs: set = set()
-        self._build_fns()
+        self._ivf = isinstance(index, IVFIndex)
+        if cfg.scoring == "int8" and not self._ivf:
+            raise ValueError(
+                "scoring='int8' needs an IVF index (the per-cluster "
+                "scale has no flat-gallery equivalent); use bf16 or "
+                "--index-kind ivf"
+            )
+        if share_compiled_with is not None:
+            # Replica-tier compile sharing (docs/SERVING.md): replicas
+            # of ONE index+config reuse the primary's jitted callables
+            # AND its signature set, so warming the primary warms the
+            # whole tier and no replica ever pays (or falsely counts)
+            # a duplicate XLA compile.
+            other = share_compiled_with
+            if other.index is not index or other.cfg != cfg:
+                raise ValueError(
+                    "share_compiled_with requires the same index object "
+                    "and an identical EngineConfig"
+                )
+            self._seen_sigs = other._seen_sigs
+            self._topk_fn = other._topk_fn
+            self._encode_fn = other._encode_fn
+        else:
+            self._seen_sigs: set = set()
+            self._build_fns()
 
     # -- jitted programs ---------------------------------------------------
 
     def _build_fns(self) -> None:
+        if self._ivf:
+            self._build_ivf_fns()
+        else:
+            self._build_flat_fns()
+        self._build_encode_fn()
+
+    def _build_flat_fns(self) -> None:
         k = self.cfg.top_k
         block = self.cfg.gallery_block
+        scoring = self.cfg.scoring
         index = self.index
 
         def topk_single(q, emb, labels, valid):
-            return _stream_topk(q, emb, labels, valid, k, block)
+            return _stream_topk(q, emb, labels, valid, k, block, scoring)
 
         if index.mesh is not None:
             mesh, axis = index.mesh, index.axis
@@ -197,7 +364,8 @@ class QueryEngine:
                 # must compute offsets for the NEW layout.
                 shard_n = emb.shape[0]
                 kl = min(k, shard_n)
-                s, r = _stream_topk(q, emb, labels, valid, kl, block)
+                s, r = _stream_topk(q, emb, labels, valid, kl, block,
+                                    scoring)
                 offset = jax.lax.axis_index(axis) * shard_n
                 return s[None], (r + offset)[None]
 
@@ -224,6 +392,60 @@ class QueryEngine:
         else:
             self._topk_fn = jax.jit(topk_single)
 
+    def _build_ivf_fns(self) -> None:
+        """The probe-top-C clustered path (serve/ivf.py): centroid scan
+        -> gather probed clusters -> scored top-k merge across clusters
+        and mesh shards.  Same dispatch protocol as the flat path —
+        (B, k) scores + GLOBAL gallery rows — so the server, warmup,
+        and compile accounting are unchanged."""
+        k = self.cfg.top_k
+        probes = self.cfg.probes
+        scoring = self.cfg.scoring
+        index = self.index
+        with_scale = scoring == "int8"
+
+        def single(q, packed, rows, cents, cvalid, scale=None):
+            s, r = _ivf_probe_topk(
+                q, packed, rows, cents, cvalid, scale,
+                k=k, probes=probes, scoring=scoring, g0=0)
+            return _finalize_topk(s, r, k)
+
+        if index.mesh is not None:
+            mesh, axis = index.mesh, index.axis
+            g = mesh.size
+
+            def per_shard(q, packed, rows, cents, cvalid, scale=None):
+                kc_local = packed.shape[0]
+                g0 = jax.lax.axis_index(axis) * kc_local
+                s, r = _ivf_probe_topk(
+                    q, packed, rows, cents, cvalid, scale,
+                    k=k, probes=probes, scoring=scoring, g0=g0)
+                return s[None], r[None]
+
+            specs = [P(), P(axis), P(axis), P(), P()]
+            if with_scale:
+                specs.append(P(axis))
+            sharded = shard_map(
+                per_shard, mesh=mesh,
+                in_specs=tuple(specs),
+                out_specs=(P(axis), P(axis)),
+            )
+
+            def topk(q, packed, rows, cents, cvalid, scale=None):
+                args = (q, packed, rows, cents, cvalid)
+                if with_scale:
+                    args += (scale,)
+                s, r = sharded(*args)
+                _, _, kl = s.shape
+                s = jnp.transpose(s, (1, 0, 2)).reshape(q.shape[0], g * kl)
+                r = jnp.transpose(r, (1, 0, 2)).reshape(q.shape[0], g * kl)
+                return _finalize_topk(s, r, k)
+
+            self._topk_fn = jax.jit(topk)
+        else:
+            self._topk_fn = jax.jit(single)
+
+    def _build_encode_fn(self) -> None:
         if self.model is not None:
             model = self.model
 
@@ -349,6 +571,25 @@ class QueryEngine:
             for key in outs[0]
         }
 
+    def _topk_call(self, bucket: int):
+        """(dispatch args, compile signature) for the current index
+        state — read ONCE per dispatch, so an IVF republish (add())
+        lands between dispatches, never inside one."""
+        idx = self.index
+        if self._ivf:
+            layout = idx.layout
+            slab, scale = idx.scored_arrays(self.cfg.scoring,
+                                            layout=layout)
+            args = (slab, layout.rows, layout.centroids,
+                    layout.cluster_valid)
+            if scale is not None:
+                args += (scale,)
+            sig = ("ivf", bucket, tuple(layout.packed.shape),
+                   self.cfg.scoring)
+            return args, sig
+        return ((idx.emb, idx.labels, idx.valid),
+                ("topk", bucket, idx.padded_size, idx.dim))
+
     def _query_bucketed(self, q: np.ndarray) -> Dict[str, np.ndarray]:
         n = q.shape[0]
         bucket = self.bucket_for(n)
@@ -357,12 +598,10 @@ class QueryEngine:
                 [q, np.zeros((bucket - n, q.shape[1]), np.float32)]
             )
         idx = self.index
-        sig = ("topk", bucket, idx.padded_size, idx.dim)
+        args, sig = self._topk_call(bucket)
         n_before = self._cache_size()
         with self._span("serve/topk", batch=n, bucket=bucket):
-            scores, rows = self._topk_fn(
-                jnp.asarray(q), idx.emb, idx.labels, idx.valid
-            )
+            scores, rows = self._topk_fn(jnp.asarray(q), *args)
             scores = np.asarray(scores)[:n]
             rows = np.asarray(rows)[:n]
         self._count_compiles(sig, n_before)

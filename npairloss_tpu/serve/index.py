@@ -53,6 +53,10 @@ log = logging.getLogger("npairloss_tpu.serve")
 INDEX_KIND = "gallery-index"
 INDEX_SUFFIX = ".gidx"
 _ARRAYS = ("emb", "labels", "ids")
+# Committed-index kind -> loader class; ``ivf-index`` registers itself
+# on import (serve/ivf.py) so load_index/load_newest dispatch without a
+# hard import cycle.
+_KIND_REGISTRY: dict = {}
 
 
 def l2_normalize_rows(x: np.ndarray) -> np.ndarray:
@@ -75,6 +79,12 @@ class GalleryIndex:
     global gallery row back through it.  Build via :meth:`build` /
     :meth:`load`, never the raw constructor.
     """
+
+    # Persistence identity: subclasses (serve/ivf.py's IVFIndex)
+    # override these to commit extra arrays under their own kind while
+    # reusing the one save/load/commit path.
+    KIND = INDEX_KIND
+    ARRAY_NAMES = _ARRAYS
 
     emb: jax.Array
     labels: jax.Array
@@ -173,21 +183,16 @@ class GalleryIndex:
     def dim(self) -> int:
         return int(self.emb.shape[1])
 
-    def add(
+    def _validate_added_rows(
         self,
         embeddings: np.ndarray,
         labels: np.ndarray,
-        ids: Optional[np.ndarray] = None,
-        normalize: bool = True,
-    ) -> int:
-        """Incrementally append rows and re-place the gallery.
-
-        O(N) host work + one fresh placement — the padded/sharded layout
-        must be rebuilt, so adds are for index-refresh cadence (seconds),
-        not the per-query hot path.  Returns the new ``size``.  The
-        engine notices the new placement on its next dispatch; a changed
-        PADDED size is a new program signature (one recompile, counted).
-        """
+        ids: Optional[np.ndarray],
+        normalize: bool,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Coerce/validate an ``add()`` payload against this gallery
+        (shared with the IVF subclass, whose add must also re-assign
+        the rows into clusters before re-placing)."""
         emb = np.asarray(embeddings, np.float32)
         lab = np.asarray(labels, np.int32).reshape(-1)
         if emb.ndim != 2 or emb.shape[1] != self._host_emb.shape[1]:
@@ -210,6 +215,25 @@ class GalleryIndex:
                 raise ValueError(
                     f"ids {ids.shape} / embeddings {emb.shape} mismatch"
                 )
+        return emb, lab, ids
+
+    def add(
+        self,
+        embeddings: np.ndarray,
+        labels: np.ndarray,
+        ids: Optional[np.ndarray] = None,
+        normalize: bool = True,
+    ) -> int:
+        """Incrementally append rows and re-place the gallery.
+
+        O(N) host work + one fresh placement — the padded/sharded layout
+        must be rebuilt, so adds are for index-refresh cadence (seconds),
+        not the per-query hot path.  Returns the new ``size``.  The
+        engine notices the new placement on its next dispatch; a changed
+        PADDED size is a new program signature (one recompile, counted).
+        """
+        emb, lab, ids = self._validate_added_rows(
+            embeddings, labels, ids, normalize)
         import time
 
         self._host_emb = np.concatenate([self._host_emb, emb])
@@ -247,12 +271,12 @@ class GalleryIndex:
         tmp = f"{final}{TMP_MARKER}{nonce}"
         os.makedirs(tmp)
         tree = self._tree()
-        for name in _ARRAYS:
+        for name in self.ARRAY_NAMES:
             np.save(os.path.join(tmp, name + ".npy"), tree[name])
         write_manifest(
             tmp, 0, state_checksums(tree),
-            extra={"kind": INDEX_KIND, "size": self.size,
-                   "dim": self.dim},
+            extra={"kind": self.KIND, "size": self.size,
+                   "dim": self.dim, **self._manifest_extra()},
         )
         old = None
         if os.path.isdir(final):
@@ -275,6 +299,10 @@ class GalleryIndex:
                  final, self.size, self.dim)
         return final
 
+    def _manifest_extra(self) -> dict:
+        """Extra manifest keys a subclass commits (IVF: cluster count)."""
+        return {}
+
     @classmethod
     def load(
         cls,
@@ -286,13 +314,13 @@ class GalleryIndex:
         manifest; raises :class:`SnapshotValidationError` on a torn or
         corrupt index instead of serving garbage answers."""
         manifest = validate_snapshot(os.path.abspath(path))
-        if manifest.get("kind") != INDEX_KIND:
+        if manifest.get("kind") != cls.KIND:
             raise SnapshotValidationError(
-                f"{path} is not a gallery index "
+                f"{path} is not a {cls.KIND} "
                 f"(kind={manifest.get('kind')!r})"
             )
         tree = {}
-        for name in _ARRAYS:
+        for name in cls.ARRAY_NAMES:
             p = os.path.join(path, name + ".npy")
             try:
                 tree[name] = np.load(p)
@@ -301,8 +329,16 @@ class GalleryIndex:
                     f"unreadable index array {p}: {e}"
                 ) from e
         verify_restored(tree, manifest)
+        idx = cls._from_tree(tree, manifest, mesh, axis)
+        idx._place()
+        return idx
+
+    @classmethod
+    def _from_tree(cls, tree, manifest, mesh, axis) -> "GalleryIndex":
+        """Instance from verified arrays (pre-``_place``); subclasses
+        extend with their extra arrays."""
         created = manifest.get("created")
-        idx = cls(
+        return cls(
             emb=None, labels=None, valid=None,  # type: ignore
             ids=np.asarray(tree["ids"], np.int64),
             size=int(tree["emb"].shape[0]), mesh=mesh, axis=axis,
@@ -311,8 +347,6 @@ class GalleryIndex:
             _host_emb=np.asarray(tree["emb"], np.float32),
             _host_labels=np.asarray(tree["labels"], np.int32),
         )
-        idx._place()
-        return idx
 
 
 def list_indexes(prefix: str) -> List[Tuple[str, str]]:
@@ -335,6 +369,31 @@ def list_indexes(prefix: str) -> List[Tuple[str, str]]:
     return out
 
 
+def load_index(
+    path: str,
+    mesh: Optional[Mesh] = None,
+    axis: str = "dp",
+) -> GalleryIndex:
+    """Load a committed index of ANY registered kind: the manifest's
+    ``kind`` picks the class (gallery-index -> :class:`GalleryIndex`;
+    ivf-index -> ``serve.ivf.IVFIndex``), so a serving prefix can mix
+    flat and clustered commits and a consumer need not know which it
+    got."""
+    kind = read_manifest(path).get("kind")
+    cls = _KIND_REGISTRY.get(kind, GalleryIndex if kind == INDEX_KIND
+                             else None)
+    if cls is None and kind == "ivf-index":
+        # Importing serve.ivf registers the class; lazy to avoid a
+        # module cycle (ivf imports this module).
+        import npairloss_tpu.serve.ivf  # noqa: F401
+
+        cls = _KIND_REGISTRY.get(kind)
+    if cls is None:
+        raise SnapshotValidationError(
+            f"{path}: unknown index kind {kind!r}")
+    return cls.load(path, mesh=mesh, axis=axis)
+
+
 def load_newest(
     prefix: str,
     mesh: Optional[Mesh] = None,
@@ -343,10 +402,11 @@ def load_newest(
     """Scan ``<prefix>*.gidx`` newest-first (by name — the build cadence
     names indexes sortably) and load the first one that validates,
     skipping torn/corrupt candidates with a logged reason — the serving
-    twin of ``Solver.restore_auto``.  Returns (path, index) or None."""
+    twin of ``Solver.restore_auto``.  Returns (path, index) or None;
+    the index may be any registered kind (see :func:`load_index`)."""
     for _, path in reversed(list_indexes(prefix)):
         try:
-            return path, GalleryIndex.load(path, mesh=mesh, axis=axis)
+            return path, load_index(path, mesh=mesh, axis=axis)
         except Exception as e:  # noqa: BLE001 — skip, try the next
             log.warning("index load: skipping %s: %s", path, e)
     return None

@@ -1,0 +1,144 @@
+"""ReplicaSet — N QueryEngine replicas behind one front end.
+
+The serving tier's horizontal dimension (ROADMAP item 2; the
+Gemma-serving shape from PAPERS.md — capacity is replicas x per-replica
+throughput, operated against explicit p99/QPS targets): each replica is
+one :class:`~npairloss_tpu.serve.engine.QueryEngine` with its OWN
+:class:`~npairloss_tpu.serve.batcher.MicroBatcher` (own admission
+queue, own dispatcher thread), and the front end routes each submitted
+query to the least-loaded live replica.  Replicas of one index share
+the primary engine's compiled programs
+(``QueryEngine(share_compiled_with=...)``) so warming the primary warms
+the tier and a replica restart deserializes from the shared persistent
+compile cache instead of recompiling.
+
+Crash containment: the ``serve.replica_crash`` failpoint
+(docs/RESILIENCE.md) kills a replica mid-dispatch — its in-flight batch
+fails (the front end answers those queries with errors), every batch
+still queued on it fails fast, and the router stops sending it traffic;
+the remaining replicas absorb the load.  The front end's accounting
+invariant (``queries == answered + errors + rejected``) holds through
+the crash — pinned by tests/test_serve_replicas.py and the resilience
+table.
+
+Drain is per-replica: ``close(drain=True)`` drains every live replica's
+queue to answers (the SIGTERM contract), and a dead replica's queue
+fails loudly instead of hanging the drain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Callable, List, Optional
+
+from npairloss_tpu.serve.batcher import (
+    BatcherConfig,
+    MicroBatcher,
+    QueueFullError,
+)
+
+log = logging.getLogger("npairloss_tpu.serve")
+
+
+class ReplicaCrashError(RuntimeError):
+    """A replica died (injected or real) — its in-flight work fails and
+    the router must stop sending it traffic."""
+
+
+@dataclasses.dataclass
+class Replica:
+    """One engine + its batcher + liveness."""
+
+    name: str
+    engine: Any
+    batcher: Optional[MicroBatcher] = None
+    alive: bool = True
+
+
+class ReplicaSet:
+    """Route/submit/drain across N replicas.
+
+    ``dispatch_factory(replica)`` returns the batcher dispatch callable
+    for that replica (the server wires per-replica crash containment
+    and the shared answer logic there).
+    """
+
+    def __init__(
+        self,
+        engines: List[Any],
+        batcher_cfg: BatcherConfig,
+        dispatch_factory: Callable[[Replica], Callable],
+        span_fn=None,
+        on_batch=None,
+    ):
+        if not engines:
+            raise ValueError("ReplicaSet needs at least one engine")
+        self.replicas: List[Replica] = []
+        for i, engine in enumerate(engines):
+            rep = Replica(name=f"r{i}", engine=engine)
+            rep.batcher = MicroBatcher(
+                dispatch_factory(rep), batcher_cfg,
+                span_fn=span_fn, on_batch=on_batch,
+            )
+            self.replicas.append(rep)
+        # Rejections that never reached a batcher (no live replica) —
+        # part of the aggregate ``rejected`` so the front-end invariant
+        # holds even with the whole tier down.  Lock-guarded like every
+        # other invariant term: concurrent HTTP submits against a down
+        # tier must not lose counts.
+        self.down_rejected = 0
+        self._down_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaSet":
+        for rep in self.replicas:
+            rep.batcher.start()
+        return self
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        for rep in self.replicas:
+            # A dead replica cannot drain its queue to answers — its
+            # dispatch fails every batch fast, which IS its drain.
+            rep.batcher.close(drain=drain, timeout=timeout)
+
+    # -- routing -----------------------------------------------------------
+
+    def pick(self) -> Replica:
+        """Least-loaded live replica; raises
+        :class:`~npairloss_tpu.serve.batcher.QueueFullError` when the
+        whole tier is down (counted in ``down_rejected``)."""
+        live = [r for r in self.replicas if r.alive]
+        if not live:
+            with self._down_lock:
+                self.down_rejected += 1
+            raise QueueFullError("no live replicas")
+        return min(live, key=lambda r: r.batcher.queue_depth)
+
+    def submit(self, record):
+        return self.pick().batcher.submit(record)
+
+    # -- aggregates --------------------------------------------------------
+
+    @property
+    def alive_count(self) -> int:
+        return sum(1 for r in self.replicas if r.alive)
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.batcher.queue_depth for r in self.replicas)
+
+    @property
+    def batches(self) -> int:
+        return sum(r.batcher.batches for r in self.replicas)
+
+    @property
+    def dispatched(self) -> int:
+        return sum(r.batcher.dispatched for r in self.replicas)
+
+    @property
+    def rejected(self) -> int:
+        return (sum(r.batcher.rejected for r in self.replicas)
+                + self.down_rejected)

@@ -44,11 +44,7 @@ import numpy as np
 
 from npairloss_tpu.resilience import failpoints
 from npairloss_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionSignal
-from npairloss_tpu.serve.batcher import (
-    BatcherConfig,
-    MicroBatcher,
-    QueueFullError,
-)
+from npairloss_tpu.serve.batcher import BatcherConfig, QueueFullError
 from npairloss_tpu.serve.engine import QueryEngine
 
 log = logging.getLogger("npairloss_tpu.serve")
@@ -132,19 +128,32 @@ class ServerConfig:
 
 
 class RetrievalServer:
-    """One engine + one batcher + the request/answer protocol."""
+    """N replica engines + per-replica batchers + the request/answer
+    protocol (one engine is the degenerate, pre-replica-tier shape)."""
 
     def __init__(
         self,
-        engine: QueryEngine,
+        engine,
         batcher_cfg: BatcherConfig = BatcherConfig(),
         cfg: ServerConfig = ServerConfig(),
         telemetry=None,
         preempt: Optional[PreemptionSignal] = None,
         freshness: Optional[Freshness] = None,
         live=None,
+        admission=None,
     ):
-        self.engine = engine
+        from npairloss_tpu.serve.replicas import ReplicaSet
+
+        # ``engine`` may be one QueryEngine or a sequence of replica
+        # engines (docs/SERVING.md §Approximate index): each replica
+        # gets its own batcher/admission queue; routing is least-loaded
+        # live replica.  ``self.engine`` stays the primary — compile
+        # stats and index identity are tier-wide (replicas share the
+        # primary's compiled programs).
+        engines = (list(engine) if isinstance(engine, (list, tuple))
+                   else [engine])
+        self.engines: List[QueryEngine] = engines
+        self.engine = engines[0]
         self.cfg = cfg
         self.telemetry = telemetry
         self.preempt = preempt
@@ -154,9 +163,13 @@ class RetrievalServer:
         # on /healthz.  Both default None: the pre-PR server shape.
         self.freshness = freshness
         self.live = live
-        self.batcher = MicroBatcher(
-            self._dispatch, batcher_cfg, span_fn=self._span,
-            on_batch=self._record_batch,
+        # SLO-burn-driven admission control (serve/admission.py): when
+        # set, submits consult it BEFORE routing — a shed is a
+        # fast-reject counted in the ``rejected`` invariant.
+        self.admission = admission
+        self.replicaset = ReplicaSet(
+            engines, batcher_cfg, self._replica_dispatch,
+            span_fn=self._span, on_batch=self._record_batch,
         )
         self._lat = collections.deque(maxlen=max(cfg.latency_window, 1))
         # THIS window's latencies, cleared at each emission: window rows
@@ -192,6 +205,35 @@ class RetrievalServer:
         self._events_start_idx = baseline
         self._window_events_idx = baseline
         self._window_events_lock = threading.Lock()
+
+    @property
+    def batcher(self):
+        """The primary replica's batcher (the pre-replica-tier attribute;
+        aggregate counters live on ``self.replicaset``)."""
+        return self.replicaset.replicas[0].batcher
+
+    def _replica_dispatch(self, replica):
+        """Per-replica dispatch wrapper: crash containment around the
+        shared answer logic.  The ``serve.replica_crash`` failpoint
+        (docs/RESILIENCE.md) kills THIS replica: its in-flight batch
+        fails (error answers), every batch still queued on it fails
+        fast, and the router stops selecting it."""
+        from npairloss_tpu.serve.replicas import ReplicaCrashError
+
+        def dispatch(items: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+            if not replica.alive:
+                raise ReplicaCrashError(
+                    f"replica {replica.name} is down")
+            if failpoints.should_fire("serve.replica_crash"):
+                replica.alive = False
+                log.error("replica %s crashed (injected); %d live "
+                          "replica(s) remain", replica.name,
+                          self.replicaset.alive_count)
+                raise ReplicaCrashError(
+                    f"replica {replica.name} crashed")
+            return self._dispatch(items, engine=replica.engine)
+
+        return dispatch
 
     # -- telemetry ---------------------------------------------------------
 
@@ -292,19 +334,27 @@ class RetrievalServer:
         row = {
             "qps": round(qps, 1),
             **{k: round(v, 3) for k, v in self._percentiles(lat).items()},
-            "queue_depth": self.batcher.queue_depth,
-            "batches": self.batcher.batches,
-            "rejected": self.batcher.rejected,
+            "queue_depth": self.replicaset.queue_depth,
+            "batches": self.replicaset.batches,
+            "rejected": self._rejected_total(),
             **self._window_latency_split(),
             **{f"batch_{k}": round(v, 3) if isinstance(v, float) else v
                for k, v in self._last_batch.items()},
         }
-        if self.engine.compiles_after_warmup:
+        if len(self.engines) > 1:
+            # Replica-tier keys only exist on a replicated tier, so a
+            # single-replica row stream stays byte-identical to pre-PR
+            # (the spans_dropped contract).
+            row["replicas_alive"] = self.replicaset.alive_count
+        if self.admission is not None and self.admission.sheds:
+            row["shed"] = self.admission.sheds
+        compiles = self._compiles_after_warmup()
+        if compiles:
             # The strict guard's counting twin, in-row (the
             # spans_dropped contract: present only when > 0, so clean
             # streams stay byte-identical to pre-PR) — the live-obs
             # post-warmup-compile watchdog reads exactly this key.
-            row["compiles_after_warmup"] = self.engine.compiles_after_warmup
+            row["compiles_after_warmup"] = compiles
         if self.telemetry is not None and self.telemetry.metrics_enabled:
             try:
                 self.telemetry.log("serve", self.answered, row)
@@ -314,7 +364,9 @@ class RetrievalServer:
 
     # -- serving core ------------------------------------------------------
 
-    def _dispatch(self, items: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    def _dispatch(self, items: List[Dict[str, Any]],
+                  engine: Optional[QueryEngine] = None
+                  ) -> List[Dict[str, Any]]:
         """Batcher dispatch: coalesced query records -> per-query
         answers.  A malformed record (missing field, wrong embedding
         shape, ragged input) answers ``{"id", "error"}`` WITHOUT failing
@@ -324,13 +376,15 @@ class RetrievalServer:
         merge with the embedding records for one top-k dispatch."""
         from npairloss_tpu.serve.engine import ServeCompileError
 
+        if engine is None:
+            engine = self.engine
         if failpoints.should_fire("serve.latency"):
             # Deterministic latency fault (docs/RESILIENCE.md): every
             # query in this batch pays the stall — the p99 spike the
             # live-obs alert lifecycle is tested against.  Sited here
             # (not in the engine) so warmup's dispatches stay fast.
             time.sleep(failpoints.SERVE_LATENCY_FAULT_S)
-        dim = self.engine.index.dim
+        dim = engine.index.dim
         answers: List[Optional[Dict[str, Any]]] = [None] * len(items)
         emb_rows: List[tuple] = []  # (item position, (D,) query row)
         enc_rows: List[tuple] = []  # (item position, raw input array)
@@ -356,7 +410,7 @@ class RetrievalServer:
                 answers[i] = {"id": rec.get("id"), "error": str(e)}
         if enc_rows:
             try:
-                enc = self.engine.encode(
+                enc = engine.encode(
                     np.stack([x for _, x in enc_rows])
                 )
                 emb_rows.extend(
@@ -369,7 +423,7 @@ class RetrievalServer:
                     answers[i] = {"id": items[i].get("id"),
                                   "error": str(e)}
         if emb_rows:
-            out = self.engine.query(np.stack([x for _, x in emb_rows]))
+            out = engine.query(np.stack([x for _, x in emb_rows]))
             ages = (self.freshness.ages()
                     if self.freshness is not None else {})
             for j, (i, _) in enumerate(emb_rows):
@@ -391,13 +445,33 @@ class RetrievalServer:
                 }
         return answers
 
+    def _rejected_total(self) -> int:
+        """Every rejection source, once each: batcher backpressure +
+        whole-tier-down + admission sheds — the ``rejected`` term of
+        the drain invariant."""
+        total = self.replicaset.rejected
+        if self.admission is not None:
+            total += self.admission.sheds
+        return total
+
+    def _compiles_after_warmup(self) -> int:
+        # Replicas share one signature set, so summing never double-
+        # counts a compile; single-engine this is the old value.
+        return sum(e.compiles_after_warmup for e in self.engines)
+
     def submit(self, record: Dict[str, Any]):
         """Admit one query record; returns (future, t_submit).  Raises
-        :class:`QueueFullError` on backpressure."""
+        :class:`QueueFullError` on backpressure — from a full replica
+        queue, a fully-down tier, or the admission controller shedding
+        under SLO burn (all counted in ``rejected``)."""
         with self._span("serve/admit"):
             with self._lock:  # HTTP front end submits from many threads
                 self.queries += 1
-            return self.batcher.submit(record), time.perf_counter()
+            if self.admission is not None and not self.admission.admit():
+                raise QueueFullError(
+                    "load shed: SLO burning (admission control); retry "
+                    "after backoff")
+            return self.replicaset.submit(record), time.perf_counter()
 
     def handle_many(
         self,
@@ -444,8 +518,16 @@ class RetrievalServer:
             "queries": self.queries,
             "answered": self.answered,
             "errors": self.errors,
-            "rejected": self.batcher.rejected,
-            "batches": self.batcher.batches,
+            "rejected": self._rejected_total(),
+            "batches": self.replicaset.batches,
+            # Replica/admission state only when the feature is on (the
+            # single-replica summary keeps its pre-PR shape).
+            **({"replicas": len(self.engines),
+                "replicas_alive": self.replicaset.alive_count}
+               if len(self.engines) > 1 else {}),
+            **({"shed": self.admission.sheds,
+                "shedding": self.admission.shedding}
+               if self.admission is not None else {}),
             # Freshness identity + ages (live-obs on or off): what this
             # run was answering from, and how stale it had become.
             **(self.freshness.identity()
@@ -460,7 +542,14 @@ class RetrievalServer:
             **(self._latency_split(
                 self._tracer().events_since(self._events_start_idx)[0])
                if self._tracer() is not None else {}),
-            **self.engine.compile_stats(),
+            # Compile counters are tier-wide sums (replicas share one
+            # signature set, so sums never double-count and both keys
+            # stay mutually consistent — whichever replica took a count
+            # must not make after_warmup exceed total).
+            **{**self.engine.compile_stats(),
+               "compiles_total": sum(e.compiles_total
+                                     for e in self.engines),
+               "compiles_after_warmup": self._compiles_after_warmup()},
         }
 
     def healthz(self) -> Dict[str, Any]:
@@ -473,6 +562,8 @@ class RetrievalServer:
             "draining": self._preempted(),
             **self.summary(),
         }
+        if self.admission is not None:
+            out["admission"] = self.admission.stats()
         if self.live is not None:
             out.update(self.live.health())
         return out
@@ -480,7 +571,7 @@ class RetrievalServer:
     def _drain(self) -> Dict[str, Any]:
         """Finish in-flight batches, flush telemetry, return the
         summary record.  Idempotent enough for every exit path."""
-        self.batcher.close(drain=True)
+        self.replicaset.close(drain=True)
         s = self.summary()
         if self.telemetry is not None:
             with contextlib.suppress(Exception):
@@ -499,7 +590,7 @@ class RetrievalServer:
         """Serve line-delimited JSON until EOF or preemption; answers go
         out in request order.  Returns the process exit code (0 on EOF,
         EXIT_PREEMPTED after a graceful drain)."""
-        self.batcher.start()
+        self.replicaset.start()
         pending: collections.deque = collections.deque()
         emit_lock = threading.Lock()
 
@@ -578,7 +669,7 @@ class RetrievalServer:
         finally:
             # Graceful drain on EVERY exit: stop admitting, answer every
             # in-flight query, flush telemetry — zero drops.
-            self.batcher.close(drain=True)
+            self.replicaset.close(drain=True)
             flush_ready(block=True)
             emit(self._drain())
         # A SIGTERM that lands while the reader is blocked can surface
@@ -653,7 +744,7 @@ class RetrievalServer:
                 answers = server_ref.handle_many(recs)
                 self._send(200, answers[0] if len(answers) == 1 else answers)
 
-        self.batcher.start()
+        self.replicaset.start()
         httpd = ThreadingHTTPServer((host, port), Handler)
         httpd.timeout = self.cfg.poll_s
         log.info("serving on http://%s:%d (POST /query, GET /healthz)",

@@ -50,6 +50,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HISTORY = os.path.join(REPO, "bench_cache", "bench_history.jsonl")
 LAST_GOOD = os.path.join(REPO, "bench_cache", "last_good.json")
 DEFAULT_TOL = 0.05
+# Hard (absolute, not noise-relative) gates on the approximate-index
+# bench row (ISSUE 11 / docs/SERVING.md §Approximate index): a faster-
+# but-wrong index is a regression however smooth the trajectory, and an
+# IVF path slower than 5x the flat scan has lost its reason to exist.
+IVF_RECALL_FLOOR = 0.95
+IVF_SPEEDUP_FLOOR = 5.0
 
 
 def _log(msg: str) -> None:
@@ -271,6 +277,40 @@ def check_alert_log(path: str) -> List[str]:
 
 # -- the gate -----------------------------------------------------------------
 
+def _ivf_hard_gates(new_rows: Dict[str, Dict]) -> List[str]:
+    """Absolute gates on the newest record's ``ivf_qps_1m`` row: the
+    recall@1 floor against the flat oracle, and the minimum qps speedup
+    over the ``flat_qps_1m`` twin measured in the same pass.  Rows
+    absent = coverage unchanged, nothing to gate."""
+    out: List[str] = []
+    ivf = new_rows.get("ivf_qps_1m")
+    if not isinstance(ivf, dict):
+        return out
+    r1 = ivf.get("recall_at_1")
+    if isinstance(r1, (int, float)):
+        if r1 < IVF_RECALL_FLOOR:
+            out.append(
+                f"ivf_qps_1m: recall@1 {r1:.4f} < hard floor "
+                f"{IVF_RECALL_FLOOR} (approximate answers drifted from "
+                "the brute-force oracle)")
+        else:
+            _log(f"ivf recall@1 {r1:.4f} >= floor {IVF_RECALL_FLOOR}")
+    flat = new_rows.get("flat_qps_1m")
+    ivf_qps, flat_qps = ivf.get("qps"), (flat or {}).get("qps")
+    if isinstance(ivf_qps, (int, float)) and \
+            isinstance(flat_qps, (int, float)) and flat_qps > 0:
+        speedup = ivf_qps / flat_qps
+        if speedup < IVF_SPEEDUP_FLOOR:
+            out.append(
+                f"ivf_qps_1m: {speedup:.1f}x flat qps < hard floor "
+                f"{IVF_SPEEDUP_FLOOR}x ({ivf_qps:.1f} vs {flat_qps:.1f} "
+                "qps at the 1M gallery)")
+        else:
+            _log(f"ivf speedup {speedup:.1f}x flat "
+                 f">= floor {IVF_SPEEDUP_FLOOR}x")
+    return out
+
+
 def _spread(rec: Dict[str, Any]) -> float:
     """Relative window spread = the record's own measured noise floor
     (two-window-min semantics: the min is published, the spread is the
@@ -376,6 +416,7 @@ def check(
                     f"{path}: p99 {row['p99_ms']:.2f} ms > {ceil:.2f} ms "
                     f"(ref {ref_row['p99_ms']:.2f} from {ref_src}, "
                     f"tol {eff:.1%})")
+    violations.extend(_ivf_hard_gates(new_rows))
     return violations
 
 

@@ -478,6 +478,107 @@ print(f"watch feed agrees: {states}")
 EOF
 echo "live observatory smoke OK (0 false positives, fire->resolve, gate teeth, watch agreement)"
 
+echo "== overload / admission-control smoke (docs/SERVING.md §Approximate index) =="
+# The graceful-degradation scenario (ISSUE 11): a 2-replica IVF tier
+# under a p99 SLO is rammed past capacity (deterministically — the
+# serve.latency failpoint stalls every dispatch 0.25s during the ramp).
+# Required behavior: the p99 alert FIRES, SLO-driven admission control
+# SHEDS load (fast-rejects counted in the rejected invariant) while a
+# probe trickle keeps recovery observable, answered queries keep
+# flowing end to end (no stall), and once the ramp ends the alert
+# RESOLVES and full admission returns — then the jax-free
+# bench_check --alerts gate must accept the fire->resolve log.
+ov_dir="$smoke_dir/overload"
+mkdir -p "$ov_dir"
+python - "$ov_dir" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+rng = np.random.default_rng(0)
+emb = rng.standard_normal((512, 32)).astype(np.float32)
+emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+np.save(d + "/g.emb.npy", emb)
+np.save(d + "/g.labels.npy", (np.arange(512) % 32).astype(np.int32))
+with open(d + "/flood.jsonl", "w") as f:
+    for i in range(300):
+        f.write(json.dumps({"id": i, "embedding": emb[i % 512].tolist()}) + "\n")
+with open(d + "/recover.jsonl", "w") as f:
+    for i in range(100):
+        f.write(json.dumps({"id": 1000 + i, "embedding": emb[i].tolist()}) + "\n")
+with open(d + "/tail.jsonl", "w") as f:
+    for i in range(20):
+        f.write(json.dumps({"id": 4000 + i, "embedding": emb[i].tolist()}) + "\n")
+json.dump({"slos": [{
+    "name": "serve_p99", "metric": "serve_p99_ms", "op": "<=",
+    "target": 150.0, "window_s": 2.0, "burn_threshold": 0.5,
+    "min_samples": 1, "severity": "critical"}]},
+    open(d + "/slo.json", "w"))
+EOF
+JAX_PLATFORMS=cpu python -m npairloss_tpu index \
+    --emb "$ov_dir/g.emb.npy" --labels "$ov_dir/g.labels.npy" \
+    --no-normalize --kind ivf --clusters 16 --out "$ov_dir/g.gidx" \
+    > "$ov_dir/index.log" 2>&1 \
+    || { echo "overload smoke: ivf index build failed"; cat "$ov_dir/index.log"; exit 1; }
+mkfifo "$ov_dir/in"
+JAX_PLATFORMS=cpu NPAIRLOSS_FAILPOINTS="serve.latency:60" \
+    python -m npairloss_tpu serve --index "$ov_dir/g.gidx" \
+    --index-kind ivf --probes 4 --scoring bf16 --replicas 2 \
+    --admission slo --admission-slos serve_p99 \
+    --top-k 3 --buckets 1 --deadline-ms 1 --max-queue 64 \
+    --metrics-window 4 --telemetry-dir "$ov_dir/tel" --live-obs \
+    --slo-config "$ov_dir/slo.json" --slo-tick 0.2 \
+    < "$ov_dir/in" > "$ov_dir/answers.jsonl" 2> "$ov_dir/serve.log" &
+ovpid=$!
+exec 5> "$ov_dir/in"
+# Phase A — the ramp: 300 queries at ~33 qps against ~8 qps of faulted
+# capacity.  The queues saturate, the p99 alert fires, shedding engages.
+while IFS= read -r ln; do printf '%s\n' "$ln" >&5; sleep 0.03; done \
+    < "$ov_dir/flood.jsonl"
+sleep 3  # ramp over; fault budget exhausts, queues drain
+# Phase B — recovery: throttled traffic; the probe trickle's fast
+# answers age the burn out, the alert resolves, admission returns.
+while IFS= read -r ln; do printf '%s\n' "$ln" >&5; sleep 0.04; done \
+    < "$ov_dir/recover.jsonl"
+sleep 2.5
+# Phase C — steady state again: the tail queries must nearly all land.
+while IFS= read -r ln; do printf '%s\n' "$ln" >&5; sleep 0.05; done \
+    < "$ov_dir/tail.jsonl"
+sleep 1.5
+kill -TERM "$ovpid" 2>/dev/null || true
+exec 5>&-
+rc=0; wait "$ovpid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "overload smoke: expected exit 75, got $rc"; cat "$ov_dir/serve.log"; exit 1; }
+python - "$ov_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", drain
+answers = lines[:-1]
+served = [a for a in answers if "neighbors" in a]
+tail_served = [a for a in served if isinstance(a.get("id"), int) and a["id"] >= 4000]
+# shedding engaged: admission sheds happened and are counted in rejected
+assert drain["shed"] > 0, f"admission control never shed: {drain}"
+assert drain["rejected"] >= drain["shed"] > 0, drain
+# no stall: answers kept flowing through and after the incident
+assert drain["answered"] >= 60, drain
+assert len(tail_served) >= 15, \
+    f"only {len(tail_served)}/20 tail queries served — tier never readmitted"
+assert drain["shedding"] is False, "still shedding at drain"
+assert drain["replicas"] == 2 and drain["replicas_alive"] == 2, drain
+# the invariant holds through overload: nothing dropped, nothing counted twice
+assert drain["queries"] == drain["answered"] + drain["errors"] + drain["rejected"], drain
+states = [json.loads(ln)["state"] for ln in open(d + "/tel/alerts.jsonl") if ln.strip()]
+assert "firing" in states, "p99 alert never fired under the ramp"
+assert states[-1] == "resolved", f"alert did not resolve after the ramp: {states}"
+print(f"overload smoke OK (shed {drain['shed']}, rejected {drain['rejected']}, "
+      f"answered {drain['answered']}, tail {len(tail_served)}/20, "
+      f"alert fired+resolved)")
+EOF
+python scripts/bench_check.py --alerts "$ov_dir/tel/alerts.jsonl" \
+    || { echo "overload smoke: gate refused the fire->resolve log"; exit 1; }
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

@@ -589,6 +589,40 @@ def test_bench_check_offline_on_committed_artifacts():
     assert bc.main(["--offline"]) == 0
 
 
+def test_bench_check_ivf_hard_gates():
+    """The approximate-index row's ABSOLUTE gates (ISSUE 11): recall@1
+    below the hard floor or an IVF/flat qps ratio under the speedup
+    floor is a violation regardless of trajectory noise; clean rows
+    and absent rows gate nothing."""
+    bc = _load_bench_check()
+    base = _rec(4300.0, extras={"serve_qps": {"p99_ms": 10.0}})
+
+    def scale_rec(recall, ivf_qps, flat_qps):
+        return _rec(4310.0, extras={
+            "serve_qps": {"p99_ms": 10.0},
+            "flat_qps_1m": {"p99_ms": 800.0, "qps": flat_qps},
+            "ivf_qps_1m": {"p99_ms": 70.0, "qps": ivf_qps,
+                           "recall_at_1": recall},
+        })
+
+    # Healthy: 8x speedup at recall 1.0 — clean.
+    assert bc.check([("r1", base), ("r2", scale_rec(1.0, 130.0, 16.0))]) \
+        == []
+    # Recall under the floor: hard violation.
+    v = bc.check([("r1", base), ("r2", scale_rec(0.80, 130.0, 16.0))])
+    assert any("recall@1" in x for x in v), v
+    # Speedup under the floor: hard violation.
+    v = bc.check([("r1", base), ("r2", scale_rec(1.0, 40.0, 16.0))])
+    assert any("flat qps" in x for x in v), v
+    # IVF row absent: coverage unchanged, nothing to gate.
+    assert bc.check([("r1", base), ("r2", base)]) == []
+    # The committed BENCH_r07 evidence must clear both hard gates.
+    records = bc.load_offline_records()
+    rows = bc._walk_rows(records[-1][1])
+    assert "ivf_qps_1m" in rows, "committed ivf_qps_1m row missing"
+    assert bc._ivf_hard_gates(rows) == []
+
+
 def test_bench_check_skips_degraded_and_reused():
     bc = _load_bench_check()
     assert not bc._is_measurement(
