@@ -535,6 +535,22 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     if flush is None:
         flush = lambda inflight=None: None  # noqa: E731
     extras.update({"pool": n, "steps": steps})
+    try:
+        # DCN-aware plan provenance (parallel.plan): what the engine
+        # selector would choose for THIS pool on THIS box's topology —
+        # the same "which engine and why" stamp the run manifests
+        # carry, so a bench record is auditable against the selection
+        # policy that was live when it was measured.
+        from npairloss_tpu.parallel.plan import host_counts, plan_engine
+
+        devs = jax.devices()
+        extras["engine_plan"] = plan_engine(
+            n_devices=len(devs), n_hosts=len(host_counts(devs)),
+            shard_rows=max(n // len(devs), 1), emb_dim=d,
+            device_kind=getattr(devs[0], "device_kind", ""),
+        ).to_dict()
+    except Exception as e:  # noqa: BLE001 — provenance, not measurement
+        _log(f"extras: engine plan stamp unavailable ({e})")
     if selected is not None and not (set(ENGINE_ROWS) & selected):
         # A batch-only --rows re-pass: every engine row is unselected,
         # so skip the whole section BEFORE the n x d pool is built and

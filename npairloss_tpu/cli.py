@@ -106,7 +106,6 @@ def _build_solver(args):
 
     from npairloss_tpu.config import load_net, load_solver
     from npairloss_tpu.models import get_model
-    from npairloss_tpu.parallel import data_parallel_mesh
     from npairloss_tpu.train import Solver, SolverConfig
 
     if getattr(args, "solver", None):
@@ -193,10 +192,39 @@ def _build_solver(args):
         # path; don't auto-build a mesh around it.  An EXPLICIT --mesh
         # still reaches the Solver's blockwise+mesh contradiction error.
         want = 1
-    if want > 1 or engine == "ring":
+    mp = int(getattr(args, "mp", 1) or 1)
+    if want > 1 or engine == "ring" or mp > 1:
         # Ring streams over a mesh axis; a 1-device mesh is valid (the
         # bench times it), so honor --engine ring even single-device.
-        mesh = data_parallel_mesh(jax.devices()[:max(want, 1)])
+        # --mp > 1 folds the same devices into a 2-D dp x mp mesh for
+        # partition rules that shard parameters (docs/DISTRIBUTED.md).
+        from npairloss_tpu.parallel import build_mesh
+
+        mesh = build_mesh(jax.devices()[:max(want, 1)], mp=mp)
+    elif engine == "auto":
+        # Nothing to exchange on a single shard: auto degrades to the
+        # default engine without wrapping a 1-device shard_map mesh
+        # around the step.
+        engine = None
+
+    partition_rules = None
+    if getattr(args, "partition_rules", None):
+        from npairloss_tpu.parallel import load_partition_rules
+        from npairloss_tpu.parallel.partition import PartitionRuleError
+
+        try:
+            partition_rules = load_partition_rules(args.partition_rules)
+        except (OSError, ValueError, PartitionRuleError) as e:
+            log.error("--partition-rules %s: %s", args.partition_rules, e)
+            return 2
+        if mesh is None:
+            # The module's loud-by-design contract extends to the CLI:
+            # a sharding table on a mesh-less run would silently never
+            # apply — exactly the no-op shape the table exists to kill.
+            log.error("--partition-rules given but no mesh was built "
+                      "(single device, no --mesh/--mp): the table "
+                      "would silently not apply")
+            return 2
 
     model_name = args.model or _model_for_net(net_cfg)
     import jax.numpy as jnp
@@ -217,11 +245,36 @@ def _build_solver(args):
     else:
         model = get_model(model_name, dtype=dtype, **model_kw)
 
+    engine_plan = None
+    if mesh is not None and engine != "blockwise":
+        # DCN-aware engine selection (parallel.plan): consult the
+        # roofline interconnect peaks + the mesh's host topology;
+        # --engine auto takes the plan's choice, an explicit engine is
+        # honored but the plan (with what auto would have said) is
+        # still stamped into the run manifest as provenance.
+        from npairloss_tpu.parallel import plan_for_mesh
+
+        d_any = net_cfg.data.get("TRAIN") or net_cfg.data.get("TEST")
+        ids, imgs = _identity_batch_geometry(d_any)
+        emb_dim = int(getattr(model, "embedding_dim", 0) or 512)
+        from npairloss_tpu.obs.fleet.stamp import resolved_process
+
+        engine_plan = plan_for_mesh(
+            mesh, ids * imgs, emb_dim,
+            requested=engine if engine else "dense",
+            process_count=resolved_process()[1],
+        )
+        if engine == "auto":
+            engine = engine_plan.engine
+            log.info("engine auto -> %s over %s (%s)",
+                     engine, engine_plan.link, engine_plan.reason)
+
     sim_cache = getattr(args, "sim_cache", None)
     pos_topk = getattr(args, "pos_topk", None)
     solver = Solver(
         model, loss_cfg, solver_cfg, mesh=mesh, input_shape=input_shape,
         engine=engine,
+        partition_rules=partition_rules,
         sim_cache={"auto": None, "on": True, "off": False}[sim_cache or "auto"],
         pos_topk=None if pos_topk in (None, "auto") else int(pos_topk),
         matmul_precision=getattr(args, "matmul_precision", None),
@@ -231,6 +284,7 @@ def _build_solver(args):
                      if net_cfg.loss and net_cfg.loss.loss_weights
                      else 1.0),
     )
+    solver.engine_plan = engine_plan
     if getattr(args, "resume", None):
         if args.resume == "auto":
             # Auto-resume (docs/RESILIENCE.md): newest manifest-valid
@@ -312,6 +366,23 @@ def cmd_train(args) -> int:
         log.info("resumed optimizer from %s at iteration %d",
                  args.caffe_solverstate, it)
 
+    if getattr(args, "dump_partitions", False):
+        # Preflight visibility (docs/DISTRIBUTED.md): the resolved
+        # rule -> PartitionSpec table per state leaf, with per-rule
+        # match counts — a silent no-op rule (0 matches) is visible
+        # BEFORE a multi-hour run.  Pair with --max_iter 0 for a
+        # check-only invocation.  Mesh-less runs have no placement to
+        # resolve, so the flag demands one.
+        if solver.mesh is None:
+            log.error("--dump-partitions needs a mesh "
+                      "(--mesh/--mp): single-device runs have no "
+                      "placement to resolve")
+            return 2
+        from npairloss_tpu.parallel import render_partition_table
+
+        print(render_partition_table(solver.partition_table()),
+              flush=True)
+
     train_iter, _ = _build_data(
         net_cfg, "TRAIN", input_shape, seed=0, synthetic=args.synthetic,
         native=args.native,
@@ -326,6 +397,23 @@ def cmd_train(args) -> int:
             args.net or args.solver,
         )
         return 2
+
+    import jax as _jax
+
+    if _jax.process_count() > 1:
+        # Multi-controller data model (docs/DISTRIBUTED.md): every
+        # controller builds the same deterministic loader; each takes
+        # its process-disjoint row shard of every global batch, and
+        # Solver._put_batch reassembles them in process order into the
+        # pod-global array — the mpirun per-rank MultibatchData shape,
+        # with global batch = sum of the local batches.
+        from npairloss_tpu.data import shard_batches
+
+        train_iter = shard_batches(
+            train_iter, _jax.process_index(), _jax.process_count())
+        if test_iter is not None:
+            test_iter = shard_batches(
+                test_iter, _jax.process_index(), _jax.process_count())
 
     # Configure logging only when the embedder has not.  basicConfig is
     # already a no-op when the ROOT logger has handlers; the extra check
@@ -465,6 +553,18 @@ def cmd_train(args) -> int:
                             "synthetic": bool(args.synthetic),
                             "health_metrics":
                                 bool(getattr(args, "health_metrics", False)),
+                            # Pod-scale provenance (docs/DISTRIBUTED.md):
+                            # WHY this engine (DCN-aware plan) and WHERE
+                            # every state leaf lives (rule digest, with
+                            # zero-match rules flagged).
+                            "engine_plan": (
+                                solver.engine_plan.to_dict()
+                                if solver.engine_plan is not None else None
+                            ),
+                            "partition": (
+                                solver.partition_summary()
+                                if solver.mesh is not None else None
+                            ),
                         },
                         mesh=(
                             mesh_topology(solver.mesh, solver.axis)
@@ -1637,7 +1737,12 @@ def _prof_train(args, jax, np, dev, tel, steps, obsperf):
         ms_per_step=ms_per_step, steps=steps,
         region_depth=int(args.region_depth),
         extra={"model": args.model, "engine": solver.engine,
-               "policy": policy or None},
+               "policy": policy or None,
+               # The satellite of --dump-partitions: a prof'd mesh run
+               # stamps the same rule digest, so a silent no-op rule is
+               # visible in the perf artifact too.
+               **({"partition": solver.partition_summary()}
+                  if mesh is not None else {})},
     )
 
 
@@ -1735,9 +1840,30 @@ def main(argv: Optional[list] = None) -> int:
     t.add_argument("--max_iter", type=int, help="override solver max_iter")
     t.add_argument("--mesh", type=int, help="devices in the dp mesh")
     t.add_argument(
-        "--engine", choices=["dense", "ring", "blockwise"],
+        "--engine", choices=["auto", "dense", "ring", "blockwise"],
         help="loss engine (default: dense; ring streams the pool over a "
-        "mesh, blockwise streams Pallas tiles on one device)",
+        "mesh, blockwise streams Pallas tiles on one device; auto picks "
+        "dense vs ring from the mesh's host topology and the roofline "
+        "ICI/DCN peaks — the plan lands in the run manifest)",
+    )
+    t.add_argument(
+        "--mp", type=int, default=1, metavar="M",
+        help="model-parallel axis size: the mesh becomes 2-D (dp x mp) "
+        "with mp groups on adjacent (same-host) chips, for partition "
+        "rules that shard parameters over 'mp' (docs/DISTRIBUTED.md)",
+    )
+    t.add_argument(
+        "--partition-rules", dest="partition_rules", metavar="FILE",
+        help="JSON partition-rule table: ordered [regex, spec] pairs "
+        "over the flattened state-tree path, first match wins, "
+        "unmatched leaves are a loud error (default: everything "
+        "replicated) — docs/DISTRIBUTED.md cookbook",
+    )
+    t.add_argument(
+        "--dump-partitions", dest="dump_partitions", action="store_true",
+        help="print the resolved rule->PartitionSpec table per state "
+        "leaf (zero-match rules flagged) before training; pair with "
+        "--max_iter 0 as a preflight check",
     )
     t.add_argument(
         "--pos-topk", dest="pos_topk", default="auto", metavar="K",

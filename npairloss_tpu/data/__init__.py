@@ -7,6 +7,7 @@ from npairloss_tpu.data.loader import (
     NativeMultibatchLoader,
     PrefetchWorkerError,
     multibatch_loader,
+    shard_batches,
 )
 from npairloss_tpu.data.sampler import IdentityBalancedSampler
 from npairloss_tpu.data.synthetic import synthetic_identity_batches
@@ -23,6 +24,7 @@ __all__ = [
     "NativeMultibatchLoader",
     "PrefetchWorkerError",
     "multibatch_loader",
+    "shard_batches",
     "IdentityBalancedSampler",
     "synthetic_identity_batches",
     "apply_transform_param",

@@ -323,6 +323,49 @@ def multibatch_loader(
     )
 
 
+def shard_batches(
+    batches: Iterator[Tuple[np.ndarray, np.ndarray]],
+    rank: int,
+    count: int,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Per-process disjoint shards of a deterministic pod-global batch
+    stream — the multi-controller data model (docs/DISTRIBUTED.md).
+
+    Every controller builds the SAME loader (same list file, same
+    seed), so each one computes the identical global batch schedule;
+    this wrapper hands process ``rank`` rows
+    ``[rank*n : (rank+1)*n]`` of every batch (``n = rows // count``).
+    The shards are disjoint by construction, their concatenation in
+    rank order IS the global batch (``process_local_batch`` reassembles
+    exactly it on the mesh), and the global batch — hence the training
+    trajectory — is independent of how many controllers split it: the
+    single-process run on the unsliced stream is the bit-identical
+    parity oracle.  Mirrors the reference's per-rank MultibatchData
+    with a shared schedule (``mpirun -np G``, cu:17-43).
+
+    Loud on a batch whose rows don't divide by ``count`` — a silently
+    dropped remainder would change the pool every step.
+    """
+    if not (0 <= int(rank) < int(count)):
+        raise ValueError(f"rank {rank} outside [0, {count})")
+    rank, count = int(rank), int(count)
+
+    def gen():
+        for inputs, labels in batches:
+            rows = len(labels)
+            if rows % count:
+                raise ValueError(
+                    f"global batch of {rows} rows does not divide over "
+                    f"{count} processes; fix identity_num_per_batch x "
+                    "img_num_per_identity to a multiple of the process "
+                    "count")
+            n = rows // count
+            sl = slice(rank * n, (rank + 1) * n)
+            yield np.asarray(inputs)[sl], np.asarray(labels)[sl]
+
+    return gen()
+
+
 def _list_file_all_suffixed(source: str, suffixes, sample: int = 4096) -> bool:
     """True when the list file's entries all carry a native-decodable
     suffix.  Bounded: only the first ``sample`` entries are examined (an

@@ -105,6 +105,30 @@ def fleet_stamp() -> Optional[FleetStamp]:
         return None
 
 
+def resolved_process() -> tuple:
+    """``(process_index, process_count)`` for COORDINATION (resume
+    waits, topology records, engine planning): a live multi-controller
+    runtime outranks the declared harness stamp, which outranks the
+    single-process default.  Never raises — a malformed override
+    degrades to ``(0, 1)``, because a coordination probe must not kill
+    the run it coordinates (the stamping path, ``fleet_stamp``, stays
+    loud about malformed overrides)."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            if jax.process_count() > 1:
+                return jax.process_index(), jax.process_count()
+        except Exception:
+            pass
+    try:
+        stamp = fleet_stamp()
+    except Exception:
+        return 0, 1
+    if stamp is not None:
+        return stamp.process_index, stamp.process_count
+    return 0, 1
+
+
 def resolve_fleet(fleet) -> Optional[FleetStamp]:
     """Normalize a ``fleet=`` argument: None/False = off, True = the
     ambient stamp (rank 0 of 1 when nothing else is declared — an

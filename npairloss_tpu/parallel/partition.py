@@ -1,0 +1,414 @@
+"""Declarative partition rules — the sharding table for pod-scale state.
+
+The reference's distribution story is implicit: one MPI rank per GPU,
+parameters replicated, activations split by rank (cu:17-43).  That was
+also this repo's story until now — every placement a hand-written
+``NamedSharding(mesh, P())``/``P(axis)`` scattered through the solver
+and the serving index.  At pod scale that stops being tenable: a bigger
+trunk or a bigger pooled batch needs *some* leaves sharded over a
+second mesh axis, and hand-placing them per call site is exactly how
+the PR 7 ViT root-path bug happened (a rule that silently matched
+nothing).
+
+This module is the one home for placement decisions, in the
+``match_partition_rules`` idiom (SNIPPETS.md [3]): an ORDERED list of
+``(regex, PartitionSpec)`` rules matched against the flattened pytree
+path of every leaf.
+
+  * **first match wins** — order expresses priority, so specific rules
+    go first and a broad fallback goes last;
+  * **scalars are never partitioned** — 0-d / single-element leaves
+    resolve to ``P()`` before any rule is consulted (there is nothing
+    to split);
+  * **unmatched leaves are LOUD** — a leaf no rule matches raises
+    :class:`PartitionRuleError` naming the leaf path.  Replication is a
+    *decision*, spelled as the explicit fallback rule ``(".*", P())``,
+    never a silent default;
+  * **no-op rules are visible** — :func:`partition_table` counts the
+    leaves each rule matched, so a rule with ``matches == 0`` (the
+    silent-no-op shape) shows up in ``train --dump-partitions`` before
+    a multi-hour run, not after it.
+
+Leaf paths are ``"/"``-joined (``params/conv1/Conv_0/kernel``,
+``opt/momentum_buf/conv1/Conv_0/kernel``), so one rule written against
+the param name covers its optimizer twin via ``kernel$``-style anchors
+— or excludes it via an explicit ``^params/`` prefix.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class PartitionRuleError(ValueError):
+    """A leaf no rule matches, an invalid rule regex/spec, or a spec
+    naming an axis the mesh does not have."""
+
+
+# The shipped default: every leaf replicated — byte-for-byte the
+# hand-placed ``NamedSharding(mesh, P())`` behavior this table replaced
+# (parity by construction; pinned in tests/test_partition.py).
+def replicated_rules():
+    from jax.sharding import PartitionSpec as P
+
+    return ((".*", P()),)
+
+
+class ShardLastDim:
+    """Rule-spec sentinel: shard the LAST dim of whatever rank the
+    matched leaf has — the output-channel dim of a 2-D Dense kernel
+    ``(in, out)`` AND a 4-D conv kernel ``(h, w, in, out)`` alike,
+    which no fixed positional PartitionSpec can express for both.
+    JSON spelling: ``{"last": "mp"}`` (or a list for a multi-axis
+    last dim)."""
+
+    def __init__(self, axes):
+        self.axes = tuple(axes) if isinstance(axes, (list, tuple)) \
+            else (axes,)
+
+    def spec_for(self, shape):
+        from jax.sharding import PartitionSpec as P
+
+        entry = self.axes[0] if len(self.axes) == 1 else self.axes
+        return P(*([None] * (max(len(shape), 1) - 1) + [entry]))
+
+    def __repr__(self):
+        return f"last_dim{self.axes!r}"
+
+    def __eq__(self, other):
+        return isinstance(other, ShardLastDim) and self.axes == other.axes
+
+
+def model_parallel_rules(mp_axis: str = "mp"):
+    """The shipped 2-D starter set: shard the OUTPUT (last) dim of
+    weight matrices and conv kernels (and their momentum twins,
+    matched by the same ``kernel$`` anchor) over ``mp_axis``;
+    everything else — biases, norms, scalars, batch stats —
+    replicated.  A cookbook seed, not a law: pass your own table for
+    anything finer (docs/DISTRIBUTED.md §Partition-rule cookbook)."""
+    return (
+        (r"kernel$", ShardLastDim(mp_axis)),
+        (".*", None),
+    )
+
+
+def _as_spec(spec):
+    """Normalize a rule's spec: a PartitionSpec or :class:`ShardLastDim`
+    passes through; a list/tuple of axis entries (None, "axis", or a
+    sub-list for multi-axis dims) becomes a PartitionSpec; the dict
+    ``{"last": axes}`` becomes a :class:`ShardLastDim` — the
+    JSON-config spellings."""
+    from jax.sharding import PartitionSpec as P
+
+    if isinstance(spec, (P, ShardLastDim)):
+        return spec
+    if spec is None:
+        return P()
+    if isinstance(spec, dict):
+        if set(spec) == {"last"}:
+            return ShardLastDim(spec["last"])
+        raise PartitionRuleError(
+            f'dict rule specs must be {{"last": axes}}, got {spec!r}')
+    if isinstance(spec, (list, tuple)):
+        dims = []
+        for d in spec:
+            if isinstance(d, list):
+                dims.append(tuple(d))
+            else:
+                dims.append(d)
+        return P(*dims)
+    raise PartitionRuleError(
+        f"rule spec must be a PartitionSpec, ShardLastDim, or a list "
+        f"of axis entries, got {spec!r}"
+    )
+
+
+def _resolve_spec(spec, shape):
+    """A rule's spec made concrete for one leaf (ShardLastDim needs
+    the leaf's rank; PartitionSpecs pass through)."""
+    return spec.spec_for(shape) if isinstance(spec, ShardLastDim) else spec
+
+
+def compile_rules(rules) -> List[Tuple[Any, str, Any]]:
+    """Validate + compile a ruleset into ``(compiled_regex, pattern,
+    spec)`` triples — loud on a bad regex or spec, at table-build time
+    rather than deep inside a jit trace."""
+    if not rules:
+        raise PartitionRuleError("empty partition ruleset (need at least "
+                                 'a fallback rule like (".*", P()))')
+    out = []
+    for i, rule in enumerate(rules):
+        try:
+            pattern, spec = rule
+        except (TypeError, ValueError):
+            raise PartitionRuleError(
+                f"rule {i} is not a (pattern, spec) pair: {rule!r}")
+        try:
+            rx = re.compile(pattern)
+        except re.error as e:
+            raise PartitionRuleError(
+                f"rule {i} pattern {pattern!r} is not a valid regex: {e}")
+        out.append((rx, pattern, _as_spec(spec)))
+    return out
+
+
+def tree_path_str(path) -> str:
+    """One leaf's pytree path as the ``"/"``-joined string the rules
+    match: dict keys and namedtuple fields by name, sequence entries by
+    index — ``opt/momentum_buf/conv1/Conv_0/kernel``."""
+    parts = []
+    for p in path:
+        name = getattr(p, "key", None)
+        if name is None:
+            name = getattr(p, "name", None)
+        if name is None:
+            name = getattr(p, "idx", None)
+        parts.append(str(name) if name is not None else str(p))
+    return "/".join(parts)
+
+
+def _is_scalar(leaf) -> bool:
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return True  # python scalar leaf
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_partition_rules(rules, tree):
+    """Resolve a pytree to a matching tree of PartitionSpecs.
+
+    Scalar leaves resolve to ``P()``; every other leaf takes the FIRST
+    rule whose regex ``search``-matches its path string.  A leaf with
+    no matching rule raises :class:`PartitionRuleError` — replication
+    must be an explicit fallback rule, never an accident.
+    """
+    import jax
+
+    compiled = compile_rules(rules)
+
+    def pick(path, leaf):
+        from jax.sharding import PartitionSpec as P
+
+        if _is_scalar(leaf):
+            return P()
+        name = tree_path_str(path)
+        for rx, _pat, spec in compiled:
+            if rx.search(name):
+                return _resolve_spec(spec, getattr(leaf, "shape", ()))
+        raise PartitionRuleError(
+            f"no partition rule matches leaf {name!r} "
+            f"(shape {tuple(getattr(leaf, 'shape', ()))}); add a rule or "
+            'an explicit replicated fallback (".*", P())'
+        )
+
+    return jax.tree_util.tree_map_with_path(pick, tree)
+
+
+def _check_spec_on_mesh(name: str, shape, spec, mesh) -> None:
+    """Loud pre-flight for one leaf: every axis the spec names must
+    exist on the mesh, and the dimension it splits must divide by the
+    axis size — XLA would eventually refuse both, but hours later and
+    without the leaf path."""
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dims = tuple(spec)
+    if len(dims) > len(shape):
+        raise PartitionRuleError(
+            f"leaf {name!r} (shape {tuple(shape)}) has fewer dims than "
+            f"its spec {spec}")
+    for d, entry in enumerate(dims):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        split = 1
+        for ax in axes:
+            if ax not in axis_sizes:
+                raise PartitionRuleError(
+                    f"leaf {name!r}: spec {spec} names axis {ax!r} but the "
+                    f"mesh has axes {tuple(mesh.axis_names)}")
+            split *= axis_sizes[ax]
+        if shape[d] % split:
+            raise PartitionRuleError(
+                f"leaf {name!r}: dim {d} of shape {tuple(shape)} does not "
+                f"divide by {split} (spec {spec} over mesh "
+                f"{dict(axis_sizes)})")
+
+
+def match_partition_shardings(rules, tree, mesh):
+    """Rules -> a matching tree of ``NamedSharding`` on ``mesh``, with
+    the axis-name/divisibility pre-flight applied per leaf.  This is
+    the tree jit's ``in_shardings``/``device_put`` consume."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = match_partition_rules(rules, tree)
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    shardings = []
+    for (path, leaf), spec in zip(leaves, flat_specs):
+        shape = getattr(leaf, "shape", ())
+        _check_spec_on_mesh(tree_path_str(path), shape, spec, mesh)
+        shardings.append(NamedSharding(mesh, spec))
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def place_tree(tree, shardings_tree):
+    """Place a host pytree per a matching shardings tree.  Single
+    process: a plain ``device_put``.  Multi-controller: every process
+    holds the full host value (replicated state, or the deterministic
+    global batch) and contributes its addressable shards via
+    ``make_array_from_callback`` — ``device_put`` cannot place onto
+    devices another process owns."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    sh_flat = jax.tree_util.tree_leaves(shardings_tree)
+    if jax.process_count() == 1:
+        placed = [jax.device_put(x, s) for x, s in zip(flat, sh_flat)]
+    else:
+        placed = []
+        for x, s in zip(flat, sh_flat):
+            host = np.asarray(x)
+            placed.append(jax.make_array_from_callback(
+                host.shape, s, lambda idx, host=host: host[idx]))
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+# -- the diagnostic table (train --dump-partitions; prof stamp) ------------
+
+
+def partition_table(rules, tree, mesh=None) -> Dict[str, Any]:
+    """The resolved rule -> PartitionSpec table over a (possibly
+    abstract) pytree: one row per leaf plus per-rule match counts.
+
+    Unlike :func:`match_partition_rules` this never raises on an
+    unmatched leaf — it REPORTS it (``unmatched`` list + per-row
+    ``rule: None``), because the table is the tool you reach for when
+    the ruleset is wrong.  Rules with ``matches == 0`` are the silent
+    no-ops ``--dump-partitions`` exists to expose.
+    """
+    import jax
+
+    compiled = compile_rules(rules)
+    counts = [0] * len(compiled)
+    rows: List[Dict[str, Any]] = []
+    unmatched: List[str] = []
+    sharded = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = tree_path_str(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if _is_scalar(leaf):
+            rows.append({"path": name, "shape": shape, "rule": None,
+                         "spec": "P()", "scalar": True})
+            continue
+        for i, (rx, pat, spec) in enumerate(compiled):
+            if rx.search(name):
+                counts[i] += 1
+                concrete = _resolve_spec(spec, shape)
+                if any(d is not None for d in tuple(concrete)):
+                    sharded += 1
+                rows.append({"path": name, "shape": shape, "rule": pat,
+                             "spec": str(concrete), "scalar": False})
+                break
+        else:
+            unmatched.append(name)
+            rows.append({"path": name, "shape": shape, "rule": None,
+                         "spec": None, "scalar": False})
+    table = {
+        "rows": rows,
+        "rules": [
+            {"pattern": pat, "spec": str(spec), "matches": counts[i]}
+            for i, (_rx, pat, spec) in enumerate(compiled)
+        ],
+        "unmatched": unmatched,
+        "leaves": len(rows),
+        "sharded_leaves": sharded,
+    }
+    if mesh is not None:
+        table["mesh"] = {
+            "axes": {str(a): int(s)
+                     for a, s in zip(mesh.axis_names, mesh.devices.shape)},
+            "devices": int(mesh.size),
+        }
+    return table
+
+
+def partition_summary(rules, tree, mesh=None) -> Dict[str, Any]:
+    """The manifest-sized digest of :func:`partition_table`: rules with
+    match counts (zero-match rules flagged), leaf totals, unmatched
+    count — enough for a post-hoc reader to see whether a rule
+    silently no-op'd, without a row per leaf."""
+    t = partition_table(rules, tree, mesh=mesh)
+    return {
+        "rules": t["rules"],
+        "leaves": t["leaves"],
+        "sharded_leaves": t["sharded_leaves"],
+        "unmatched": len(t["unmatched"]),
+        "noop_rules": [r["pattern"] for r in t["rules"]
+                       if r["matches"] == 0],
+        **({"mesh": t["mesh"]} if "mesh" in t else {}),
+    }
+
+
+def render_partition_table(table: Dict[str, Any]) -> str:
+    """Human-readable table for ``train --dump-partitions``."""
+    lines = ["partition rules (first match wins):"]
+    for r in table["rules"]:
+        flag = "  <-- matches NOTHING (no-op rule?)" if r["matches"] == 0 \
+            else ""
+        lines.append(f"  {r['pattern']!r:40s} -> {r['spec']:20s} "
+                     f"[{r['matches']} leaves]{flag}")
+    if "mesh" in table:
+        lines.append(f"mesh: {table['mesh']['axes']} "
+                     f"({table['mesh']['devices']} devices)")
+    lines.append(f"{table['leaves']} leaves "
+                 f"({table['sharded_leaves']} sharded):")
+    width = max((len(r["path"]) for r in table["rows"]), default=0)
+    for r in table["rows"]:
+        spec = r["spec"] if r["spec"] is not None else "UNMATCHED"
+        why = "scalar" if r["scalar"] else (r["rule"] or "-")
+        lines.append(f"  {r['path']:{width}s}  {str(r['shape']):16s} "
+                     f"{spec:20s} via {why}")
+    if table["unmatched"]:
+        lines.append(f"UNMATCHED leaves ({len(table['unmatched'])}): "
+                     + ", ".join(table["unmatched"]))
+    return "\n".join(lines)
+
+
+def load_partition_rules(path: str):
+    """Load a ruleset from JSON: ``{"rules": [[pattern, spec], ...]}``
+    (or a bare list), where ``spec`` is a list of axis entries — null
+    for an unsharded dim, an axis name, or a list of names for a
+    multi-axis dim.  ``[]``/null mean replicated.  Compiled (and so
+    validated) before returning."""
+    with open(path) as f:
+        obj = json.load(f)
+    rules = obj.get("rules") if isinstance(obj, dict) else obj
+    if not isinstance(rules, list):
+        raise PartitionRuleError(
+            f"{path}: expected a JSON list of [pattern, spec] pairs "
+            '(or {"rules": [...]})')
+    out = tuple((pat, _as_spec(spec)) for pat, spec in
+                (tuple(r) for r in rules))
+    compile_rules(out)
+    return out
+
+
+# -- shipped rule tables for the serving gallery ---------------------------
+
+def gallery_rules(axis: str):
+    """The serving index's placement, declared: gallery rows (and the
+    IVF packed slabs, whose leading dim is clusters) shard over the
+    mesh axis; centroid tables replicate; anything new must match or
+    fail loudly (no silent replication of a 10^8-row array)."""
+    from jax.sharding import PartitionSpec as P
+
+    return (
+        (r"^(emb|labels|valid|packed|rows)$", P(axis)),
+        (r"^(centroids|cluster_valid)$", P()),
+    )

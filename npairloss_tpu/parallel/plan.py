@@ -1,0 +1,212 @@
+"""DCN-aware engine planning — which exchange pattern should this mesh run?
+
+The TPU-v4 embedding-hardware paper's central constraint (PAPERS.md) is
+the ICI-vs-DCN bandwidth asymmetry: within a host the chip fabric moves
+hundreds of GB/s, across hosts the data-center network moves ~an order
+of magnitude less.  The two loss engines exercise that asymmetry very
+differently:
+
+  * **dense** issues one fused ``all_gather`` of the whole pod pool
+    before the similarity matmul — lowest latency on ICI, but the
+    gather GATES the matmul, so on DCN the step eats the full
+    cross-host transfer up front;
+  * **ring** streams the pool over ``ppermute`` hops, one
+    block-matmul per hop — each hop's transfer can hide under the
+    previous hop's compute, so a DCN hop that fits under the per-hop
+    matmul costs (almost) nothing.
+
+``plan_engine`` makes that choice explicit and auditable: pure integer
+arithmetic over the mesh's host topology and the roofline interconnect
+peaks (``obs.perf.roofline.interconnect_peak``), returning an
+:class:`EnginePlan` whose ``reason`` says why — and the CLI stamps the
+plan into the run manifest, so "which engine and why" is provenance,
+not a flag someone once passed.
+
+Ring hop ordering rides the same topology: ``ring_device_order`` keeps
+devices process-major, so one rotation crosses the DCN exactly
+``hosts`` times (one hop per host boundary) instead of up to ``G``
+times under an interleaved order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+# A dense per-shard similarity block bigger than this routes to the
+# streaming engine even on a single host (the blockwise/ring engines
+# exist exactly for pools whose matrix does not fit).
+DENSE_SIM_BUDGET_BYTES = 2 << 30
+
+
+def ring_device_order(devices: Sequence) -> List:
+    """Process-major device order: all of host 0's chips, then host
+    1's, ...  A ring over this order crosses the DCN once per host
+    boundary — the minimum any ring over P hosts can do — instead of
+    on (up to) every hop.  Within a host, id order keeps the layout
+    deterministic."""
+    return sorted(devices,
+                  key=lambda d: (getattr(d, "process_index", 0), d.id))
+
+
+def host_counts(devices: Sequence) -> Dict[int, int]:
+    """Device count per owning process (host), for topology records."""
+    counts: Dict[int, int] = {}
+    for d in devices:
+        p = int(getattr(d, "process_index", 0))
+        counts[p] = counts.get(p, 0) + 1
+    return counts
+
+
+@dataclasses.dataclass(frozen=True)
+class EnginePlan:
+    """One auditable engine decision, manifest-ready via ``to_dict``."""
+
+    engine: str                  # the choice: "dense" | "ring"
+    requested: str               # what the caller asked ("auto" or explicit)
+    link: str                    # slowest link a collective crosses
+    devices: int
+    hosts: int
+    shard_rows: int              # batch rows per mesh shard
+    emb_dim: int
+    hop_bytes: float             # one ring hop's payload per device
+    gather_bytes: float          # dense all_gather receive per device
+    dense_sim_bytes: float       # per-shard similarity block, fp32
+    peak_bytes_per_s: float      # interconnect_peak(spec, link)
+    peak_known: bool
+    t_hop_comm_us: float         # hop transfer at link peak
+    t_hop_compute_us: float      # per-hop sim block matmul at chip peak
+    comm_hidden: bool            # hop transfer fits under hop compute
+    cross_host_hops: int         # DCN crossings per ring rotation
+    device_kind: str
+    reason: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def plan_engine(
+    n_devices: int,
+    n_hosts: int,
+    shard_rows: int,
+    emb_dim: int,
+    device_kind: str = "",
+    requested: str = "auto",
+    itemsize: int = 4,
+    dense_sim_budget: int = DENSE_SIM_BUDGET_BYTES,
+) -> EnginePlan:
+    """The engine decision as pure arithmetic (unit-testable without a
+    backend):
+
+      * one shard's hop payload is ``shard_rows * emb_dim * itemsize``;
+      * a ring hop's transfer time at the slowest link's peak is
+        compared against the hop's own sim-block matmul at the chip's
+        peak FLOP/s — if the transfer hides under the compute, the
+        ring's cross-host cost is ~zero and it wins on DCN;
+      * if it does not hide, dense wins (its gather moves fewer
+        serialized bytes than G-1 exposed hops);
+      * on a single host, dense wins unless its per-shard similarity
+        block exceeds ``dense_sim_budget`` (memory, not bandwidth, is
+        the binding constraint there);
+      * an explicit ``requested`` engine is honored verbatim — the plan
+        then just records what the auto choice would have said.
+    """
+    from npairloss_tpu.obs.perf.roofline import chip_peaks, interconnect_peak
+
+    if n_devices < 1 or n_hosts < 1 or n_hosts > n_devices:
+        raise ValueError(
+            f"bad topology: {n_devices} devices / {n_hosts} hosts")
+    if requested not in ("auto", "dense", "ring", "blockwise"):
+        raise ValueError(f"unknown engine {requested!r}")
+    spec = chip_peaks(device_kind)
+    link = "dcn" if n_hosts > 1 else "ici"
+    peak = interconnect_peak(spec, link)
+    hop_bytes = float(shard_rows) * emb_dim * itemsize
+    gather_bytes = hop_bytes * max(n_devices - 1, 0)
+    pool_rows = shard_rows * n_devices
+    dense_sim_bytes = float(shard_rows) * pool_rows * 4  # fp32 sim block
+    t_hop_comm = hop_bytes / peak if peak else float("inf")
+    t_hop_compute = (2.0 * shard_rows * shard_rows * emb_dim) / spec.flops
+    comm_hidden = t_hop_comm <= t_hop_compute
+    cross_host_hops = n_hosts if n_hosts > 1 else 0
+
+    if n_devices == 1:
+        auto, why = "dense", "single shard: nothing to exchange"
+    elif dense_sim_bytes > dense_sim_budget:
+        # Memory outranks bandwidth on every link: a pod-global pool
+        # whose dense similarity block does not fit must stream,
+        # whatever the gather would have cost.
+        auto, why = "ring", (
+            f"the dense per-shard similarity block is "
+            f"{dense_sim_bytes / 1e9:.2f} GB (> "
+            f"{dense_sim_budget / 1e9:.2f} GB budget) over {link}: "
+            "stream it")
+    elif n_hosts > 1:
+        if comm_hidden:
+            auto, why = "ring", (
+                f"cross-host ({n_hosts} hosts over {link}): a "
+                f"{hop_bytes / 1e6:.2f} MB ppermute hop "
+                f"({t_hop_comm * 1e6:.0f} us at {peak / 1e9:.0f} GB/s) "
+                f"hides under the {t_hop_compute * 1e6:.0f} us per-hop "
+                "sim matmul — streamed hops cost ~nothing")
+        else:
+            auto, why = "dense", (
+                f"cross-host but a {hop_bytes / 1e6:.2f} MB hop "
+                f"({t_hop_comm * 1e6:.0f} us at {peak / 1e9:.0f} GB/s) "
+                f"does NOT hide under {t_hop_compute * 1e6:.0f} us of "
+                f"per-hop compute: {n_devices - 1} exposed hops would "
+                "cost more than one fused all_gather")
+    else:
+        auto, why = "dense", (
+            f"single host over {link}: one fused all_gather "
+            f"({gather_bytes / 1e6:.2f} MB/device at "
+            f"{peak / 1e9:.0f} GB/s) beats {max(n_devices - 1, 0)} "
+            "serialized hops")
+
+    if requested != "auto":
+        engine = requested
+        reason = (f"explicit --engine {requested} "
+                  f"(auto would pick {auto}: {why})")
+    else:
+        engine, reason = auto, why
+    return EnginePlan(
+        engine=engine, requested=requested, link=link,
+        devices=int(n_devices), hosts=int(n_hosts),
+        shard_rows=int(shard_rows), emb_dim=int(emb_dim),
+        hop_bytes=hop_bytes, gather_bytes=gather_bytes,
+        dense_sim_bytes=dense_sim_bytes,
+        peak_bytes_per_s=peak, peak_known=spec.known,
+        t_hop_comm_us=t_hop_comm * 1e6,
+        t_hop_compute_us=t_hop_compute * 1e6,
+        comm_hidden=comm_hidden, cross_host_hops=cross_host_hops,
+        device_kind=device_kind or spec.device_kind, reason=reason,
+    )
+
+
+def plan_for_mesh(
+    mesh,
+    global_batch: int,
+    emb_dim: int,
+    requested: str = "auto",
+    process_count: Optional[int] = None,
+) -> EnginePlan:
+    """``plan_engine`` over a live mesh: host count from the devices'
+    owning processes (overridable by ``process_count`` for the
+    declared-rank harness, where every device claims process 0 but the
+    fleet really spans N controllers), shard rows from the global batch
+    over the data-parallel axis."""
+    devices = list(mesh.devices.flatten())
+    hosts = len(host_counts(devices))
+    if process_count is not None and process_count > hosts:
+        # A declared fleet cannot spread a mesh thinner than one device
+        # per host: a harness process holding a 1-device local mesh
+        # plans THAT mesh (no cross-device exchange), however many
+        # controllers the fleet declares.
+        hosts = min(int(process_count), len(devices))
+    dp = int(mesh.devices.shape[0])
+    shard_rows = max(int(global_batch) // max(dp, 1), 1)
+    kind = getattr(devices[0], "device_kind", "") if devices else ""
+    return plan_engine(
+        n_devices=len(devices), n_hosts=hosts, shard_rows=shard_rows,
+        emb_dim=emb_dim, device_kind=kind, requested=requested,
+    )

@@ -46,6 +46,7 @@ from npairloss_tpu.resilience.snapshot import (
     read_manifest,
     state_checksums,
     validate_snapshot,
+    validate_snapshot_wait,
     verify_restored,
 )
 
@@ -69,5 +70,6 @@ __all__ = [
     "read_manifest",
     "state_checksums",
     "validate_snapshot",
+    "validate_snapshot_wait",
     "verify_restored",
 ]

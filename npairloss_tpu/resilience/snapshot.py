@@ -181,6 +181,37 @@ def validate_snapshot(path: str) -> Dict[str, Any]:
     return manifest
 
 
+def validate_snapshot_wait(path: str, policy=None) -> Dict[str, Any]:
+    """:func:`validate_snapshot` with the shared retry/backoff — the
+    NON-rank-0 side of a multi-controller resume (docs/DISTRIBUTED.md).
+
+    The multihost save contract is: every rank enters the collective
+    Orbax save, then rank 0 alone writes ``manifest.json``.  A
+    relaunched non-zero rank scanning ``--resume auto`` can therefore
+    see the committed Orbax dir BEFORE rank 0's manifest lands and
+    would mis-read a perfectly valid snapshot as torn — so it waits on
+    the manifest (bounded, jittered backoff) instead of skipping.
+    Rank 0 never calls this: on rank 0 a missing manifest really is a
+    torn commit.
+    """
+    from npairloss_tpu.resilience.retrying import RetryPolicy, call_with_retry
+
+    policy = policy if policy is not None else RetryPolicy()
+    import dataclasses as _dc
+
+    # Same schedule as snapshot I/O, but the transient here is the
+    # manifest race (surfaced as SnapshotValidationError), not an
+    # OSError — widen retry_on for this call only.
+    policy = _dc.replace(
+        policy,
+        retry_on=tuple(set(policy.retry_on) | {SnapshotValidationError}),
+    )
+    return call_with_retry(
+        lambda: validate_snapshot(path), policy,
+        describe=f"manifest wait ({path})",
+    )
+
+
 # -- commit ---------------------------------------------------------------
 
 

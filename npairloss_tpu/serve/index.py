@@ -165,10 +165,26 @@ class GalleryIndex:
             lab = np.concatenate([lab, np.zeros(pad, np.int32)])
             valid = np.concatenate([valid, np.zeros(pad, bool)])
         if self.mesh is not None:
-            sharding = NamedSharding(self.mesh, P(self.axis))
-            self.emb = jax.device_put(emb, sharding)
-            self.labels = jax.device_put(lab, sharding)
-            self.valid = jax.device_put(valid, sharding)
+            # Placement via the declarative partition table
+            # (parallel.partition.gallery_rules) instead of hand-placed
+            # NamedShardings: rows shard over the mesh axis, and any
+            # NEW gallery array must match a rule or fail loudly —
+            # never silently replicate a pod-scale array.
+            from npairloss_tpu.parallel.partition import (
+                gallery_rules,
+                match_partition_shardings,
+                place_tree,
+            )
+
+            tree = {"emb": emb, "labels": lab, "valid": valid}
+            placed = place_tree(
+                tree,
+                match_partition_shardings(
+                    gallery_rules(self.axis), tree, self.mesh),
+            )
+            self.emb = placed["emb"]
+            self.labels = placed["labels"]
+            self.valid = placed["valid"]
         else:
             self.emb = jax.device_put(jnp.asarray(emb))
             self.labels = jax.device_put(jnp.asarray(lab))

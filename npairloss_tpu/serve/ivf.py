@@ -228,13 +228,28 @@ class IVFIndex(GalleryIndex):
         cvalid = np.zeros(kc_pad, bool)
         cvalid[:kc] = sizes > 0
         if self.mesh is not None:
-            shard = NamedSharding(self.mesh, P(self.axis))
-            rep = NamedSharding(self.mesh, P())
+            # Same declarative table as the flat gallery
+            # (parallel.partition.gallery_rules): packed slabs shard
+            # over the mesh axis on their cluster dim, centroid tables
+            # replicate — one placement source of truth across serve.
+            from npairloss_tpu.parallel.partition import (
+                gallery_rules,
+                match_partition_shardings,
+                place_tree,
+            )
+
+            tree = {"packed": packed, "rows": rows,
+                    "centroids": cents, "cluster_valid": cvalid}
+            placed = place_tree(
+                tree,
+                match_partition_shardings(
+                    gallery_rules(self.axis), tree, self.mesh),
+            )
             layout = IVFLayout(
-                packed=jax.device_put(packed, shard),
-                rows=jax.device_put(rows, shard),
-                centroids=jax.device_put(cents, rep),
-                cluster_valid=jax.device_put(cvalid, rep),
+                packed=placed["packed"],
+                rows=placed["rows"],
+                centroids=placed["centroids"],
+                cluster_valid=placed["cluster_valid"],
                 n_clusters=kc, cap=cap,
             )
         else:

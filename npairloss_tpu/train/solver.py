@@ -56,6 +56,7 @@ from npairloss_tpu.resilience.snapshot import (
     read_manifest,
     state_checksums,
     validate_snapshot,
+    validate_snapshot_wait,
     verify_restored,
     write_manifest,
 )
@@ -140,6 +141,7 @@ class Solver:
         pos_topk: Optional[int] = None,
         matmul_precision: Optional[str] = None,
         precision: Optional[Any] = None,
+        partition_rules: Optional[Sequence] = None,
         param_mults: Optional[tuple] = None,
         loss_weight: float = 1.0,
         health: Optional[HealthConfig] = None,
@@ -214,6 +216,21 @@ class Solver:
         self.param_mults = param_mults
         self.mesh = mesh
         self.axis = axis
+        # Declarative state sharding (parallel.partition,
+        # docs/DISTRIBUTED.md): ordered (regex, PartitionSpec) rules
+        # over the flattened state-tree path, first match wins,
+        # unmatched leaves LOUD.  None = the shipped replicated table —
+        # byte-identical placement to the hand-written
+        # NamedSharding(mesh, P()) calls this replaced (parity pinned
+        # by tests/test_partition.py).  A 2-D mesh (build_mesh mp>1)
+        # plus a table sharding kernels over "mp" is how params scale
+        # past replicated.
+        self.partition_rules = (tuple(partition_rules)
+                                if partition_rules is not None else None)
+        # The DCN-aware engine decision (parallel.plan.EnginePlan) the
+        # CLI resolved for this run, if any — stamped into the run
+        # manifest so "which engine and why" is provenance.
+        self.engine_plan = None
         # Loss engine (see docs/DESIGN.md §2): "dense" materializes the
         # pair matrix, "ring" streams it over ppermute hops on a mesh,
         # "blockwise" streams Pallas tiles on a single device (the
@@ -331,27 +348,85 @@ class Solver:
             jax.random.PRNGKey(self.cfg.random_seed),
             jnp.asarray(example_input),
         )
-        self.state = {
+        self.state = self._place_state({
             "params": variables["params"],
             "batch_stats": variables.get("batch_stats", {}),
             "opt": opt,
-        }
-        if self.mesh is not None:
-            replicated = NamedSharding(self.mesh, P())
-            if jax.process_count() > 1:
-                # Multi-controller: every process holds identical values
-                # (same seed); assemble them into one replicated global
-                # array per leaf — device_put cannot place onto devices
-                # another process owns.
-                self.state = jax.tree_util.tree_map(
-                    lambda x: jax.make_array_from_process_local_data(
-                        replicated, np.asarray(x)
-                    ),
-                    self.state,
-                )
-            else:
-                self.state = jax.device_put(self.state, replicated)
+        })
         return self.state
+
+    # -- declarative state sharding (parallel.partition) -------------------
+
+    def _rules(self):
+        """The effective partition ruleset: the caller's table, or the
+        shipped all-replicated one (the pre-partition behavior, by
+        construction)."""
+        if self.partition_rules is not None:
+            return self.partition_rules
+        from npairloss_tpu.parallel.partition import replicated_rules
+
+        return replicated_rules()
+
+    def _state_shardings(self, state=None):
+        """The state tree's NamedShardings, resolved through the rule
+        table — THE one source of placement truth: ``_place_state``
+        puts with it, the jitted step/eval fns take it as their state
+        ``in_shardings``, and ``--dump-partitions`` renders it.  Loud
+        (PartitionRuleError) on an unmatched leaf or an axis the mesh
+        lacks — at build time, not hours into a run."""
+        from npairloss_tpu.parallel.partition import match_partition_shardings
+
+        state = state if state is not None else self.state
+        return match_partition_shardings(self._rules(), state, self.mesh)
+
+    def _place_state(self, state):
+        """Rule-resolved device placement of a (host or device) state
+        tree.  Multi-controller processes each hold the full value
+        (identical seeds / identical restores) and contribute their
+        addressable shards; single-process is a plain sharded
+        device_put.  No mesh: leave placement to jit."""
+        if self.mesh is None:
+            return state
+        from npairloss_tpu.parallel.partition import place_tree
+
+        return place_tree(state, self._state_shardings(state))
+
+    def _abstract_state(self):
+        """The state tree as ShapeDtypeStructs, no arrays materialized
+        — lets ``partition_table``/``partition_summary`` run before
+        ``init()`` (manifest stamping, ``--dump-partitions`` preflight)
+        without paying device work."""
+        if self.state is not None:
+            return self.state
+
+        def build(key, x):
+            variables = self.model.init(key, x, train=False)
+            return {
+                "params": variables["params"],
+                "batch_stats": variables.get("batch_stats", {}),
+                "opt": self.tx.init(variables["params"]),
+            }
+
+        return jax.eval_shape(
+            build, jax.random.PRNGKey(self.cfg.random_seed),
+            jnp.zeros((2, *self.input_shape), jnp.float32),
+        )
+
+    def partition_table(self) -> Dict[str, Any]:
+        """The resolved rule -> PartitionSpec table per state leaf,
+        with per-rule match counts — zero-match (silent no-op) rules
+        flagged.  ``train --dump-partitions`` prints this."""
+        from npairloss_tpu.parallel.partition import partition_table
+
+        return partition_table(self._rules(), self._abstract_state(),
+                               mesh=self.mesh)
+
+    def partition_summary(self) -> Dict[str, Any]:
+        """Manifest-sized digest of :meth:`partition_table`."""
+        from npairloss_tpu.parallel.partition import partition_summary
+
+        return partition_summary(self._rules(), self._abstract_state(),
+                                 mesh=self.mesh)
 
     # -- compiled step ----------------------------------------------------
 
@@ -519,14 +594,26 @@ class Solver:
         donate = (0,)
         if self.mesh is not None:
             data_sharding = NamedSharding(self.mesh, P(self.axis))
-            replicated = NamedSharding(self.mesh, P())
+            # State placement comes from the partition-rule table (one
+            # source of truth with _place_state), not hand-placed specs;
+            # None (state not built yet) defers to the arguments' own
+            # shardings, which _place_state already resolved.
+            state_sh = (self._state_shardings()
+                        if self.state is not None else None)
+            # out_shardings pins the NEW state to the same rule table:
+            # without it XLA may propagate a sharded kernel's layout
+            # onto e.g. its bias in the OUTPUT, and the next step's
+            # input contract breaks (the rules are the invariant, for
+            # inputs and outputs alike).
             self._step_fn = jax.jit(
                 train_step,
                 donate_argnums=donate,
-                in_shardings=(None, data_sharding, data_sharding),
+                in_shardings=(state_sh, data_sharding, data_sharding),
+                out_shardings=(state_sh, None),
             )
             self._eval_fn = jax.jit(
-                eval_step, in_shardings=(None, data_sharding, data_sharding)
+                eval_step,
+                in_shardings=(state_sh, data_sharding, data_sharding),
             )
         else:
             self._step_fn = jax.jit(train_step, donate_argnums=donate)
@@ -588,10 +675,16 @@ class Solver:
         if self.mesh is not None:
             data_sharding = NamedSharding(self.mesh, P(self.axis))
             replicated = NamedSharding(self.mesh, P())
+            state_sh = (self._state_shardings()
+                        if self.state is not None else None)
+            # Same out-pinning as _make_step: state stays on the rule
+            # table, the ring stays replicated, across every step.
             self._pipe_step_fn = jax.jit(
                 pipelined_step,
                 donate_argnums=donate,
-                in_shardings=(None, replicated, data_sharding, data_sharding),
+                in_shardings=(state_sh, replicated,
+                              data_sharding, data_sharding),
+                out_shardings=(state_sh, replicated, None),
             )
         else:
             self._pipe_step_fn = jax.jit(pipelined_step,
@@ -1554,10 +1647,7 @@ class Solver:
                 self.state["batch_stats"],
                 batch_stats,
             )
-        if self.mesh is not None:
-            replicated = NamedSharding(self.mesh, P())
-            state = jax.device_put(state, replicated)
-        self.state = state
+        self.state = self._place_state(state)
         return self.state
 
     def load_caffe_solverstate(self, path: str, model_name: str = "googlenet"):
@@ -1611,11 +1701,18 @@ class Solver:
         state["opt"] = CaffeSGDState(
             momentum_buf=mom, step=jnp.asarray(int(st["iter"]), jnp.int32)
         )
-        if self.mesh is not None:
-            replicated = NamedSharding(self.mesh, P())
-            state = jax.device_put(state, replicated)
-        self.state = state
+        self.state = self._place_state(state)
         return int(st["iter"])
+
+    def _resume_rank(self) -> int:
+        """This process's rank for multi-writer snapshot coordination:
+        jax's own when a multi-controller runtime is up, else the
+        declared harness rank (``NPAIRLOSS_FLEET_PROCESS``), else 0.
+        Non-zero ranks WAIT on rank 0's manifest instead of reading a
+        just-committed multihost save as torn (docs/DISTRIBUTED.md)."""
+        from npairloss_tpu.obs.fleet.stamp import resolved_process
+
+        return resolved_process()[0]
 
     def restore_snapshot(self, path: str):
         """Restore an explicit snapshot path (retrying transient I/O).
@@ -1624,11 +1721,19 @@ class Solver:
         is checksum-verified against it — a corrupt snapshot raises
         ``SnapshotValidationError`` instead of silently resuming from
         garbage.  Manifest-less dirs (pre-resilience snapshots, raw
-        Orbax trees) restore unverified, preserving the old contract.
+        Orbax trees) restore unverified, preserving the old contract —
+        but a NON-ZERO rank first waits out the multihost commit race
+        (rank 0 writes the manifest after the collective save lands)
+        before concluding the dir is legacy.
         """
         if self.state is None:
             self.init()
         self._ckpt().wait_until_finished()
+        if self._resume_rank() != 0:
+            try:
+                validate_snapshot_wait(path, self.snapshot_retry)
+            except Exception:  # noqa: BLE001 — verdict below, per contract
+                pass
 
         def do_restore():
             failpoints.fire("snapshot.restore.io")
@@ -1673,11 +1778,20 @@ class Solver:
             self.init()
         self._ckpt().wait_until_finished()
         prefix = self.cfg.snapshot_prefix
+        rank = self._resume_rank()
         for step, path in reversed(list_snapshots(prefix)):
             if max_step is not None and step > max_step:
                 continue
             try:
-                manifest = validate_snapshot(path)
+                # A non-zero rank can scan this dir BETWEEN the
+                # collective Orbax save landing and rank 0 writing
+                # manifest.json; waiting (the shared retry/backoff)
+                # turns that race into a pause instead of skipping a
+                # perfectly valid snapshot as torn.  Rank 0 never
+                # waits: for it a missing manifest IS a torn commit.
+                manifest = (validate_snapshot_wait(path,
+                                                   self.snapshot_retry)
+                            if rank != 0 else validate_snapshot(path))
 
                 def do_restore(path=path):
                     failpoints.fire("snapshot.restore.io")

@@ -182,13 +182,17 @@ def _load_fleet_aggregate():
     return sys.modules["npairloss_tpu.obs.fleet.aggregate"]
 
 
-def check_fleet_report(path: str) -> List[str]:
+def check_fleet_report(path: str,
+                       expect_link: Optional[str] = None) -> List[str]:
     """Gate one fleet report artifact: schema-valid per the one
     contract (validate_fleet_report), per-rank step counts in
     agreement (ranks not training in lockstep is a broken fleet, not a
     measurement), and zero unattributed collective bytes when the
     comms join ran (an unclaimed collective kind means an exchange
-    path went uninstrumented)."""
+    path went uninstrumented).  ``expect_link`` additionally pins the
+    comms link kind — the multi-controller ci smoke demands "dcn"
+    (collectives priced as crossing host processes), so a run that
+    silently fell back to single-process pricing fails the gate."""
     try:
         with open(path) as f:
             report = json.load(f)
@@ -218,6 +222,16 @@ def check_fleet_report(path: str) -> List[str]:
             f"{comms['unattributed_bytes']:.0f} collective bytes "
             "unattributed — an exchange path is missing its comm/ "
             "instrumentation")
+    if expect_link is not None:
+        if not comms.get("available"):
+            violations.append(
+                f"comms join unavailable but --expect-link {expect_link} "
+                "was demanded (no fleet_comms.json priced)")
+        elif comms.get("link") != expect_link:
+            violations.append(
+                f"comms link is {comms.get('link')!r}, expected "
+                f"{expect_link!r} — the run did not price its "
+                "collectives as crossing host processes")
     if not violations:
         _log(f"fleet report OK ({len(counts)} rank(s), "
              f"{next(iter(counts.values()))} steps each)")
@@ -447,6 +461,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "bytes — the ci.sh fleet-smoke wiring",
     )
     ap.add_argument(
+        "--expect-link", dest="expect_link", choices=["ici", "dcn"],
+        help="with --fleet-report: additionally require the comms "
+        "block's link kind (the multi-controller smoke pins 'dcn')",
+    )
+    ap.add_argument(
         "--alerts", metavar="PATH",
         help="gate a live-observatory alert log instead of the bench "
         "trajectory: schema-valid (npairloss-alerts-v1) and no "
@@ -464,7 +483,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.fleet_report:
-        violations = check_fleet_report(args.fleet_report)
+        violations = check_fleet_report(args.fleet_report,
+                                        expect_link=args.expect_link)
         if violations:
             for v in violations:
                 print(f"REGRESSION: {v}")

@@ -375,6 +375,166 @@ EOF
 python scripts/bench_check.py --fleet-report "$fleet_dir/run/fleet_report.json" \
     || { echo "fleet smoke: bench_check refused the fleet report"; exit 1; }
 
+echo "== pod-scale multi-controller smoke (docs/DISTRIBUTED.md) =="
+# One global batch, three ways: a single-process virtual 2-device mesh
+# BASELINE, then TWO controller processes covering the same global
+# mesh — real jax.distributed where the capability probe passed (each
+# process owning 1 device, per-process disjoint data shards), else the
+# declared-rank harness (NPAIRLOSS_FLEET_PROCESS, each process running
+# the full virtual mesh on the same global batch).  The contract: the
+# 2-process run produces byte-identical metric-key streams and
+# bit-identical final params vs the baseline, for BOTH the dense and
+# ring engines, under the strict sync guard; then `prof --fleet` over
+# the shared run dir must reconcile with ZERO unattributed collective
+# bytes and the DCN link, gated by bench_check --expect-link dcn.
+# (Reuses $probe_ok from the fleet smoke's capability probe.)
+pod_dir="$smoke_dir/pod"
+mkdir -p "$pod_dir"
+cat > "$pod_dir/solver.prototxt" <<EOF
+net: "examples/tiny_net.prototxt"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+max_iter: 6
+display: 3
+test_interval: 0
+test_iter: 0
+snapshot: 6
+snapshot_prefix: "$pod_dir/unused_"
+EOF
+for eng in dense ring; do
+    # Baseline: one process, the whole 2-device virtual mesh.
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+        NPAIRLOSS_PIPELINE_SYNC_GUARD=strict \
+        python -m npairloss_tpu train --solver "$pod_dir/solver.prototxt" \
+        --model mlp --synthetic --engine "$eng" --mesh 2 --pipeline \
+        --snapshot_prefix "$pod_dir/base_${eng}_s_" \
+        --telemetry-dir "$pod_dir/base_$eng" \
+        > "$pod_dir/base_$eng.log" 2>&1 \
+        || { echo "pod smoke: baseline $eng failed"; cat "$pod_dir/base_$eng.log"; exit 1; }
+    if [[ "$probe_ok" -eq 1 ]]; then
+        pod_mode=real
+        pod_port=$(python -c 'import socket; s=socket.socket(); s.bind(("localhost",0)); print(s.getsockname()[1])')
+        for i in 0 1; do
+            JAX_PLATFORMS=cpu XLA_FLAGS= NPAIRLOSS_PIPELINE_SYNC_GUARD=strict \
+                python -m npairloss_tpu train --solver "$pod_dir/solver.prototxt" \
+                --model mlp --synthetic --engine "$eng" --pipeline \
+                --coordinator "localhost:$pod_port" --num-processes 2 --process-id "$i" \
+                --snapshot_prefix "$pod_dir/pod_${eng}_s_" \
+                --telemetry-dir "$pod_dir/pod_$eng" \
+                > "$pod_dir/pod_${eng}_$i.log" 2>&1 &
+            eval "podpid$i=\$!"
+        done
+    else
+        pod_mode=harness
+        for i in 0 1; do
+            JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+                NPAIRLOSS_FLEET_PROCESS="$i/2" NPAIRLOSS_PIPELINE_SYNC_GUARD=strict \
+                python -m npairloss_tpu train --solver "$pod_dir/solver.prototxt" \
+                --model mlp --synthetic --engine "$eng" --mesh 2 --pipeline \
+                --snapshot_prefix "$pod_dir/pod_${eng}_r${i}_s_" \
+                --telemetry-dir "$pod_dir/pod_$eng" \
+                > "$pod_dir/pod_${eng}_$i.log" 2>&1 &
+            eval "podpid$i=\$!"
+        done
+    fi
+    for i in 0 1; do
+        eval "pid=\$podpid$i"
+        wait "$pid" \
+            || { echo "pod smoke: $eng rank $i failed"; cat "$pod_dir/pod_${eng}_$i.log"; exit 1; }
+    done
+    python - "$pod_dir" "$eng" "$pod_mode" <<'EOF'
+import json, sys
+
+d, eng, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+
+# -- params: bit-identical final snapshots, proven from the commit
+# manifests' per-leaf CRC-32s (identical bits <=> identical checksums)
+# — no backend, no device-mesh coupling to how the snapshot was saved.
+def arrays(path):
+    m = json.load(open(path + "/manifest.json"))
+    assert m["step"] == 6, m["step"]
+    return m["arrays"]
+
+base = arrays(f"{d}/base_{eng}_s_iter_6.ckpt")
+assert base, "baseline snapshot manifest empty"
+pods = ([f"{d}/pod_{eng}_s_iter_6.ckpt"] if mode == "real" else
+        [f"{d}/pod_{eng}_r{i}_s_iter_6.ckpt" for i in (0, 1)])
+for p in pods:
+    got = arrays(p)
+    assert got == base, (
+        f"{eng}: params differ vs {p}: "
+        + str([k for k in base if got.get(k) != base[k]][:4]))
+
+# -- streams: byte-identical metric-key streams -------------------------
+DROP = {"run_id", "wall_time", "process_index", "process_count",
+        "local_device_ids"}
+
+def rows(path):
+    out = []
+    for ln in open(path):
+        if not ln.strip():
+            continue
+        r = json.loads(ln)
+        out.append((r.get("phase"), r.get("step"),
+                    tuple(sorted((k, v) for k, v in r.items()
+                                 if k not in DROP and k not in
+                                 ("phase", "step")))))
+    return out
+
+want = rows(f"{d}/base_{eng}/metrics.jsonl")
+assert want, "baseline stream empty"
+for i in (0, 1):
+    got = rows(f"{d}/pod_{eng}/telemetry.r{i}.jsonl")
+    assert got == want, (
+        f"{eng}: rank {i} stream diverges from the single-process "
+        f"baseline ({len(got)} vs {len(want)} rows)")
+print(f"pod smoke [{mode}] {eng}: params bit-identical, "
+      f"{len(want)}-row metric streams byte-identical across "
+      "baseline + both ranks")
+EOF
+done
+# The shared run dir of the LAST engine (ring) feeds the fleet gate:
+# both ranks present, zero unattributed bytes, DCN link selected.
+JAX_PLATFORMS=cpu python -m npairloss_tpu prof --fleet "$pod_dir/pod_ring" \
+    > "$pod_dir/prof.log" 2>&1 \
+    || { echo "pod smoke: prof --fleet failed"; cat "$pod_dir/prof.log"; exit 1; }
+python scripts/bench_check.py --fleet-report "$pod_dir/pod_ring/fleet_report.json" \
+    --expect-link dcn \
+    || { echo "pod smoke: fleet report not valid/DCN"; exit 1; }
+python - "$pod_dir/pod_ring" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+man = json.load(open(sorted(glob.glob(d + "/manifest.r0.json"))[0]))
+plan = man["config"]["engine_plan"]
+assert plan and plan["link"] == "dcn" and plan["hosts"] == 2, plan
+part = man["config"]["partition"]
+assert part["unmatched"] == 0 and part["noop_rules"] == [], part
+print(f"pod smoke manifest OK (engine_plan link={plan['link']}, "
+      f"hosts={plan['hosts']}, partition {part['leaves']} leaves / "
+      f"{part['sharded_leaves']} sharded)")
+EOF
+# --engine auto + --dump-partitions preflight: the resolved table must
+# print (no zero-match rules on the default table) and the manifest
+# must stamp the auto plan.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    python -m npairloss_tpu train --solver "$pod_dir/solver.prototxt" \
+    --model mlp --synthetic --engine auto --mesh 2 --max_iter 0 \
+    --dump-partitions --telemetry-dir "$pod_dir/auto" \
+    > "$pod_dir/auto.log" 2>&1 \
+    || { echo "pod smoke: --engine auto preflight failed"; cat "$pod_dir/auto.log"; exit 1; }
+grep -q "partition rules (first match wins):" "$pod_dir/auto.log" \
+    || { echo "pod smoke: --dump-partitions printed no table"; cat "$pod_dir/auto.log"; exit 1; }
+python - "$pod_dir/auto/manifest.json" <<'EOF'
+import json, sys
+cfg = json.load(open(sys.argv[1]))["config"]
+plan = cfg["engine_plan"]
+assert plan["requested"] == "auto" and plan["engine"] in ("dense", "ring")
+assert cfg["engine"] == plan["engine"], (cfg["engine"], plan["engine"])
+print(f"pod smoke auto OK (auto -> {plan['engine']}: "
+      + plan["reason"][:70] + "...)")
+EOF
+
 echo "== live observatory smoke (docs/OBSERVABILITY.md §Live) =="
 # The alert lifecycle end-to-end: a CLEAN serve run under an SLO config
 # fires ZERO alerts; a run with the serve.latency failpoint armed fires

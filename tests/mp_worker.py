@@ -145,6 +145,46 @@ def main() -> int:
     m = solver.step(f_mine, l_mine)
     assert np.isfinite(float(m["loss"])), m
 
+    # Per-process disjoint shards of a DETERMINISTIC global batch
+    # (data.shard_batches, docs/DISTRIBUTED.md): every controller
+    # computes the same global stream, rank r contributes rows
+    # [r*n, (r+1)*n), and the assembled mesh array IS the global batch
+    # — the data model behind the single-vs-multi-process bit-identity
+    # parity contract.
+    from npairloss_tpu.data import shard_batches
+
+    def global_stream():
+        r = np.random.default_rng(7)
+        while True:
+            gf = r.standard_normal((4 * g, 16)).astype(np.float32)
+            gl = np.repeat(np.arange(2 * g), 2).astype(np.int32)
+            yield gf, gl
+
+    xs, ls = next(shard_batches(global_stream(), proc_id, nproc))
+    assert xs.shape[0] == 4 * g // nproc, xs.shape
+    gxs, gls = next(global_stream())
+    axs, als = process_local_batch(mesh, (xs, ls))
+    assert axs.shape[0] == 4 * g, axs.shape
+    for s in axs.addressable_shards:
+        start = s.index[0].start or 0
+        np.testing.assert_array_equal(
+            np.asarray(s.data), gxs[start:start + s.data.shape[0]],
+            err_msg="assembled shard is not the global batch's slice")
+
+    # Multi-host snapshot -> resume: the collective Orbax save with
+    # rank 0 writing the manifest AFTER it lands; every rank then
+    # resumes via --resume auto semantics.  Rank 1 reaching
+    # restore_auto while rank 0 is still writing manifest.json is THE
+    # race resilience.validate_snapshot_wait exists for — exercised
+    # live here, not just in the mocked unit test.
+    import dataclasses
+
+    solver.cfg = dataclasses.replace(
+        solver.cfg, snapshot_prefix=os.path.join(out_dir, "snap_"))
+    snap = solver.save_snapshot(solver.iteration)
+    restored = solver.restore_auto()
+    assert restored == snap, (restored, snap)
+
     # Fleet observatory leg (obs.fleet): every rank opens rank-stamped
     # telemetry on the SAME shared run dir and trains a few more steps
     # — rank-disjoint streams, step-numbered dispatch spans, per-step
