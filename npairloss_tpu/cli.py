@@ -312,6 +312,17 @@ def cmd_train(args) -> int:
         log.error("--metrics-port needs --live-obs (there is no "
                   "metric registry to export without it)")
         return 2
+    if getattr(args, "remediation_config", None):
+        # Parse NOW: a typo'd policy table must not cost a solver
+        # build + restore first (it re-loads cheaply at wiring time).
+        from npairloss_tpu.resilience.remediate import load_policies
+
+        try:
+            load_policies(args.remediation_config)
+        except (OSError, ValueError) as e:
+            log.error("--remediation-config %s: %s",
+                      args.remediation_config, e)
+            return 2
     # The MPI_COMM_WORLD replacement: must run before the first backend
     # query (exactly as MPI_Init precedes any communicator use).
     from npairloss_tpu.parallel import initialize_distributed
@@ -511,6 +522,62 @@ def cmd_train(args) -> int:
                                       max(_time.time() - created, 0.0))
 
             live.add_probe(_snapshot_age_probe)
+            if getattr(args, "remediate_dry_run", False):
+                args.remediate = True  # a dry-run IS a remediation run
+            if getattr(args, "remediate", False):
+                # Alert→actuation for training (docs/RESILIENCE.md
+                # §Remediation): a health-signal alert (embedding
+                # collapse) requests a rollback the train loop executes
+                # at its next safe point — resilience/guard.py's
+                # divergence recovery generalized beyond non-finite
+                # streaks.
+                from npairloss_tpu.resilience.guard import (
+                    RollbackRequest,
+                )
+                from npairloss_tpu.resilience.remediate import (
+                    RemediationEngine,
+                    default_policies,
+                    load_policies,
+                )
+
+                def _rollback_action(alert):
+                    solver.request_rollback(RollbackRequest(
+                        reason=(f"{alert.get('slo')} alert "
+                                f"{alert.get('alert_id')}"),
+                        before_wall_time=alert.get("fired_at"),
+                    ))
+                    return {"requested": True}
+
+                policies = (
+                    load_policies(args.remediation_config)
+                    if getattr(args, "remediation_config", None)
+                    else default_policies("train"))
+                try:
+                    remediation = RemediationEngine(
+                        policies,
+                        {"trainer_rollback": _rollback_action},
+                        log_path=os.path.join(tel_dir,
+                                              "remediation.jsonl"),
+                        dry_run=getattr(args, "remediate_dry_run",
+                                        False),
+                    )
+                except ValueError as e:
+                    # A config naming an action training cannot perform
+                    # is a config error, not a crash.
+                    log.error("--remediation-config %s: %s",
+                              args.remediation_config, e)
+                    return 2
+                live.set_remediation(remediation)
+                log.info(
+                    "remediation armed: %s%s",
+                    ", ".join(f"{p.name}({p.slo}->{p.action})"
+                              for p in policies),
+                    " [DRY-RUN]" if remediation.dry_run else "")
+        elif getattr(args, "remediate", False) or \
+                getattr(args, "remediate_dry_run", False):
+            log.error("--remediate needs --live-obs (remediation is "
+                      "driven by the alert engine)")
+            return 2
         if tel_dir or trace_dir:
             import dataclasses
 
@@ -1066,7 +1133,11 @@ def cmd_serve(args) -> int:
 
     import jax
 
-    from npairloss_tpu.resilience import EXIT_PREEMPTED, PreemptionSignal
+    from npairloss_tpu.resilience import (
+        EXIT_PREEMPTED,
+        PreemptionSignal,
+        failpoints,
+    )
     from npairloss_tpu.serve import (
         BatcherConfig,
         EngineConfig,
@@ -1077,6 +1148,32 @@ def cmd_serve(args) -> int:
         ServerConfig,
     )
     from npairloss_tpu.serve.index import load_index, load_newest
+
+    # Arg-only validations FIRST — a misconfigured invocation must fail
+    # in milliseconds, not after the index loads and the buckets warm.
+    if getattr(args, "remediate_dry_run", False):
+        args.remediate = True  # a dry-run IS a remediation run
+    if getattr(args, "watch_snapshots", None) and not args.snapshot:
+        log.error("--watch-snapshots needs --snapshot/--model (the "
+                  "hot-swap restores new params INTO the served model; "
+                  "embedding-only serving can only watch --index-prefix)")
+        return 2
+    if getattr(args, "remediate", False) and \
+            not getattr(args, "live_obs", False):
+        log.error("--remediate needs --live-obs (remediation is driven "
+                  "by the alert engine)")
+        return 2
+    if getattr(args, "remediation_config", None):
+        # Parse NOW (it re-loads cheaply at wiring time): a typo'd
+        # policy table must not cost an index load + warmup first.
+        from npairloss_tpu.resilience.remediate import load_policies
+
+        try:
+            load_policies(args.remediation_config)
+        except (OSError, ValueError) as e:
+            log.error("--remediation-config %s: %s",
+                      args.remediation_config, e)
+            return 2
 
     if args.compile_cache:
         from npairloss_tpu.pipeline import enable_compile_cache
@@ -1115,16 +1212,23 @@ def cmd_serve(args) -> int:
     # structure (docs/SERVING.md §Approximate index): a flat commit can
     # serve through the IVF probe path (clustered in-memory at startup)
     # and an IVF commit can serve flat (the exact-scan recall oracle) —
-    # the committed artifact never dictates the serving posture.
-    if args.index_kind == "ivf" and not isinstance(index, IVFIndex):
-        log.info("clustering flat index into IVF (%s clusters)...",
-                 args.ivf_clusters or "auto")
-        index = IVFIndex.from_gallery(index, clusters=args.ivf_clusters)
-    elif args.index_kind == "flat" and isinstance(index, IVFIndex):
-        log.info("serving ivf commit through the flat exact scan")
-        index = GalleryIndex.build(
-            index._host_emb, index._host_labels, ids=index.ids,
-            mesh=mesh, normalize=False)
+    # the committed artifact never dictates the serving posture.  ONE
+    # closure, because the hot-swap remediation must apply the same
+    # reconciliation to every swapped-in index (a flat commit must not
+    # demote an IVF tier at the first swap).
+    def _reconcile_index(idx):
+        if args.index_kind == "ivf" and not isinstance(idx, IVFIndex):
+            log.info("clustering flat index into IVF (%s clusters)...",
+                     args.ivf_clusters or "auto")
+            return IVFIndex.from_gallery(idx, clusters=args.ivf_clusters)
+        if args.index_kind == "flat" and isinstance(idx, IVFIndex):
+            log.info("serving ivf commit through the flat exact scan")
+            return GalleryIndex.build(
+                idx._host_emb, idx._host_labels, ids=idx.ids,
+                mesh=mesh, normalize=False)
+        return idx
+
+    index = _reconcile_index(index)
 
     model = state = None
     input_shape = None
@@ -1188,6 +1292,9 @@ def cmd_serve(args) -> int:
                 "max_queue": args.max_queue,
                 "live_obs": live is not None,
                 "slo_config": getattr(args, "slo_config", None),
+                "remediate": bool(getattr(args, "remediate", False)
+                                  or getattr(args, "remediate_dry_run",
+                                             False)),
             })
 
     if args.admission != "off" and live is None:
@@ -1242,13 +1349,100 @@ def cmd_serve(args) -> int:
             ServerConfig(metrics_window=args.metrics_window),
             telemetry=telemetry, preempt=preempt,
             freshness=freshness, live=live, admission=admission,
+            input_shape=input_shape,
         )
+        if getattr(args, "remediate", False):
+            # Alert→actuation (docs/RESILIENCE.md §Remediation): bind
+            # the live alerts to the serve-side actions this run can
+            # actually perform, audited to remediation.jsonl.
+            # (--live-obs presence was validated before the preempt
+            # handler went in.)
+            from npairloss_tpu.resilience.remediate import (
+                RemediationEngine,
+                default_policies,
+                load_policies,
+            )
+
+            explicit = bool(getattr(args, "remediation_config", None))
+            policies = (load_policies(args.remediation_config)
+                        if explicit else default_policies("serve"))
+            actions = {}
+            if args.index_prefix or getattr(args, "watch_snapshots",
+                                            None):
+                from npairloss_tpu.serve.hotswap import SnapshotSwapper
+
+                swapper = SnapshotSwapper(
+                    server, mesh=mesh,
+                    index_prefix=args.index_prefix,
+                    snapshot_prefix=getattr(args, "watch_snapshots",
+                                            None),
+                    model=model, input_shape=input_shape,
+                    telemetry=telemetry,
+                    index_transform=_reconcile_index,
+                )
+                actions["snapshot_hotswap"] = swapper.swap
+            actions["rewarm"] = lambda alert: server.rewarm()
+            if admission is None and any(p.action == "load_shed"
+                                         for p in policies):
+                # Remediation-driven shedding needs the throttle in the
+                # submit path: a forced-only controller (NO burn
+                # listener — it sheds only while the load_shed policy
+                # holds it engaged).
+                from npairloss_tpu.serve.admission import (
+                    AdmissionConfig,
+                    AdmissionController,
+                )
+
+                admission = AdmissionController(
+                    AdmissionConfig(), registry=live.registry)
+                server.admission = admission
+            if admission is not None:
+                actions["load_shed"] = (admission.engage,
+                                        admission.release)
+            if not explicit:
+                # The default table ships every policy; keep the ones
+                # this invocation registered an actuator for.  An
+                # EXPLICIT config is never filtered — a policy without
+                # its action is a loud config error.
+                policies = [p for p in policies if p.action in actions]
+            try:
+                remediation = RemediationEngine(
+                    policies, actions,
+                    log_path=os.path.join(tel_dir, "remediation.jsonl"),
+                    dry_run=getattr(args, "remediate_dry_run", False),
+                )
+            except ValueError as e:
+                # An explicit config naming an action this invocation
+                # has no actuator for (snapshot_hotswap without a
+                # watched prefix) — a config error, not a crash.
+                log.error("--remediation-config %s: %s",
+                          args.remediation_config, e)
+                return 2
+            server.remediation = remediation
+            live.set_remediation(remediation)
+            log.info("remediation armed: %s%s",
+                     ", ".join(f"{p.name}({p.slo}->{p.action})"
+                               for p in policies) or "no policies",
+                     " [DRY-RUN]" if remediation.dry_run else "")
         if live is not None:
             # Freshness probe: ages are server state, not metric rows —
             # each evaluator tick republishes them so the staleness
-            # watchdogs see a continuous stream.
+            # watchdogs see a continuous stream.  Reads the SERVER's
+            # freshness (not a construction-time snapshot): a hot-swap
+            # republishes identity + ages, and the probe must see the
+            # drop.  The serve.stale_model failpoint poisons the
+            # published model age so the staleness→hot-swap loop is
+            # deterministically drivable.
             def _freshness_probe():
-                for key, v in freshness.ages().items():
+                f = server.freshness
+                if f is None:
+                    return
+                ages = f.ages()
+                if failpoints.should_fire("serve.stale_model"):
+                    ages["model_age_s"] = (
+                        ages.get("model_age_s", 0.0)
+                        + failpoints.STALE_AGE_FAULT_S)
+                for key, v in ages.items():
                     live.registry.set(f"serve_{key}", v)
 
             live.add_probe(_freshness_probe)
@@ -2069,6 +2263,26 @@ def main(argv: Optional[list] = None) -> int:
         "with SLO status) on this localhost port",
     )
     t.add_argument(
+        "--remediate", action="store_true",
+        help="alert→actuation (docs/RESILIENCE.md §Remediation): a "
+        "health-signal alert (embedding collapse) requests a rollback "
+        "to a pre-incident snapshot, executed at the loop's next safe "
+        "point and audited to <telemetry-dir>/remediation.jsonl; "
+        "needs --live-obs",
+    )
+    t.add_argument(
+        "--remediation-config", dest="remediation_config",
+        metavar="PATH",
+        help="remediation policy table (JSON; default: the shipped "
+        "train policies)",
+    )
+    t.add_argument(
+        "--remediate-dry-run", dest="remediate_dry_run",
+        action="store_true",
+        help="log every remediation the policies WOULD run without "
+        "acting — implies --remediate",
+    )
+    t.add_argument(
         "--debug-checks", dest="debug_checks", action="store_true",
         help="validate every step's loss/metric scalars are finite on "
         "host (utils.debug.enable_debug_checks; also settable via "
@@ -2327,6 +2541,35 @@ def main(argv: Optional[list] = None) -> int:
         "--slo-tick", dest="slo_tick", type=float, default=1.0,
         metavar="S",
         help="live-obs evaluation period in seconds (default 1.0)",
+    )
+    sv.add_argument(
+        "--remediate", action="store_true",
+        help="alert→actuation (docs/RESILIENCE.md §Remediation): bind "
+        "the live alerts to guarded actions — snapshot/index hot-swap "
+        "on staleness (needs --watch-snapshots/--index-prefix), "
+        "load-shed on queue saturation, re-warm on a post-warmup "
+        "compile storm — audited to remediation.jsonl; needs "
+        "--live-obs",
+    )
+    sv.add_argument(
+        "--remediation-config", dest="remediation_config",
+        metavar="PATH",
+        help="remediation policy table (JSON; default: the shipped "
+        "serve policies filtered to the actions this invocation can "
+        "perform)",
+    )
+    sv.add_argument(
+        "--remediate-dry-run", dest="remediate_dry_run",
+        action="store_true",
+        help="log every remediation the policies WOULD run (budgets "
+        "included) without acting — implies --remediate",
+    )
+    sv.add_argument(
+        "--watch-snapshots", dest="watch_snapshots", metavar="PREFIX",
+        help="training snapshot_prefix the hot-swap remediation "
+        "watches for newer committed snapshots (the train→serve "
+        "freshness loop's actuation half; pair with --snapshot for "
+        "the initial model)",
     )
     sv_tel = sv.add_mutually_exclusive_group()
     sv_tel.add_argument(

@@ -56,7 +56,11 @@ from npairloss_tpu.obs.live.slo import (
 )
 from npairloss_tpu.obs.live.watchdogs import bench_floor_emb_per_sec, default_watchdogs
 from npairloss_tpu.obs.live.export import prometheus_text, start_http_exporter
-from npairloss_tpu.obs.live.watch import replay_records, watch_run_dir
+from npairloss_tpu.obs.live.watch import (
+    reconcile_remediation,
+    replay_records,
+    watch_run_dir,
+)
 
 __all__ = [
     "ALERTS_SCHEMA",
@@ -76,6 +80,7 @@ __all__ = [
     "load_alert_log",
     "load_slo_config",
     "prometheus_text",
+    "reconcile_remediation",
     "replay_records",
     "start_http_exporter",
     "unresolved_alerts",

@@ -30,6 +30,63 @@ from npairloss_tpu.obs.live.live import LiveObservatory
 from npairloss_tpu.obs.live.slo import SLOSpec
 
 WATCH_ALERTS_FILENAME = "alerts.watch.jsonl"
+REMEDIATION_FILENAME = "remediation.jsonl"
+
+
+def _load_remediate():
+    """File-path-load ``resilience.remediate`` (self-contained, stdlib
+    only) WITHOUT importing the resilience package — whose ``__init__``
+    pulls the jax-needing snapshot module, and watch must stay
+    backend-free (the bench_check loader pattern)."""
+    import importlib.util
+    import sys
+
+    name = "npairloss_tpu.resilience.remediate"
+    if name not in sys.modules:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "resilience", "remediate.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def reconcile_remediation(
+    rem_records: Sequence[Dict[str, Any]],
+    alert_events: Sequence[Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Join one run's remediation audit against an alert-event stream
+    (the watch replay's, or the live log's): every resolved alert of an
+    SLO some policy ACTS ON should have an action, and every action's
+    alert should eventually resolve.  Both mismatch directions are
+    reported — ``alert_resolved_no_action`` (the alert healed on its
+    own, or the actuator missed it) and ``action_no_resolution`` (the
+    action ran but the incident never stood down) — as evidence for the
+    operator, not a gate (bench_check --remediation owns the gating)."""
+    # Dry-run attempts are rehearsals, not actions: they still mark
+    # their SLO as policy-covered (so resolved-with-no-action reporting
+    # works in a dry run) but must never read as "the actuator resolved
+    # this incident".
+    acted = {str(r.get("alert_id")) for r in rem_records
+             if isinstance(r, dict) and not r.get("dry_run")}
+    policy_slos = {r.get("slo") for r in rem_records
+                   if isinstance(r, dict)}
+    fired = {e["alert_id"]: e["slo"] for e in alert_events
+             if e.get("state") == "firing"}
+    resolved = {e["alert_id"] for e in alert_events
+                if e.get("state") == "resolved"}
+    return {
+        "records": len(rem_records),
+        "matched": sorted(acted & resolved),
+        "alert_resolved_no_action": sorted(
+            aid for aid, slo in fired.items()
+            if aid in resolved and slo in policy_slos
+            and aid not in acted),
+        "action_no_resolution": sorted(acted - resolved),
+    }
 
 
 def telemetry_paths(run_dir: str) -> List[str]:
@@ -168,6 +225,22 @@ def watch_run_dir(
         drain_once()
     obs.alerts.close()
     active = obs.alerts.active()
+    remediation: Optional[Dict[str, Any]] = None
+    rem_path = os.path.join(run_dir, REMEDIATION_FILENAME)
+    if os.path.exists(rem_path):
+        # The run remediated: validate its audit log and reconcile it
+        # against the alert lifecycle the replay just reproduced — a
+        # resolved alert with no action and an action with no
+        # resolution are both reported.
+        rem = _load_remediate()
+        rem_records = rem.load_remediation_log(rem_path)
+        err = rem.validate_remediation_log(rem_records)
+        remediation = {
+            "log": rem_path,
+            "valid": err is None,
+            **({"error": err} if err else {}),
+            **reconcile_remediation(rem_records, events),
+        }
     return {
         "run_dir": run_dir,
         "streams": paths,
@@ -182,4 +255,8 @@ def watch_run_dir(
         # empty window and print every SLO as ok right next to an
         # active alert in the same summary.
         "slo": obs.evaluator.status_dict(last_t[0]),
+        # Remediation reconciliation only when the run remediated (the
+        # absent-key contract: no audit log, no block).
+        **({"remediation": remediation}
+           if remediation is not None else {}),
     }

@@ -29,6 +29,13 @@ from npairloss_tpu.resilience.guard import (
     DivergenceConfig,
     DivergenceError,
     DivergenceGuard,
+    RollbackRequest,
+)
+from npairloss_tpu.resilience.remediate import (
+    RemediationEngine,
+    RemediationPolicy,
+    load_remediation_log,
+    validate_remediation_log,
 )
 from npairloss_tpu.resilience.preempt import (
     EXIT_PREEMPTED,
@@ -57,7 +64,10 @@ __all__ = [
     "DivergenceGuard",
     "InjectedFault",
     "PreemptionSignal",
+    "RemediationEngine",
+    "RemediationPolicy",
     "RetryPolicy",
+    "RollbackRequest",
     "SnapshotError",
     "SnapshotValidationError",
     "TrainingPreempted",
@@ -66,9 +76,11 @@ __all__ = [
     "failpoints",
     "gc_snapshots",
     "list_snapshots",
+    "load_remediation_log",
     "quarantine_snapshots",
     "read_manifest",
     "state_checksums",
+    "validate_remediation_log",
     "validate_snapshot",
     "validate_snapshot_wait",
     "verify_restored",

@@ -11,9 +11,13 @@ Arming, two ways:
   * programmatic (tests): ``arm(name, times=N)`` / ``disarm(name)``, or
     the ``armed(name, times=N)`` context manager;
   * environment (CLI smoke runs): ``NPAIRLOSS_FAILPOINTS`` holds a
-    comma-separated ``name[:count]`` list, e.g.
+    comma-separated ``name[:count[@delay]]`` list, e.g.
     ``NPAIRLOSS_FAILPOINTS="snapshot.save.io:2,data.worker"`` — parsed
-    once at first use.
+    once at first use.  ``@delay`` skips the site's first ``delay``
+    checks before the ``count`` fires begin
+    (``train.collapse:160@60`` = 60 healthy steps, then 160 collapsed
+    ones) — faults that must start MID-run, after snapshots/warmup
+    exist, are armed this way instead of with wall-clock sleeps.
 
 Failpoints wired into the framework (docs/RESILIENCE.md):
 
@@ -52,6 +56,24 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               remaining replicas absorb the load — the
                               front end's answered+errors+rejected
                               invariant must hold through the crash
+  ``serve.stale_model``       add ``STALE_AGE_FAULT_S`` to the model age
+                              the serving freshness probe publishes —
+                              the model-staleness alert fires without
+                              waiting real hours, driving the snapshot
+                              hot-swap remediation (docs/RESILIENCE.md
+                              §Remediation)
+  ``serve.compile_storm``     count one PHANTOM post-warmup compile in
+                              the query engine's compile accounting
+                              (no real XLA compile happens) — drives
+                              the post-warmup-compile watchdog and the
+                              re-warm remediation; under the strict
+                              compile guard it raises like a real one
+  ``train.collapse``          force ``an_threshold_mean`` to 1.0 in the
+                              emitted train row (telemetry/display see
+                              a collapsing embedding space, the actual
+                              state is untouched) — drives the
+                              embedding-collapse watchdog and the
+                              trainer-rollback remediation
   ==========================  =============================================
 
 ``times`` counts fires: an armed point fires its next ``times`` checks
@@ -77,6 +99,10 @@ ENV_VAR = "NPAIRLOSS_FAILPOINTS"
 # CPU top-k) yet short enough that a counted burst clears in seconds.
 SERVE_LATENCY_FAULT_S = 0.25
 SERVE_QUEUE_STALL_S = 0.25
+# Age bump the serve.stale_model failpoint injects into the published
+# model age (seconds) — far beyond any sane staleness target, so the
+# watchdog fires on the first poisoned probe tick.
+STALE_AGE_FAULT_S = 1e6
 
 
 class InjectedFault(OSError):
@@ -91,13 +117,15 @@ class InjectedFault(OSError):
 
 
 class _Failpoint:
-    __slots__ = ("name", "remaining", "exc_factory")
+    __slots__ = ("name", "remaining", "exc_factory", "delay")
 
     def __init__(self, name: str, remaining: Optional[int],
-                 exc_factory: Optional[Callable[[], BaseException]]):
+                 exc_factory: Optional[Callable[[], BaseException]],
+                 delay: int = 0):
         self.name = name
         self.remaining = remaining  # None = unlimited
         self.exc_factory = exc_factory
+        self.delay = int(delay)  # checks to pass through before firing
 
 
 _LOCK = threading.Lock()
@@ -116,22 +144,32 @@ def _load_env_locked() -> None:
         if not part:
             continue
         name, _, count = part.partition(":")
+        if not count and "@" in name:
+            # "name@delay" shorthand: default count, delayed start.
+            name, _, delay = name.partition("@")
+        else:
+            count, _, delay = count.partition("@")
         try:
             times = int(count) if count else 1
+            skip = int(delay) if delay else 0
         except ValueError:
             log.warning("%s: bad count in %r — ignored", ENV_VAR, part)
             continue
-        _ARMED[name] = _Failpoint(name, times, None)
-        log.info("failpoint armed from env: %s (times=%d)", name, times)
+        _ARMED[name] = _Failpoint(name, times, None, delay=skip)
+        log.info("failpoint armed from env: %s (times=%d, delay=%d)",
+                 name, times, skip)
 
 
 def arm(name: str, times: Optional[int] = 1,
-        exc: Optional[Callable[[], BaseException]] = None) -> None:
+        exc: Optional[Callable[[], BaseException]] = None,
+        delay: int = 0) -> None:
     """Arm ``name`` to fire its next ``times`` checks (None = forever).
-    ``exc`` overrides the raised exception for ``fire`` sites."""
+    ``exc`` overrides the raised exception for ``fire`` sites;
+    ``delay`` lets the first ``delay`` checks pass before the fires
+    begin (a mid-run fault)."""
     with _LOCK:
         _load_env_locked()
-        _ARMED[name] = _Failpoint(name, times, exc)
+        _ARMED[name] = _Failpoint(name, times, exc, delay=delay)
 
 
 def disarm(name: str) -> None:
@@ -152,6 +190,9 @@ def _take(name: str) -> Optional[_Failpoint]:
         _load_env_locked()
         fp = _ARMED.get(name)
         if fp is None:
+            return None
+        if fp.delay > 0:
+            fp.delay -= 1
             return None
         if fp.remaining is not None:
             if fp.remaining <= 0:  # armed with times=0: never fires

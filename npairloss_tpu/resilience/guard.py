@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from typing import Optional
 
 ACTIONS = ("rollback", "halt")
 
@@ -56,6 +57,33 @@ class DivergenceConfig:
             raise ValueError(
                 f"action must be one of {ACTIONS}, got {self.action!r}"
             )
+        if not (0.0 < self.lr_scale <= 1.0):
+            raise ValueError(
+                f"lr_scale must be in (0, 1], got {self.lr_scale}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class RollbackRequest:
+    """An externally REQUESTED rollback — the divergence guard's
+    recovery generalized to health-signal triggers (the alert→actuation
+    control plane, docs/RESILIENCE.md §Remediation).
+
+    The non-finite guard trips in-loop on its own streak; a health
+    alert (embedding collapse) trips OUT of loop, on the live-obs tick
+    thread, so the actuator sets a request the train loop executes at
+    its next safe point.  ``before_wall_time`` (the alert's
+    ``fired_at``) restricts the restore to snapshots COMMITTED before
+    the incident started — a snapshot captured mid-collapse is not a
+    recovery target; ``lr_scale`` optionally damps the relaunch the way
+    the divergence rollback does.
+    """
+
+    reason: str
+    before_wall_time: Optional[float] = None
+    lr_scale: float = 1.0
+
+    def __post_init__(self):
         if not (0.0 < self.lr_scale <= 1.0):
             raise ValueError(
                 f"lr_scale must be in (0, 1], got {self.lr_scale}"

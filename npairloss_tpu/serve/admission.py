@@ -76,6 +76,11 @@ class AdmissionController:
         self.cfg = cfg
         self.registry = registry
         self.shedding = False
+        # Remediation override (docs/RESILIENCE.md §Remediation): while
+        # ``forced`` is set by engage(), the gate sheds regardless of
+        # the listener-fed burn state — the audited load-shed action,
+        # released by the remediation engine when its alert resolves.
+        self.forced = False
         self.sheds = 0
         self.probes_admitted = 0
         self._since_probe = 0
@@ -98,8 +103,9 @@ class AdmissionController:
             self.shedding = shed
             if changed:
                 self._since_probe = 0
+            gauge = 1.0 if (shed or self.forced) else 0.0
         if self.registry is not None:
-            self.registry.set("serve_shedding", 1.0 if shed else 0.0)
+            self.registry.set("serve_shedding", gauge)
         if changed and shed:
             log.warning(
                 "admission control: SHEDDING load (burning SLOs: %s)",
@@ -107,13 +113,46 @@ class AdmissionController:
         elif changed:
             log.warning("admission control: burn cleared, admitting")
 
+    # -- the remediation override ------------------------------------------
+
+    def engage(self, _alert=None) -> dict:
+        """Force shedding on (idempotent) — the audited ``load_shed``
+        remediation action.  The probe trickle still applies, so
+        recovery stays observable exactly as under listener-driven
+        shedding."""
+        with self._lock:
+            changed = not self.forced
+            self.forced = True
+            if changed:
+                self._since_probe = 0
+        if self.registry is not None:
+            self.registry.set("serve_shedding", 1.0)
+        if changed:
+            log.warning("admission control: load shed ENGAGED "
+                        "(remediation)")
+        return {"engaged": True}
+
+    def release(self, _alert=None) -> None:
+        """Stand the forced shed down — the remediation engine's undo,
+        run when the triggering alert resolves.  Listener-driven burn
+        shedding (if wired) keeps its own verdict."""
+        with self._lock:
+            changed = self.forced
+            self.forced = False
+            still = self.shedding
+        if changed and not still and self.registry is not None:
+            self.registry.set("serve_shedding", 0.0)
+        if changed:
+            log.warning("admission control: forced shed released "
+                        "(remediation)")
+
     # -- the gate ----------------------------------------------------------
 
     def admit(self) -> bool:
         """True = admit this query; False = shed it (the caller rejects
         with backpressure and counts it in ``rejected``)."""
         with self._lock:
-            if not self.shedding:
+            if not (self.shedding or self.forced):
                 return True
             self._since_probe += 1
             if self.cfg.probe_every and \
@@ -131,10 +170,11 @@ class AdmissionController:
     def stats(self) -> dict:
         with self._lock:
             return {
-                "shedding": self.shedding,
+                "shedding": self.shedding or self.forced,
                 "shed": self.sheds,
                 "probes_admitted": self.probes_admitted,
                 "slos": list(self.cfg.slo_names),
+                **({"forced": True} if self.forced else {}),
             }
 
 

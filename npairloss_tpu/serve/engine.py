@@ -50,6 +50,7 @@ from jax.sharding import PartitionSpec as P
 
 from npairloss_tpu.ops.normalize import l2_normalize
 from npairloss_tpu.parallel._compat import shard_map
+from npairloss_tpu.resilience import failpoints
 from npairloss_tpu.serve.index import GalleryIndex, l2_normalize_rows
 from npairloss_tpu.serve.ivf import SCORINGS, IVFIndex
 
@@ -488,7 +489,15 @@ class QueryEngine:
         self._seen_sigs.add(sig)
         grew = (n_before is not None
                 and (self._cache_size() or 0) > n_before)
-        if not (fresh or grew):
+        # serve.compile_storm (docs/RESILIENCE.md): count a PHANTOM
+        # post-warmup compile — no real XLA work, but every consumer of
+        # the accounting (watchdog, strict guard, window rows) sees one,
+        # so the re-warm remediation is deterministically drivable.
+        # Short-circuit order matters: an unwarmed (re-warming) engine
+        # must not consume armed fires.
+        storm = self.warmed and failpoints.should_fire(
+            "serve.compile_storm")
+        if not (fresh or grew or storm):
             return
         self.compiles_total += 1
         if not self.warmed:
@@ -645,6 +654,28 @@ class QueryEngine:
         dt = _time.perf_counter() - t0
         log.info("serve warmup: %d bucket(s) compiled in %.2fs",
                  len(self.cfg.buckets), dt)
+        return dt
+
+    def rewarm(self, input_shape: Optional[Sequence[int]] = None) -> float:
+        """Re-prime every padding bucket and RESET the post-warmup
+        compile counter — the compile-storm remediation action
+        (docs/RESILIENCE.md §Remediation).  The re-warm dispatches run
+        with ``warmed`` cleared, so any compile they trigger counts as
+        warmup (never trips the strict guard), and
+        ``compiles_after_warmup`` restarts at zero so the post-warmup-
+        compile watchdog can observe recovery.  Returns wall seconds.
+
+        A re-warm that RAISES resets nothing: the engine keeps serving
+        (``warmed`` restored so accounting stays armed) and the storm
+        evidence in ``compiles_after_warmup`` survives — the alert that
+        triggered the failed remediation must keep its basis."""
+        self.warmed = False
+        try:
+            dt = self.warmup(input_shape)  # sets warmed=True on success
+        except BaseException:
+            self.warmed = True
+            raise
+        self.compiles_after_warmup = 0
         return dt
 
     def compile_stats(self) -> Dict[str, Any]:

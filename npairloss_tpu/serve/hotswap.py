@@ -1,0 +1,192 @@
+"""SnapshotSwapper — zero-downtime model/index hot-swap under live traffic.
+
+ROADMAP item 3's actuation half, and the flagship remediation action
+(docs/RESILIENCE.md §Remediation): the serving tier watches the
+training ``snapshot_prefix`` and/or the gallery ``index_prefix``; when
+a staleness alert fires (or :meth:`SnapshotSwapper.swap` is called
+directly), it
+
+  1. scans for a STRICTLY newer committed artifact — snapshots via
+     ``list_snapshots`` + ``validate_snapshot`` (torn/corrupt
+     candidates skipped with a logged reason, the resume scan's
+     contract), indexes via ``load_newest`` (same skip semantics; an
+     incrementally ``add()``-ed gallery arrives as a new atomic commit,
+     so the republish is a reference swap, never a half-updated slab);
+  2. builds a FRESH engine tier against the new artifacts and warms
+     every padding bucket OFF the serving path — the old tier keeps
+     answering while the new one compiles (the drain machinery
+     generalized to swap: traffic never stops, it just changes engines
+     between batches);
+  3. publishes atomically via :meth:`RetrievalServer.swap_engines` —
+     replicas flip to the new engine at their next batch, in-flight
+     batches finish where they started, and the per-answer
+     model_age_s/index_age_s visibly drop (the staleness watchdog
+     proving the swap is the ci.sh chaos scenario).
+
+Raises :class:`NothingNewerError` when no newer valid artifact exists —
+for the remediation engine that is an honest FAILED attempt (a stalled
+trainer is an incident the actuator cannot fix), not a silent no-op.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional, Sequence
+
+from npairloss_tpu.resilience.snapshot import (
+    list_snapshots,
+    validate_snapshot,
+)
+from npairloss_tpu.serve.engine import QueryEngine
+from npairloss_tpu.serve.index import load_newest
+from npairloss_tpu.serve.server import Freshness, RetrievalServer
+
+log = logging.getLogger("npairloss_tpu.serve")
+
+
+class NothingNewerError(RuntimeError):
+    """No committed snapshot/index newer than what is being served."""
+
+
+class SnapshotSwapper:
+    """Watch ``snapshot_prefix``/``index_prefix`` and hot-swap the
+    server's engine tier to the newest committed artifacts.
+
+    ``model``/``input_shape`` mirror the engine construction in
+    ``cmd_serve`` (None = embedding-only serving, no model to swap);
+    the CURRENT identities are always read from ``server.freshness`` at
+    swap time, so repeated swaps chain correctly.  ``index_transform``
+    is cmd_serve's ``--index-kind`` reconciliation applied to every
+    swapped-in index — without it a flat commit would silently demote
+    an IVF-serving tier back to the exact scan at the first swap.
+    ``swap(alert=None)`` is the remediation-action signature (the alert
+    info is logged, not consumed).
+    """
+
+    def __init__(
+        self,
+        server: RetrievalServer,
+        mesh=None,
+        index_prefix: Optional[str] = None,
+        snapshot_prefix: Optional[str] = None,
+        model=None,
+        input_shape: Optional[Sequence[int]] = None,
+        telemetry=None,
+        index_transform=None,
+    ):
+        if not index_prefix and not snapshot_prefix:
+            raise ValueError(
+                "SnapshotSwapper needs an index_prefix and/or a "
+                "snapshot_prefix to watch")
+        if snapshot_prefix and model is None:
+            raise ValueError(
+                "watching snapshot_prefix needs the model (the swap "
+                "restores new params INTO it); embedding-only serving "
+                "can only watch index_prefix")
+        self.server = server
+        self.mesh = mesh
+        self.index_prefix = index_prefix
+        self.snapshot_prefix = snapshot_prefix
+        self.model = model
+        self.input_shape = (tuple(input_shape)
+                            if input_shape is not None else None)
+        self.telemetry = telemetry
+        self.index_transform = index_transform
+
+    # -- discovery ---------------------------------------------------------
+
+    def _restore_newer(self, fresh: Freshness):
+        """(path, restored state) of the newest snapshot strictly newer
+        (by step) than the served one that validates AND restores, or
+        None.  A candidate whose manifest is fine but whose arrays are
+        torn is skipped in favor of the next older still-newer one —
+        the resume scan's skip contract, applied to serving (restore
+        must happen INSIDE the scan, or one corrupt newest snapshot
+        wedges every swap while a good newer-than-served one waits)."""
+        if not self.snapshot_prefix:
+            return None
+        from npairloss_tpu.train import restore_for_inference
+
+        current = fresh.snapshot_step
+        for step, path in reversed(list_snapshots(self.snapshot_prefix)):
+            if current is not None and step <= current:
+                return None  # newest-first: nothing newer remains
+            try:
+                validate_snapshot(path)
+                return path, restore_for_inference(path)
+            except Exception as e:  # noqa: BLE001 — skip, try the next
+                log.warning("hot-swap: skipping snapshot %s: %s", path, e)
+        return None
+
+    @staticmethod
+    def _index_is_newer(candidate: str, current: Optional[str]) -> bool:
+        # Index commits are named sortably (the build cadence's
+        # contract, serve/index.load_newest); a different name that
+        # sorts LATER is newer, anything else is not a swap target.
+        if current is None:
+            return True
+        return os.path.basename(candidate) > os.path.basename(current)
+
+    # -- the action --------------------------------------------------------
+
+    def swap(self, alert: Optional[Dict[str, Any]] = None
+             ) -> Dict[str, Any]:
+        """Build + warm a new tier off the serving path, then publish.
+        Returns the detail dict the remediation audit records; raises
+        :class:`NothingNewerError` when there is nothing to swap to."""
+        fresh = self.server.freshness or Freshness()
+        new_index = None
+        index_path = fresh.index_path
+        if self.index_prefix:
+            found = load_newest(self.index_prefix, mesh=self.mesh)
+            if found is not None and self._index_is_newer(
+                    found[0], fresh.index_path):
+                index_path, new_index = found
+                if self.index_transform is not None:
+                    # The --index-kind reconciliation the startup path
+                    # applied: the serving posture survives the swap.
+                    new_index = self.index_transform(new_index)
+        snapshot_path = fresh.snapshot_path
+        new_state = None
+        restored = self._restore_newer(fresh)
+        if restored is not None:
+            snapshot_path, new_state = restored
+        if new_index is None and new_state is None:
+            raise NothingNewerError(
+                "no committed snapshot/index newer than the served one"
+                + (f" (alert {alert.get('alert_id')})" if alert else ""))
+
+        old = self.server.engine
+        index = new_index if new_index is not None else old.index
+        state = new_state if new_state is not None else old.state
+        model = self.model if state is not None else None
+        primary = QueryEngine(
+            index, old.cfg, model=model, state=state,
+            telemetry=self.telemetry,
+        )
+        warmup_s = primary.warmup(
+            self.input_shape if model is not None else None)
+        engines = [primary] + [
+            QueryEngine(index, old.cfg, model=model, state=state,
+                        telemetry=self.telemetry,
+                        share_compiled_with=primary)
+            for _ in range(len(self.server.engines) - 1)
+        ]
+        for e in engines[1:]:
+            e.warmed = True
+        freshness = Freshness.collect(
+            index=index, index_path=index_path,
+            snapshot_path=snapshot_path if model is not None else None,
+        )
+        self.server.swap_engines(engines, freshness)
+        detail: Dict[str, Any] = {
+            "swapped": ([] + (["model"] if new_state is not None else [])
+                        + (["index"] if new_index is not None else [])),
+            "warmup_s": round(warmup_s, 3),
+            **freshness.identity(),
+        }
+        if self.telemetry is not None:
+            self.telemetry.instant("serve/hot_swap", **{
+                k: v for k, v in detail.items() if k != "swapped"})
+        return detail

@@ -141,6 +141,7 @@ class RetrievalServer:
         freshness: Optional[Freshness] = None,
         live=None,
         admission=None,
+        input_shape=None,
     ):
         from npairloss_tpu.serve.replicas import ReplicaSet
 
@@ -167,6 +168,20 @@ class RetrievalServer:
         # set, submits consult it BEFORE routing — a shed is a
         # fast-reject counted in the ``rejected`` invariant.
         self.admission = admission
+        # Raw-input shape for encode-path re-warms (None = embedding-
+        # only serving) and the optional RemediationEngine whose
+        # last-action-per-policy the summary/healthz surface
+        # (docs/RESILIENCE.md §Remediation).
+        self.input_shape = (tuple(input_shape)
+                            if input_shape is not None else None)
+        self.remediation = None
+        # Hot-swap state (serve/hotswap.py): count of engine-tier
+        # republishes, and whether a re-warm has made the window rows'
+        # compiles_after_warmup key EXPLICIT (present even at zero) so
+        # the post-warmup-compile watchdog can observe recovery — clean
+        # never-remediated runs keep the absent-when-zero contract.
+        self.swaps = 0
+        self._explicit_compile_key = False
         self.replicaset = ReplicaSet(
             engines, batcher_cfg, self._replica_dispatch,
             span_fn=self._span, on_batch=self._record_batch,
@@ -349,11 +364,15 @@ class RetrievalServer:
         if self.admission is not None and self.admission.sheds:
             row["shed"] = self.admission.sheds
         compiles = self._compiles_after_warmup()
-        if compiles:
+        if compiles or self._explicit_compile_key:
             # The strict guard's counting twin, in-row (the
             # spans_dropped contract: present only when > 0, so clean
             # streams stay byte-identical to pre-PR) — the live-obs
             # post-warmup-compile watchdog reads exactly this key.
+            # After a re-warm remediation the key turns EXPLICIT
+            # (present at zero): absent-when-zero would starve the
+            # watchdog of the good samples resolution requires
+            # (silence holds a burning SLO, by design).
             row["compiles_after_warmup"] = compiles
         if self.telemetry is not None and self.telemetry.metrics_enabled:
             try:
@@ -445,6 +464,50 @@ class RetrievalServer:
                 }
         return answers
 
+    # -- remediation actuators (docs/RESILIENCE.md §Remediation) -----------
+
+    def swap_engines(self, engines, freshness: Optional[Freshness] = None
+                     ) -> None:
+        """Atomically publish a fresh engine tier — the hot-swap commit
+        point (ROADMAP item 3's actuation half).  The caller must have
+        built AND WARMED the new primary off the serving path
+        (serve/hotswap.py does); here each replica's engine pointer
+        flips, so its NEXT batch dispatches on the new engine while any
+        in-flight batch finishes on the engine it started with — zero
+        dropped queries, zero serving-path compiles.  Freshness flips
+        with the tier, so per-answer model/index ages drop at the same
+        instant the answers start coming from the new snapshot."""
+        engines = list(engines)
+        if len(engines) != len(self.engines):
+            raise ValueError(
+                f"swap must preserve the replica count: got "
+                f"{len(engines)}, tier has {len(self.engines)}")
+        with self._lock:
+            self.engines = engines
+            self.engine = engines[0]
+            if freshness is not None:
+                self.freshness = freshness
+            self.swaps += 1
+        for rep, eng in zip(self.replicaset.replicas, engines):
+            rep.engine = eng
+        log.warning("hot-swap %d: serving tier republished (%s)",
+                    self.swaps,
+                    freshness.identity() if freshness else "same identity")
+
+    def rewarm(self) -> Dict[str, Any]:
+        """Re-warm every padding bucket and reset the tier's
+        post-warmup compile counters — the compile-storm remediation
+        action.  From here on the window rows carry an EXPLICIT
+        ``compiles_after_warmup`` (including 0) so the watchdog sees
+        recovery."""
+        dt = self.engine.rewarm(self.input_shape)
+        for e in self.engines[1:]:
+            # Replicas share the primary's programs + signature set;
+            # only their counters need the reset.
+            e.compiles_after_warmup = 0
+        self._explicit_compile_key = True
+        return {"warmup_s": round(dt, 3)}
+
     def _rejected_total(self) -> int:
         """Every rejection source, once each: batcher backpressure +
         whole-tier-down + admission sheds — the ``rejected`` term of
@@ -526,7 +589,8 @@ class RetrievalServer:
                 "replicas_alive": self.replicaset.alive_count}
                if len(self.engines) > 1 else {}),
             **({"shed": self.admission.sheds,
-                "shedding": self.admission.shedding}
+                "shedding": (self.admission.shedding
+                             or self.admission.forced)}
                if self.admission is not None else {}),
             # Freshness identity + ages (live-obs on or off): what this
             # run was answering from, and how stale it had become.
@@ -534,6 +598,13 @@ class RetrievalServer:
                if self.freshness is not None else {}),
             **(self.freshness.ages()
                if self.freshness is not None else {}),
+            # Hot-swap count (absent when the tier never swapped) and
+            # the last remediation per policy (key absent = policy
+            # never fired; block absent = no engine attached — the
+            # freshness-JSON contract, docs/RESILIENCE.md §Remediation).
+            **({"hot_swaps": self.swaps} if self.swaps else {}),
+            **({"remediation": self.remediation.last_by_policy()}
+               if self.remediation is not None else {}),
             **{k: round(v, 3) for k, v in self._percentiles().items()},
             # Whole-run latency split: where an answer's time went,
             # stage by stage (one read at drain, not per window; from

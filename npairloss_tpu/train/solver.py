@@ -20,6 +20,7 @@ import collections
 import contextlib
 import dataclasses
 import logging
+import threading
 import time
 import warnings
 from functools import partial
@@ -44,6 +45,7 @@ from npairloss_tpu.resilience.guard import (
     DivergenceConfig,
     DivergenceError,
     DivergenceGuard,
+    RollbackRequest,
 )
 from npairloss_tpu.resilience.preempt import PreemptionSignal, TrainingPreempted
 from npairloss_tpu.resilience.retrying import RetryPolicy, call_with_retry
@@ -297,6 +299,13 @@ class Solver:
         self._metric_window = None
         self.sync_monitor = None
         self._checkpointer = None
+        # Externally requested rollback (the alert→actuation control
+        # plane, docs/RESILIENCE.md §Remediation): any thread may set a
+        # RollbackRequest via ``request_rollback``; the train loop
+        # takes it at its next safe point (sync: per step; pipelined:
+        # the window boundary) and restores a pre-incident snapshot.
+        self._rollback_request: Optional[RollbackRequest] = None
+        self._rollback_lock = threading.Lock()
         # A fresh config per solver: SolverConfig is mutable, so a shared
         # default instance would leak cfg edits across solvers.
         self.cfg = cfg if cfg is not None else SolverConfig()
@@ -1127,6 +1136,13 @@ class Solver:
                         guard, step_num, log_fn, record_fn
                     )
                     continue
+                req = self._take_rollback_request()
+                if req is not None:
+                    rolled = self._handle_requested_rollback(
+                        req, step_num, log_fn, record_fn)
+                    if rolled is not None:
+                        it = rolled
+                        continue
                 self._emit_step_row(step_num, metrics, log_fn, record_fn)
                 self._boundary_actions(step_num, test_batches, log_fn,
                                        record_fn)
@@ -1174,6 +1190,13 @@ class Solver:
         keeping three copies in lockstep.  ``log_fn=None`` (flush path)
         skips display; a pending tail can never contain a display step
         anyway (boundary steps always flush in-loop)."""
+        if failpoints.should_fire("train.collapse"):
+            # Deterministic embedding-collapse signal
+            # (docs/RESILIENCE.md): the health key the collapse
+            # watchdog reads goes degenerate in THIS row only —
+            # telemetry/display see a collapsing space, the actual
+            # training state is untouched.
+            row = {**row, "an_threshold_mean": 1.0}
         cfg = self.cfg
         tel = self.telemetry
         if tel is not None and tel.metrics_enabled \
@@ -1466,6 +1489,19 @@ class Solver:
                             window_start = it + 1
                             poisoned = []
                             continue
+                        req = self._take_rollback_request()
+                        if req is not None:
+                            # Same safe point as the divergence trip:
+                            # drain in-flight dispatches, then restore.
+                            controller.drain()
+                            rolled = self._handle_requested_rollback(
+                                req, step_num, log_fn, record_fn)
+                            if rolled is not None:
+                                it = rolled
+                                ring = None  # cfg may have been replaced
+                                window_start = it + 1
+                                poisoned = []
+                                continue
                         self._boundary_actions(step_num, test_batches,
                                                log_fn, record_fn)
                     window_start = step_num + 1
@@ -1537,19 +1573,7 @@ class Solver:
         # left in place, a later crash + --resume auto would restore
         # them newest-first and dive straight back into divergence.
         quarantine_snapshots(self.cfg.snapshot_prefix, max_step)
-        if dcfg.lr_scale != 1.0:
-            # The cfg setter rebuilds schedule + optimizer and drops the
-            # jitted step, so the scaled lr takes effect at recompile.
-            self.cfg = dataclasses.replace(
-                self.cfg, base_lr=self.cfg.base_lr * dcfg.lr_scale
-            )
-        else:
-            # cfg unchanged: clear the NaN-poisoned loss window by hand.
-            self._loss_window.clear()
-        resumed = self.iteration
-        # Fleet span numbering follows the rollback: the next dispatch
-        # is step resumed+1 again.
-        self._step_seq = resumed
+        resumed = self._post_restore(dcfg.lr_scale)
         msg = (f"divergence: {reason}; rolled back to iteration {resumed} "
                f"({restored}), lr={self.cfg.base_lr:.6g} "
                f"[rollback {guard.rollbacks}/{dcfg.max_rollbacks}]")
@@ -1561,6 +1585,98 @@ class Solver:
         if record_fn is not None:
             record_fn({"event": "rollback", "iteration": step_num,
                        "to_iteration": resumed, "snapshot": restored})
+        return resumed
+
+    def _post_restore(self, lr_scale: float) -> int:
+        """Shared tail of BOTH rollback paths (divergence + requested):
+        apply the lr damp — the cfg setter rebuilds schedule + optimizer
+        and drops the jitted step, so the scaled lr takes effect at
+        recompile — or, cfg unchanged, clear the poisoned loss window by
+        hand; then re-anchor fleet span numbering at the restored
+        iteration (the next dispatch is resumed+1 again).  One copy, so
+        a future field that must reset after a restore cannot miss a
+        path."""
+        if lr_scale != 1.0:
+            self.cfg = dataclasses.replace(
+                self.cfg, base_lr=self.cfg.base_lr * lr_scale
+            )
+        else:
+            self._loss_window.clear()
+        resumed = self.iteration
+        self._step_seq = resumed
+        return resumed
+
+    # -- requested rollback (alert→actuation, docs/RESILIENCE.md) ----------
+
+    def request_rollback(self, request: RollbackRequest) -> None:
+        """Ask the train loop to roll back at its next safe point — the
+        remediation action for health-signal alerts (embedding
+        collapse).  Thread-safe: the live-obs tick thread sets it, the
+        loop takes it.  A second request before the first is taken
+        replaces it (the newer alert context wins)."""
+        with self._rollback_lock:
+            self._rollback_request = request
+
+    def _take_rollback_request(self) -> Optional[RollbackRequest]:
+        if self._rollback_request is None:  # cheap pre-check, hot path
+            return None
+        with self._rollback_lock:
+            req, self._rollback_request = self._rollback_request, None
+            return req
+
+    def _handle_requested_rollback(self, req: RollbackRequest,
+                                   step_num: int, log_fn,
+                                   record_fn) -> Optional[int]:
+        """Execute a requested rollback: restore the newest valid
+        snapshot COMMITTED before ``req.before_wall_time`` (a snapshot
+        captured mid-incident is not a recovery target).  Unlike the
+        divergence path this never quarantines (a health-signal
+        collapse leaves finite, checksum-honest params — post-mortem
+        wants them restorable) and SKIPS gracefully when no qualifying
+        snapshot exists: the remediation engine's budget owns retries,
+        so a skip is a telemetry event and training continues, never a
+        halt.  Returns the resumed iteration, or None on a skip."""
+        max_step = step_num - 1
+        if req.before_wall_time is not None:
+            qualifying = []
+            for step, path in list_snapshots(self.cfg.snapshot_prefix):
+                if step > max_step:
+                    continue
+                created = snapshot_info(path)["created"]
+                if created is not None and created < req.before_wall_time:
+                    qualifying.append(step)
+            if not qualifying:
+                msg = (f"rollback request ({req.reason}) skipped: no "
+                       f"snapshot under {self.cfg.snapshot_prefix!r} "
+                       f"predates the incident")
+                log.warning(msg)
+                log_fn(msg)
+                self._tel_event("rollback_skip", step_num,
+                                reason=req.reason)
+                return None
+            max_step = max(qualifying)
+        restored = self.restore_auto(max_step=max_step)
+        if restored is None:
+            msg = (f"rollback request ({req.reason}) skipped: no valid "
+                   f"snapshot at iteration <= {max_step}")
+            log.warning(msg)
+            log_fn(msg)
+            self._tel_event("rollback_skip", step_num, reason=req.reason)
+            return None
+        resumed = self._post_restore(req.lr_scale)
+        msg = (f"remediation rollback ({req.reason}): rolled back to "
+               f"iteration {resumed} ({restored}), "
+               f"lr={self.cfg.base_lr:.6g}")
+        log.warning(msg)
+        log_fn(msg)
+        self._tel_event("rollback", step_num, to_iteration=resumed,
+                        snapshot=restored,
+                        base_lr=float(self.cfg.base_lr),
+                        requested=True, reason=req.reason)
+        if record_fn is not None:
+            record_fn({"event": "rollback", "iteration": step_num,
+                       "to_iteration": resumed, "snapshot": restored,
+                       "requested": True})
         return resumed
 
     # -- checkpointing (Orbax; Caffe snapshot contract) --------------------

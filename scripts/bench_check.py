@@ -289,6 +289,83 @@ def check_alert_log(path: str) -> List[str]:
     return violations
 
 
+# -- remediation-log gate -----------------------------------------------------
+
+def _load_remediate():
+    """File-path-load ``resilience.remediate`` (self-contained, stdlib
+    only — the same contract as the alerts module) WITHOUT importing
+    the package."""
+    import importlib.util
+
+    name = "npairloss_tpu.resilience.remediate"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO, "npairloss_tpu", "resilience",
+                               "remediate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def check_remediation_log(path: str,
+                          alerts_path: Optional[str] = None) -> List[str]:
+    """Gate one ``npairloss-remediation-v1`` audit artifact: schema +
+    lifecycle valid per the one contract (validate_remediation_log),
+    every action justified by an alert that actually FIRED (cross-
+    checked against the paired alerts.jsonl — default: the one next to
+    the audit log; an audit with actions but NO alert log is refused,
+    because an unjustifiable action cannot be distinguished from a
+    justified one), and no CRITICAL incident abandoned mid-budget (a
+    failed attempt with attempts remaining and no retry is an actuator
+    walking away from a live incident).  Outcome-less attempts (killed
+    mid-action) are noted, not gated — the alert gate owns the
+    unresolved-incident verdict."""
+    rem = _load_remediate()
+    try:
+        records = rem.load_remediation_log(path)
+    except OSError as e:
+        return [f"remediation log {path} unreadable: {e}"]
+    if alerts_path is None:
+        alerts_path = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                   "alerts.jsonl")
+    alert_records = None
+    if os.path.exists(alerts_path):
+        alerts = _load_live_alerts()
+        try:
+            alert_records = alerts.load_alert_log(alerts_path)
+        except OSError as e:
+            return [f"alert log {alerts_path} unreadable: {e}"]
+    elif records:
+        return [f"remediation log holds {len(records)} record(s) but no "
+                f"alert log exists at {alerts_path} — actions cannot be "
+                "justified (action-without-alert refused)"]
+    err = rem.validate_remediation_log(records,
+                                       alert_records=alert_records)
+    if err is not None:
+        return [f"remediation log invalid: {err}"]
+    violations = []
+    # Incidents the alert log shows RESOLVED are never abandonment —
+    # an alert that healed after a failed attempt needed no retry.
+    resolved = {str(r.get("alert_id")) for r in (alert_records or ())
+                if isinstance(r, dict) and r.get("state") == "resolved"}
+    for rec_id, policy, aid in rem.abandoned_remediations(
+            records, resolved_alert_ids=resolved):
+        violations.append(
+            f"critical remediation {rec_id!r} (policy {policy!r}, alert "
+            f"{aid!r}) failed with attempts remaining and was never "
+            "retried — the actuator gave up on a live incident")
+    for rec_id, policy, aid in rem.unresolved_remediations(records):
+        _log(f"attempt {rec_id!r} (policy {policy!r}, alert {aid!r}) "
+             "has no outcome — noted, not gated")
+    if not violations:
+        attempted = sum(1 for r in records if r["state"] == "attempted")
+        ok = sum(1 for r in records if r["state"] == "succeeded")
+        _log(f"remediation log OK ({len(records)} event(s), {attempted} "
+             f"attempt(s), {ok} succeeded)")
+    return violations
+
+
 # -- the gate -----------------------------------------------------------------
 
 def _ivf_hard_gates(new_rows: Dict[str, Dict]) -> List[str]:
@@ -471,7 +548,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         "trajectory: schema-valid (npairloss-alerts-v1) and no "
         "unresolved critical alert — the ci.sh live-obs-smoke wiring",
     )
+    ap.add_argument(
+        "--remediation", metavar="PATH",
+        help="gate a remediation audit log instead of the bench "
+        "trajectory: schema-valid (npairloss-remediation-v1), every "
+        "action justified by a fired alert, no abandoned critical "
+        "remediation — the ci.sh chaos-suite wiring",
+    )
+    ap.add_argument(
+        "--alerts-log", dest="alerts_log", metavar="PATH",
+        help="with --remediation: the paired alerts.jsonl for the "
+        "action-without-alert cross-check (default: alerts.jsonl "
+        "next to the remediation log)",
+    )
     args = ap.parse_args(argv)
+
+    if args.remediation:
+        violations = check_remediation_log(args.remediation,
+                                           alerts_path=args.alerts_log)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (remediation log {args.remediation})")
+        return 0
 
     if args.alerts:
         violations = check_alert_log(args.alerts)

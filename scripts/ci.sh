@@ -739,6 +739,338 @@ EOF
 python scripts/bench_check.py --alerts "$ov_dir/tel/alerts.jsonl" \
     || { echo "overload smoke: gate refused the fire->resolve log"; exit 1; }
 
+echo "== alert->actuation chaos suite (docs/RESILIENCE.md §Remediation) =="
+# Four fault->alert->remedy->resolve loops, each driven by a failpoint,
+# proven end to end, and gated by BOTH jax-free validators:
+# `bench_check --alerts` on the alert log and `bench_check
+# --remediation` on the npairloss-remediation-v1 audit log.
+chaos_dir="$smoke_dir/chaos"
+mkdir -p "$chaos_dir"
+python - "$chaos_dir" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+rng = np.random.default_rng(0)
+emb = rng.standard_normal((256, 64)).astype(np.float32)
+emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+np.save(d + "/g.emb.npy", emb)
+np.save(d + "/g.labels.npy", (np.arange(256) % 16).astype(np.int32))
+with open(d + "/queries.jsonl", "w") as f:
+    for i in range(600):
+        f.write(json.dumps({"id": i, "embedding": emb[i % 256].tolist()}) + "\n")
+EOF
+JAX_PLATFORMS=cpu python -m npairloss_tpu index \
+    --emb "$chaos_dir/g.emb.npy" --labels "$chaos_dir/g.labels.npy" \
+    --no-normalize --out "$chaos_dir/g.gidx" > "$chaos_dir/index.log" 2>&1 \
+    || { echo "chaos: index build failed"; cat "$chaos_dir/index.log"; exit 1; }
+
+chaos_gates() {  # $1 = telemetry dir, $2 = scenario label
+    python scripts/bench_check.py --alerts "$1/alerts.jsonl" \
+        || { echo "chaos $2: alert gate refused"; exit 1; }
+    python scripts/bench_check.py --remediation "$1/remediation.jsonl" \
+        || { echo "chaos $2: remediation gate refused"; exit 1; }
+}
+
+echo "-- chaos A: compile storm -> re-warm --"
+# serve.compile_storm counts phantom post-warmup compiles; the
+# post-warmup-compile alert fires, the rewarm policy re-primes the
+# buckets and resets the counters, and the now-EXPLICIT zero rows
+# resolve the alert.
+python - "$chaos_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+json.dump({"slos": [{
+    "name": "serve_post_warmup_compile", "metric": "serve_compiles_after_warmup",
+    "op": "<=", "target": 0.0, "window_s": 3.0, "burn_threshold": 0.01,
+    "min_samples": 1, "severity": "warning"}]}, open(d + "/a_slo.json", "w"))
+json.dump({"policies": [{
+    "name": "rewarm", "slo": "serve_post_warmup_compile", "action": "rewarm",
+    "cooldown_s": 4.0, "max_attempts": 3}]}, open(d + "/a_rem.json", "w"))
+EOF
+mkfifo "$chaos_dir/a_in"
+JAX_PLATFORMS=cpu NPAIRLOSS_FAILPOINTS="serve.compile_storm:2" \
+    python -m npairloss_tpu serve --index "$chaos_dir/g.gidx" \
+    --top-k 3 --buckets 1 --deadline-ms 1 --metrics-window 4 \
+    --telemetry-dir "$chaos_dir/a_tel" --live-obs \
+    --slo-config "$chaos_dir/a_slo.json" --slo-tick 0.2 \
+    --remediate --remediation-config "$chaos_dir/a_rem.json" \
+    < "$chaos_dir/a_in" > "$chaos_dir/a_answers.jsonl" \
+    2> "$chaos_dir/a.log" &
+apid=$!
+exec 6> "$chaos_dir/a_in"
+head -30 "$chaos_dir/queries.jsonl" | while IFS= read -r ln; do
+    printf '%s\n' "$ln" >&6; sleep 0.05
+done
+sleep 2    # storm rows land, alert fires, rewarm runs
+sed -n '31,90p' "$chaos_dir/queries.jsonl" | while IFS= read -r ln; do
+    printf '%s\n' "$ln" >&6; sleep 0.05
+done
+sleep 2.5  # explicit-0 rows age the burn out -> resolve
+kill -TERM "$apid" 2>/dev/null || true
+exec 6>&-
+rc=0; wait "$apid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "chaos A: expected exit 75, got $rc"; cat "$chaos_dir/a.log"; exit 1; }
+python - "$chaos_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/a_answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", drain
+assert drain["errors"] == 0 and drain["answered"] == 90, drain
+assert drain["compiles_after_warmup"] == 0, drain  # re-warm reset them
+states = [json.loads(ln)["state"] for ln in open(d + "/a_tel/alerts.jsonl") if ln.strip()]
+assert "firing" in states and states[-1] == "resolved", states
+rem = [json.loads(ln) for ln in open(d + "/a_tel/remediation.jsonl") if ln.strip()]
+assert any(r["policy"] == "rewarm" and r["state"] == "succeeded" for r in rem), rem
+assert drain["remediation"]["rewarm"]["outcome"] == "succeeded", drain
+print(f"chaos A OK (storm counted, rewarm succeeded, alert resolved; "
+      f"{len(rem)} audit event(s))")
+EOF
+chaos_gates "$chaos_dir/a_tel" A
+
+echo "-- chaos B: queue saturation -> audited load-shed --"
+# serve.latency wedges the dispatcher; the queue-saturation alert fires
+# and the load_shed policy ENGAGES the admission throttle (an audited
+# action, not an implicit behavior); the probe trickle keeps recovery
+# observable, the alert resolves once the queue drains, and the
+# engine's undo releases admission.
+python - "$chaos_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+json.dump({"slos": [{
+    "name": "serve_queue_saturation", "metric": "serve_queue_depth",
+    "op": "<=", "target": 6.0, "window_s": 2.0, "burn_threshold": 0.5,
+    "min_samples": 1, "severity": "warning"}]}, open(d + "/b_slo.json", "w"))
+json.dump({"policies": [{
+    "name": "load_shed", "slo": "serve_queue_saturation", "action": "load_shed",
+    "cooldown_s": 8.0, "max_attempts": 4}]}, open(d + "/b_rem.json", "w"))
+EOF
+mkfifo "$chaos_dir/b_in"
+JAX_PLATFORMS=cpu NPAIRLOSS_FAILPOINTS="serve.latency:30" \
+    python -m npairloss_tpu serve --index "$chaos_dir/g.gidx" \
+    --top-k 3 --buckets 1 --deadline-ms 1 --max-queue 24 \
+    --metrics-window 4 --telemetry-dir "$chaos_dir/b_tel" --live-obs \
+    --slo-config "$chaos_dir/b_slo.json" --slo-tick 0.2 \
+    --remediate --remediation-config "$chaos_dir/b_rem.json" \
+    < "$chaos_dir/b_in" > "$chaos_dir/b_answers.jsonl" \
+    2> "$chaos_dir/b.log" &
+bpid=$!
+exec 7> "$chaos_dir/b_in"
+# flood: ~100 qps against ~4 qps of faulted capacity -> queue saturates
+head -150 "$chaos_dir/queries.jsonl" | while IFS= read -r ln; do
+    printf '%s\n' "$ln" >&7; sleep 0.01
+done
+sleep 6    # fault budget exhausts, queue drains under shed
+# recovery traffic: the probe trickle's answers emit the good
+# queue-depth rows resolution requires
+sed -n '151,250p' "$chaos_dir/queries.jsonl" | while IFS= read -r ln; do
+    printf '%s\n' "$ln" >&7; sleep 0.04
+done
+sleep 2
+kill -TERM "$bpid" 2>/dev/null || true
+exec 7>&-
+rc=0; wait "$bpid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "chaos B: expected exit 75, got $rc"; cat "$chaos_dir/b.log"; exit 1; }
+python - "$chaos_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/b_answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", drain
+assert drain["shed"] > 0, f"load_shed never engaged: {drain}"
+assert drain["rejected"] >= drain["shed"], drain
+assert drain["queries"] == drain["answered"] + drain["errors"] + drain["rejected"], drain
+assert drain["shedding"] is False, "forced shed never released"
+states = [json.loads(ln)["state"] for ln in open(d + "/b_tel/alerts.jsonl") if ln.strip()]
+assert "firing" in states and states[-1] == "resolved", states
+rem = [json.loads(ln) for ln in open(d + "/b_tel/remediation.jsonl") if ln.strip()]
+assert any(r["policy"] == "load_shed" and r["state"] == "succeeded" for r in rem), rem
+print(f"chaos B OK (shed {drain['shed']}, answered {drain['answered']}, "
+      f"alert resolved, shed released)")
+EOF
+chaos_gates "$chaos_dir/b_tel" B
+
+echo "-- chaos C: embedding collapse -> trainer rollback --"
+# train.collapse (delay-armed: 60 healthy steps first, so pre-incident
+# snapshots exist) forces the health signal degenerate; the
+# embedding-collapse alert fires, the trainer_rollback policy requests
+# a rollback the loop executes at its next safe point (restoring a
+# snapshot COMMITTED BEFORE the alert fired), and once the injected
+# collapse exhausts, the real health rows resolve the alert.
+cat > "$chaos_dir/c_solver.prototxt" <<EOF
+net: "examples/tiny_net.prototxt"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+max_iter: 800
+display: 0
+test_interval: 0
+test_iter: 0
+snapshot: 5
+snapshot_prefix: "$chaos_dir/c_snap/m_"
+EOF
+python - "$chaos_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+json.dump({"slos": [{
+    "name": "embedding_collapse", "metric": "train_an_threshold_mean",
+    "op": "<=", "target": 0.98, "window_s": 2.0, "burn_threshold": 0.5,
+    "min_samples": 3, "severity": "warning"}]}, open(d + "/c_slo.json", "w"))
+json.dump({"policies": [{
+    "name": "trainer_rollback", "slo": "embedding_collapse",
+    "action": "trainer_rollback", "cooldown_s": 6.0, "max_attempts": 5}]},
+    open(d + "/c_rem.json", "w"))
+EOF
+JAX_PLATFORMS=cpu NPAIRLOSS_FAILPOINTS="train.collapse:160@60" \
+    python -m npairloss_tpu train --solver "$chaos_dir/c_solver.prototxt" \
+    --model mlp --synthetic --health-metrics \
+    --telemetry-dir "$chaos_dir/c_tel" --live-obs \
+    --slo-config "$chaos_dir/c_slo.json" --slo-tick 0.2 \
+    --remediate --remediation-config "$chaos_dir/c_rem.json" \
+    > "$chaos_dir/c.log" 2>&1 \
+    || { echo "chaos C: train run failed"; cat "$chaos_dir/c.log"; exit 1; }
+python - "$chaos_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+rows = [json.loads(ln) for ln in open(d + "/c_tel/metrics.jsonl") if ln.strip()]
+rollbacks = [r for r in rows if r.get("event") == "rollback" and r.get("requested")]
+assert rollbacks, "no requested rollback executed"
+assert all(r["to_iteration"] < r["step"] for r in rollbacks), rollbacks
+states = [json.loads(ln)["state"] for ln in open(d + "/c_tel/alerts.jsonl") if ln.strip()]
+assert "firing" in states, "collapse alert never fired"
+assert states[-1] == "resolved", f"collapse alert never resolved: {states}"
+rem = [json.loads(ln) for ln in open(d + "/c_tel/remediation.jsonl") if ln.strip()]
+assert any(r["policy"] == "trainer_rollback" and r["state"] == "succeeded"
+           for r in rem), rem
+print(f"chaos C OK ({len(rollbacks)} rollback(s) to iteration "
+      f"{rollbacks[0]['to_iteration']}, alert resolved, "
+      f"{len(rem)} audit event(s))")
+EOF
+chaos_gates "$chaos_dir/c_tel" C
+
+echo "-- chaos D (headline): model staleness -> zero-downtime hot-swap --"
+# The train->serve freshness loop's actuation half, end to end: a
+# trainer snapshots continuously (and is killed + resumed MID-STREAM);
+# the server watches its snapshot_prefix, the model-staleness alert
+# fires as the served snapshot ages past target, the hot-swap
+# remediation republishes a freshly-warmed engine tier WITHOUT dropping
+# a single in-flight query, and the per-answer model_age_s visibly
+# drops at each swap — the staleness watchdog proving the swap.
+hs="$chaos_dir/hs"
+mkdir -p "$hs"
+cat > "$hs/solver.prototxt" <<EOF
+net: "examples/tiny_net.prototxt"
+base_lr: 0.05
+lr_policy: "fixed"
+momentum: 0.9
+max_iter: 100000
+display: 0
+test_interval: 0
+test_iter: 0
+snapshot: 40
+snapshot_prefix: "$hs/snap/m_"
+snapshot_max_keep: 10
+EOF
+python - "$hs" <<'EOF'
+import json, sys
+d = sys.argv[1]
+json.dump({"slos": [{
+    "name": "model_staleness", "metric": "serve_model_age_s", "op": "<=",
+    "target": 5.0, "window_s": 2.0, "burn_threshold": 0.5,
+    "min_samples": 1, "severity": "warning"}]}, open(d + "/slo.json", "w"))
+json.dump({"policies": [{
+    "name": "hotswap_model", "slo": "model_staleness",
+    "action": "snapshot_hotswap", "cooldown_s": 4.0, "max_attempts": 4}]},
+    open(d + "/rem.json", "w"))
+EOF
+# Phase 0: one short run commits the INITIAL snapshot the server restores.
+JAX_PLATFORMS=cpu python -m npairloss_tpu train --solver "$hs/solver.prototxt" \
+    --model mlp --synthetic --max_iter 40 > "$hs/seed.log" 2>&1 \
+    || { echo "chaos D: seed training failed"; cat "$hs/seed.log"; exit 1; }
+[[ -f "$hs/snap/m_iter_40.ckpt/manifest.json" ]] \
+    || { echo "chaos D: seed snapshot missing"; exit 1; }
+# The trainer, snapshotting continuously (the supervisor loop: kill ->
+# relaunch same command, the docs/RESILIENCE.md recipe).
+JAX_PLATFORMS=cpu python -m npairloss_tpu train --solver "$hs/solver.prototxt" \
+    --model mlp --synthetic --resume auto > "$hs/train1.log" 2>&1 &
+tr_pid=$!
+mkfifo "$hs/in"
+JAX_PLATFORMS=cpu python -m npairloss_tpu serve --index "$chaos_dir/g.gidx" \
+    --snapshot "$hs/snap/m_iter_40.ckpt" --model mlp --input-size 8 \
+    --watch-snapshots "$hs/snap/m_" --compile-cache "$hs/xla_cache" \
+    --top-k 3 --buckets 1 --deadline-ms 1 --metrics-window 4 \
+    --telemetry-dir "$hs/tel" --live-obs --slo-config "$hs/slo.json" \
+    --slo-tick 0.2 --remediate --remediation-config "$hs/rem.json" \
+    < "$hs/in" > "$hs/answers.jsonl" 2> "$hs/serve.log" &
+sv_pid=$!
+exec 8> "$hs/in"
+( head -500 "$chaos_dir/queries.jsonl" | while IFS= read -r ln; do
+    printf '%s\n' "$ln" >&8; sleep 0.05; done ) &
+feeder=$!
+sleep 10
+# Kill the trainer MID-STREAM; the server must keep answering.
+kill -TERM "$tr_pid" 2>/dev/null || true
+rc=0; wait "$tr_pid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "chaos D: trainer kill expected 75, got $rc"; cat "$hs/train1.log"; exit 1; }
+# ...and resume it (same command line — the auto-resume contract).
+JAX_PLATFORMS=cpu python -m npairloss_tpu train --solver "$hs/solver.prototxt" \
+    --model mlp --synthetic --resume auto > "$hs/train2.log" 2>&1 &
+tr_pid=$!
+wait "$feeder" || true
+for _ in $(seq 1 240); do  # every fed query must be answered
+    n=$(grep -c '"neighbors"' "$hs/answers.jsonl" 2>/dev/null || true)
+    [[ "${n:-0}" -ge 500 ]] && break
+    kill -0 "$sv_pid" 2>/dev/null \
+        || { echo "chaos D: server died mid-serve"; tail -30 "$hs/serve.log"; exit 1; }
+    sleep 0.5
+done
+sleep 2  # let the last swap's resolution land before the drain
+kill -TERM "$sv_pid" 2>/dev/null || true
+exec 8>&-
+rc=0; wait "$sv_pid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "chaos D: serve expected exit 75, got $rc"; tail -30 "$hs/serve.log"; exit 1; }
+kill -TERM "$tr_pid" 2>/dev/null || true
+wait "$tr_pid" || true
+grep -q "resuming from iteration" "$hs/train2.log" \
+    || { echo "chaos D: relaunched trainer did not resume"; cat "$hs/train2.log"; exit 1; }
+python - "$hs" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", drain
+served = [a for a in lines[:-1] if "neighbors" in a]
+# zero downtime: EVERY fed query answered, none dropped or errored,
+# through two trainer generations and every swap
+assert len(served) == 500 and drain["errors"] == 0, (len(served), drain)
+assert drain["queries"] == drain["answered"] + drain["errors"] + drain["rejected"], drain
+assert drain["hot_swaps"] >= 2, f"expected >=2 hot swaps, got {drain.get('hot_swaps')}"
+# the served model ADVANCED: the drain's snapshot_step is a later
+# training iteration than the seed snapshot the server started from
+assert drain["snapshot_step"] > 40, drain["snapshot_step"]
+# per-answer model_age_s drops at each swap (the staleness watchdog's
+# proof): count strict drops of > 2s between consecutive answers
+ages = [a["model_age_s"] for a in served if "model_age_s" in a]
+assert len(ages) == 500, len(ages)
+drops = sum(1 for i in range(1, len(ages)) if ages[i] < ages[i - 1] - 2.0)
+assert drops >= 2, f"model age dropped {drops}x, expected >= 2 swaps visible"
+states = [json.loads(ln)["state"] for ln in open(d + "/tel/alerts.jsonl") if ln.strip()]
+assert states.count("firing") >= 2, states
+assert "resolved" in states, states
+rem = [json.loads(ln) for ln in open(d + "/tel/remediation.jsonl") if ln.strip()]
+swaps_ok = [r for r in rem if r["policy"] == "hotswap_model"
+            and r["state"] == "succeeded"]
+assert len(swaps_ok) >= 1, rem
+print(f"chaos D OK ({drain['hot_swaps']} hot swap(s), {drops} visible "
+      f"age drops, served snapshot_step {drain['snapshot_step']}, "
+      f"500/500 answered, {states.count('firing')} staleness incident(s))")
+EOF
+chaos_gates "$hs/tel" D
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

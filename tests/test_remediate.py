@@ -1,0 +1,962 @@
+"""Alert→actuation tests (resilience/remediate.py + its serve/train
+wiring, docs/RESILIENCE.md §Remediation): policy matching and budgets
+(cooldown / max-attempts / per-incident reset), dry-run, the
+npairloss-remediation-v1 audit validator's teeth, the jax-free
+bench_check --remediation gate, hot-swap under concurrent queries
+(zero drops, zero post-swap compiles), re-warm resetting the compile
+counters, the train.collapse / serve.compile_storm failpoints, the
+solver's requested-rollback path, watch's audit reconciliation, and the
+forced admission shed."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from npairloss_tpu.resilience import failpoints
+from npairloss_tpu.resilience.guard import RollbackRequest
+from npairloss_tpu.resilience.remediate import (
+    EVENT_KEYS,
+    REMEDIATION_SCHEMA,
+    REMEDIATION_SEVERITIES,
+    RemediationEngine,
+    RemediationPolicy,
+    abandoned_remediations,
+    default_policies,
+    load_policies,
+    load_remediation_log,
+    unresolved_remediations,
+    validate_remediation_log,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_CHECK = os.path.join(REPO, "scripts", "bench_check.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def _alert(aid, severity="critical", fired_at=0.0):
+    return {"alert_id": aid, "severity": severity, "fired_at": fired_at,
+            "bad_fraction": 1.0}
+
+
+def _engine(policies, actions, tmp_path=None, **kw):
+    log_path = (str(tmp_path / "remediation.jsonl")
+                if tmp_path is not None else None)
+    return RemediationEngine(policies, actions, log_path=log_path,
+                             clock=lambda: 0.0, **kw)
+
+
+POL = RemediationPolicy(name="p", slo="s", action="a", cooldown_s=5.0,
+                        max_attempts=2)
+
+
+# -- policy table -------------------------------------------------------------
+
+
+def test_policy_validation_louds():
+    with pytest.raises(ValueError, match="cooldown_s"):
+        RemediationPolicy(name="p", slo="s", action="a", cooldown_s=-1)
+    with pytest.raises(ValueError, match="max_attempts"):
+        RemediationPolicy(name="p", slo="s", action="a", max_attempts=0)
+    for field in ("name", "slo", "action"):
+        with pytest.raises(ValueError, match=field):
+            RemediationPolicy(**{"name": "p", "slo": "s", "action": "a",
+                                 field: ""})
+
+
+def test_load_policies_roundtrip_and_louds(tmp_path):
+    path = str(tmp_path / "rem.json")
+    with open(path, "w") as f:
+        json.dump({"policies": [
+            {"name": "x", "slo": "serve_p99", "action": "rewarm",
+             "cooldown_s": 1, "max_attempts": 4},
+        ]}, f)
+    (pol,) = load_policies(path)
+    assert (pol.name, pol.slo, pol.action) == ("x", "serve_p99", "rewarm")
+    assert pol.cooldown_s == 1 and pol.max_attempts == 4
+
+    for bad in (
+        {"policies": []},
+        {"policies": [{"name": "x"}]},                      # missing keys
+        {"policies": [{"name": "x", "slo": "s", "action": "a",
+                       "typo": 1}]},                        # unknown key
+        {"nope": []},                                       # unknown top
+        {"policies": [{"name": "x", "slo": "s", "action": "a"},
+                      {"name": "x", "slo": "t", "action": "b"}]},
+    ):
+        with open(path, "w") as f:
+            json.dump(bad, f)
+        with pytest.raises(ValueError):
+            load_policies(path)
+
+
+def test_default_policies():
+    serve = default_policies("serve")
+    assert [p.name for p in serve] == [
+        "hotswap_model", "hotswap_index", "load_shed", "rewarm"]
+    assert {p.slo for p in serve} == {
+        "model_staleness", "index_staleness", "serve_queue_saturation",
+        "serve_post_warmup_compile"}
+    (train,) = default_policies("train")
+    assert (train.slo, train.action) == (
+        "embedding_collapse", "trainer_rollback")
+    with pytest.raises(ValueError, match="unknown policy kind"):
+        default_policies("fleet")
+
+
+def test_severities_twin_pin():
+    from npairloss_tpu.obs.live.alerts import ALERT_SEVERITIES
+
+    assert REMEDIATION_SEVERITIES == ALERT_SEVERITIES
+
+
+# -- engine lifecycle ---------------------------------------------------------
+
+
+def test_engine_success_lifecycle_with_undo(tmp_path):
+    calls, undone = [], []
+    eng = _engine([POL], {"a": (lambda a: calls.append(a) or {"k": 1},
+                                lambda a: undone.append(a))}, tmp_path)
+    ev = eng.tick({"s": _alert("s-1")}, now=10.0)
+    assert [e["state"] for e in ev] == ["attempted"]
+    assert calls and calls[0]["slo"] == "s"
+    ev = eng.tick({}, now=11.0)  # alert resolved = the success signal
+    assert [e["state"] for e in ev] == ["succeeded"]
+    assert ev[0]["detail"] == {"k": 1}
+    assert ev[0]["duration_s"] == 1.0
+    assert len(undone) == 1
+    eng.close()
+    records = load_remediation_log(str(tmp_path / "remediation.jsonl"))
+    assert validate_remediation_log(records) is None
+    assert set(records[0]) >= set(EVENT_KEYS)
+    assert records[0]["schema"] == REMEDIATION_SCHEMA
+
+
+def test_engine_retry_budget_and_fresh_incident(tmp_path):
+    calls = []
+    eng = _engine([POL], {"a": lambda a: calls.append(a)}, tmp_path)
+    a1 = _alert("s-1")
+    assert [e["state"] for e in eng.tick({"s": a1}, 10.0)] == ["attempted"]
+    # inside cooldown, still firing: wait for the action to take effect
+    assert eng.tick({"s": a1}, 12.0) == []
+    # cooldown elapsed, still firing: attempt 1 failed, attempt 2 opens
+    ev = eng.tick({"s": a1}, 16.0)
+    assert [e["state"] for e in ev] == ["failed", "attempted"]
+    assert "still firing" in ev[0]["error"]
+    assert ev[1]["attempt"] == 2
+    # budget exhausted: the final attempt fails, nothing new opens
+    ev = eng.tick({"s": a1}, 22.0)
+    assert [e["state"] for e in ev] == ["failed"]
+    assert eng.tick({"s": a1}, 40.0) == []
+    # a NEW incident (new alert id) gets a fresh budget
+    ev = eng.tick({"s": _alert("s-2")}, 50.0)
+    assert [e["state"] for e in ev] == ["attempted"]
+    assert ev[0]["attempt"] == 1
+    assert len(calls) == 3
+
+
+def test_engine_cooldown_rate_limits_across_incidents():
+    eng = _engine([POL], {"a": lambda a: None})
+    assert len(eng.tick({"s": _alert("s-1")}, 10.0)) == 1
+    eng.tick({}, 11.0)  # resolve (succeeded)
+    # new incident, but the policy cooled down only 3 of 5 seconds
+    assert eng.tick({"s": _alert("s-2")}, 13.0) == []
+    assert [e["state"] for e in eng.tick({"s": _alert("s-2")}, 16.0)] \
+        == ["attempted"]
+
+
+def test_engine_action_raise_is_immediate_failure(tmp_path):
+    def boom(alert):
+        raise RuntimeError("no newer snapshot")
+
+    eng = _engine([POL], {"a": boom}, tmp_path)
+    ev = eng.tick({"s": _alert("s-1")}, 10.0)
+    assert [e["state"] for e in ev] == ["attempted", "failed"]
+    assert "no newer snapshot" in ev[1]["error"]
+    eng.close()
+    records = load_remediation_log(str(tmp_path / "remediation.jsonl"))
+    assert validate_remediation_log(records) is None
+
+
+def test_engine_dry_run_logs_but_never_acts(tmp_path):
+    calls = []
+    eng = _engine([POL], {"a": lambda a: calls.append(a)}, tmp_path,
+                  dry_run=True)
+    ev = eng.tick({"s": _alert("s-1")}, 10.0)
+    assert [e["state"] for e in ev] == ["attempted"]
+    assert ev[0]["dry_run"] is True
+    assert calls == []
+    # budgets still count: a rehearsal exercises the rate limits
+    assert eng.tick({"s": _alert("s-1")}, 16.0)[0]["attempt"] == 2
+    assert eng.tick({"s": _alert("s-1")}, 22.0) == []  # budget spent
+    # dry attempts never conclude, even on resolution
+    assert eng.tick({}, 30.0) == []
+    eng.close()
+    records = load_remediation_log(str(tmp_path / "remediation.jsonl"))
+    assert validate_remediation_log(records) is None
+    assert all(r["state"] == "attempted" and r["dry_run"]
+               for r in records)
+
+
+def test_undo_survives_failed_and_exhausted_attempts():
+    """An undo (load-shed release) must run when the incident resolves
+    even when its attempt long since FAILED — an actuator that can
+    engage but not disengage is worse than none."""
+    engaged, released = [], []
+    pol = RemediationPolicy(name="p", slo="s", action="a",
+                            cooldown_s=2.0, max_attempts=1)
+    eng = _engine([pol], {"a": (lambda a: engaged.append(a),
+                                lambda a: released.append(a))})
+    a1 = _alert("s-1")
+    assert [e["state"] for e in eng.tick({"s": a1}, 10.0)] == ["attempted"]
+    # budget is 1: the cooldown-elapsed tick fails the attempt...
+    assert [e["state"] for e in eng.tick({"s": a1}, 13.0)] == ["failed"]
+    assert eng.tick({"s": a1}, 16.0) == []
+    assert released == []  # still burning: stay engaged
+    # ...but resolution still releases the engaged actuator
+    assert eng.tick({}, 20.0) == []
+    assert len(engaged) == 1 and len(released) == 1
+
+
+def test_engine_config_louds():
+    with pytest.raises(ValueError, match="unregistered actions"):
+        RemediationEngine([POL], {})
+    with pytest.raises(ValueError, match="duplicate policy names"):
+        RemediationEngine([POL, POL], {"a": lambda a: None})
+
+
+def test_engine_resumes_id_sequence(tmp_path):
+    eng = _engine([POL], {"a": lambda a: None}, tmp_path)
+    eng.tick({"s": _alert("s-1")}, 10.0)
+    eng.tick({}, 11.0)
+    eng.close()
+    eng2 = _engine([POL], {"a": lambda a: None}, tmp_path)
+    ev = eng2.tick({"s": _alert("s-9")}, 100.0)
+    assert ev[0]["id"] == "p-2"  # continues past the old segment's ids
+    eng2.close()
+    records = load_remediation_log(str(tmp_path / "remediation.jsonl"))
+    assert validate_remediation_log(records) is None
+
+
+def test_last_by_policy_shape():
+    eng = _engine([POL], {"a": lambda a: None})
+    assert eng.last_by_policy() == {}  # never fired = absent key
+    eng.tick({"s": _alert("s-1")}, 10.0)
+    last = eng.last_by_policy()
+    assert last == {"p": {"action": "a", "outcome": "attempted",
+                          "alert_id": "s-1", "wall_time": 10.0}}
+    eng.tick({}, 11.0)
+    assert eng.last_by_policy()["p"]["outcome"] == "succeeded"
+
+
+# -- the audit contract (validator teeth) -------------------------------------
+
+
+def _valid_pair(aid="s-1", dry=False):
+    base = {
+        "schema": REMEDIATION_SCHEMA, "policy": "p", "action": "a",
+        "alert_id": aid, "slo": "s", "severity": "critical",
+        "attempt": 1, "max_attempts": 2, "dry_run": dry, "message": "m",
+    }
+    att = {**base, "id": "p-1", "state": "attempted", "ts": 10.0}
+    ok = {**base, "id": "p-1", "state": "succeeded", "ts": 11.0,
+          "dry_run": False, "duration_s": 1.0}
+    return att, ok
+
+
+def test_validator_accepts_and_rejects():
+    att, ok = _valid_pair()
+    assert validate_remediation_log([att, ok]) is None
+    assert validate_remediation_log([]) is None
+
+    def bad(mutate, records=None):
+        recs = [dict(r) for r in (records or [att, ok])]
+        mutate(recs)
+        err = validate_remediation_log(recs)
+        assert err is not None, recs
+        return err
+
+    assert "schema" in bad(lambda r: r[0].update(schema="v0"))
+    assert "missing" in bad(lambda r: r[0].pop("attempt"))
+    assert "state" in bad(lambda r: r[0].update(state="skipped"))
+    assert "severity" in bad(lambda r: r[0].update(severity="fatal"))
+    assert "not numeric" in bad(lambda r: r[0].update(ts="now"))
+    assert "not an integer" in bad(lambda r: r[0].update(attempt=1.5))
+    assert "outside" in bad(lambda r: r[0].update(attempt=3))
+    assert "without an attempted" in bad(lambda r: r.pop(0))
+    assert "duplicate attempted" in bad(lambda r: r.__setitem__(1, r[0]))
+    assert "second outcome" in bad(lambda r: r.append(dict(r[1])))
+    assert "precedes" in bad(lambda r: r[1].update(ts=9.0))
+    assert "duration_s" in bad(lambda r: r[1].pop("duration_s"))
+    # a failed outcome must carry its error
+    failed = dict(ok, state="failed")
+    assert "error" in validate_remediation_log([att, failed])
+    # a dry-run attempt can never have an outcome
+    datt = dict(att, dry_run=True)
+    assert "DRY-RUN" in validate_remediation_log([datt, ok])
+    # torn mid-log line is a violation (only the tail is tolerated)
+    assert "unparseable" in validate_remediation_log(
+        [{"_bad_line": 3}, att])
+
+
+def test_validator_alert_crosscheck():
+    att, ok = _valid_pair()
+    fired = [{"state": "firing", "alert_id": "s-1", "ts": 5.0}]
+    assert validate_remediation_log([att, ok], alert_records=fired) is None
+    err = validate_remediation_log([att, ok], alert_records=[])
+    assert "never fired" in err
+    late = [{"state": "firing", "alert_id": "s-1", "ts": 50.0}]
+    err = validate_remediation_log([att, ok], alert_records=late)
+    assert "precedes the firing" in err
+
+
+def test_unresolved_and_abandoned_helpers():
+    att, ok = _valid_pair()
+    assert unresolved_remediations([att]) == [("p-1", "p", "s-1")]
+    assert unresolved_remediations([att, ok]) == []
+    # failed mid-budget with no retry, critical: abandoned
+    failed = dict(ok, state="failed", error="x")
+    assert abandoned_remediations([att, failed]) == [("p-1", "p", "s-1")]
+    # a later attempt for the same incident clears the verdict
+    att2 = dict(att, id="p-2", attempt=2)
+    assert abandoned_remediations([att, failed, att2]) == []
+    # budget exhausted is not abandonment
+    spent = dict(failed, attempt=2)
+    assert abandoned_remediations([att, spent]) == []
+    # warnings are never abandoned (the gate is critical-only)
+    warn = [dict(att, severity="warning"),
+            dict(failed, severity="warning")]
+    assert abandoned_remediations(warn) == []
+    # an incident that RESOLVED anyway needed no retry — not abandoned
+    assert abandoned_remediations([att, failed],
+                                  resolved_alert_ids=["s-1"]) == []
+
+
+def test_torn_tail_tolerated(tmp_path):
+    att, ok = _valid_pair()
+    path = str(tmp_path / "remediation.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(att) + "\n" + json.dumps(ok) + "\n")
+        f.write('{"schema": "npairloss-rem')  # killed mid-write
+    records = load_remediation_log(path)
+    assert len(records) == 2
+    assert validate_remediation_log(records) is None
+
+
+# -- the jax-free bench_check gate --------------------------------------------
+
+
+def _gate(path, *extra):
+    return subprocess.run(
+        [sys.executable, BENCH_CHECK, "--remediation", path, *extra],
+        capture_output=True, text=True)
+
+
+def _write_logs(tmp_path, rem_records, alert_records):
+    os.makedirs(str(tmp_path), exist_ok=True)
+    rp = str(tmp_path / "remediation.jsonl")
+    with open(rp, "w") as f:
+        for r in rem_records:
+            f.write(json.dumps(r) + "\n")
+    if alert_records is not None:
+        with open(str(tmp_path / "alerts.jsonl"), "w") as f:
+            for r in alert_records:
+                f.write(json.dumps(r) + "\n")
+    return rp
+
+
+def test_bench_check_remediation_gate(tmp_path):
+    att, ok = _valid_pair()
+    fired = [{"state": "firing", "alert_id": "s-1", "ts": 5.0}]
+    rp = _write_logs(tmp_path / "good", rem_records=[att, ok],
+                     alert_records=fired)
+    out = _gate(rp)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    # schema violation refused
+    bad = dict(att)
+    bad["schema"] = "npairloss-remediation-v0"
+    rp = _write_logs(tmp_path / "schema", [bad, ok], fired)
+    out = _gate(rp)
+    assert out.returncode == 1 and "invalid" in out.stdout
+
+    # action-without-alert refused (cross-check against the paired log)
+    rp = _write_logs(tmp_path / "ghost", [att, ok],
+                     [{"state": "firing", "alert_id": "other", "ts": 1.0}])
+    out = _gate(rp)
+    assert out.returncode == 1 and "never fired" in out.stdout
+
+    # actions with NO alert log at all: unjustifiable, refused
+    rp = _write_logs(tmp_path / "nolog", [att, ok], None)
+    out = _gate(rp)
+    assert out.returncode == 1 and "no alert log" in out.stdout
+
+    # abandoned critical remediation (failed mid-budget, never retried)
+    failed = dict(ok, state="failed", error="gave up")
+    rp = _write_logs(tmp_path / "aband", [att, failed], fired)
+    out = _gate(rp)
+    assert out.returncode == 1 and "attempts remaining" in out.stdout
+
+    # ...but the same shape with the alert RESOLVED in the paired log
+    # is a healed incident, not abandonment — accepted
+    healed = fired + [{"state": "resolved", "alert_id": "s-1", "ts": 30.0}]
+    rp = _write_logs(tmp_path / "healed", [att, failed], healed)
+    out = _gate(rp)
+    assert out.returncode == 0, out.stdout
+
+    # an empty audit log next to an empty alert log is a clean run
+    rp = _write_logs(tmp_path / "empty", [], [])
+    out = _gate(rp)
+    assert out.returncode == 0, out.stdout
+
+
+# -- live-observatory attachment ----------------------------------------------
+
+
+def test_live_observatory_drives_remediation(tmp_path):
+    from npairloss_tpu.obs.live import LiveObservatory, SLOSpec
+    from npairloss_tpu.obs.live.alerts import (
+        load_alert_log,
+        validate_alert_log,
+    )
+
+    spec = SLOSpec(name="s", metric="m", op="<=", target=1.0,
+                   window_s=10.0, burn_threshold=0.5, min_samples=1,
+                   severity="critical")
+    live = LiveObservatory([spec], out_dir=str(tmp_path),
+                           clock=lambda: 0.0)
+    acted = []
+    eng = RemediationEngine(
+        [RemediationPolicy(name="fix", slo="s", action="f",
+                           cooldown_s=5.0, max_attempts=3)],
+        {"f": lambda a: acted.append(a)},
+        log_path=str(tmp_path / "remediation.jsonl"), clock=lambda: 0.0)
+    live.set_remediation(eng)
+    live.registry.set("m", 9.0, t=10.0)
+    live.tick(now=10.0)
+    assert len(acted) == 1 and acted[0]["alert_id"] == "s-1"
+    # resolution requires GOOD samples (silence holds a burning SLO);
+    # by t=21 the bad sample aged out of the window and the good one
+    # clears it -> resolve -> the attempt succeeds
+    live.registry.set("m", 0.5, t=15.0)
+    live.tick(now=21.0)
+    live.stop(final_tick=False)
+    arecs = load_alert_log(str(tmp_path / "alerts.jsonl"))
+    rrecs = load_remediation_log(str(tmp_path / "remediation.jsonl"))
+    assert validate_alert_log(arecs) is None
+    assert validate_remediation_log(rrecs, alert_records=arecs) is None
+    assert [r["state"] for r in rrecs] == ["attempted", "succeeded"]
+
+
+# -- watch reconciliation ------------------------------------------------------
+
+
+def test_watch_reconciles_audit_against_replay(tmp_path):
+    from npairloss_tpu.obs.live import SLOSpec, watch_run_dir
+
+    run = tmp_path / "run"
+    run.mkdir()
+    rows = []
+    # incident 1: fires at t=0..2, resolves by t=20 (acted on)
+    # incident 2: fires at t=35..37, resolves by t=55 (NOT acted on)
+    for t, v in [(0, 500.0), (1, 500.0), (2, 500.0), (20, 10.0),
+                 (21, 10.0), (35, 500.0), (36, 500.0), (37, 500.0),
+                 (55, 10.0), (56, 10.0)]:
+        rows.append({"phase": "serve", "step": t, "wall_time": float(t),
+                     "p99_ms": v})
+    with open(run / "metrics.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    att, _ = _valid_pair(aid="p99-1")
+    att = dict(att, slo="p99")
+    ghost, _ = _valid_pair(aid="p99-77")
+    ghost = dict(ghost, id="p-9", slo="p99")
+    with open(run / "remediation.jsonl", "w") as f:
+        f.write(json.dumps(att) + "\n")
+        f.write(json.dumps(ghost) + "\n")
+    spec = SLOSpec(name="p99", metric="serve_p99_ms", op="<=",
+                   target=150.0, window_s=10.0, burn_threshold=0.5,
+                   min_samples=1, severity="critical")
+    summary = watch_run_dir(str(run), [spec])
+    rec = summary["remediation"]
+    assert rec["valid"] is True
+    assert rec["matched"] == ["p99-1"]
+    assert rec["alert_resolved_no_action"] == ["p99-2"]
+    assert rec["action_no_resolution"] == ["p99-77"]
+    # a DRY-RUN attempt is a rehearsal, never an action: its resolved
+    # incident reads as alert_resolved_no_action, not matched
+    # (fresh watch log: the engine resumes an existing one, so a
+    # leftover alerts.watch.jsonl would continue the id sequence)
+    os.remove(run / "alerts.watch.jsonl")
+    dry = dict(att, id="p-2", dry_run=True)
+    with open(run / "remediation.jsonl", "w") as f:
+        f.write(json.dumps(dry) + "\n")
+    rec = watch_run_dir(str(run), [spec])["remediation"]
+    assert rec["matched"] == []
+    assert sorted(rec["alert_resolved_no_action"]) == ["p99-1", "p99-2"]
+
+    # no audit log, no block (the absent-key contract)
+    os.remove(run / "remediation.jsonl")
+    assert "remediation" not in watch_run_dir(str(run), [spec])
+
+
+# -- delayed failpoint arming -------------------------------------------------
+
+
+def test_failpoint_delayed_arming(monkeypatch):
+    failpoints.arm("x", times=2, delay=3)
+    assert [failpoints.should_fire("x") for _ in range(6)] == \
+        [False, False, False, True, True, False]
+    failpoints.reset()
+    monkeypatch.setenv(failpoints.ENV_VAR, "y:2@1,z,w@2")
+    assert [failpoints.should_fire("y") for _ in range(4)] == \
+        [False, True, True, False]
+    assert failpoints.should_fire("z") is True
+    # "name@delay" shorthand: default count of 1, delayed start
+    assert [failpoints.should_fire("w") for _ in range(4)] == \
+        [False, False, True, False]
+
+
+# -- admission forced shed -----------------------------------------------------
+
+
+def test_admission_engage_release_forced_shed():
+    from npairloss_tpu.serve.admission import (
+        AdmissionConfig,
+        AdmissionController,
+    )
+
+    ctl = AdmissionController(AdmissionConfig(probe_every=3))
+    assert ctl.admit() is True
+    ctl.engage()
+    assert ctl.stats()["shedding"] is True and ctl.stats()["forced"]
+    decisions = [ctl.admit() for _ in range(6)]
+    assert decisions == [False, False, True, False, False, True]
+    assert ctl.sheds == 4 and ctl.probes_admitted == 2
+    ctl.release(None)
+    assert ctl.admit() is True
+    assert ctl.stats()["shedding"] is False
+    assert "forced" not in ctl.stats()
+
+
+# -- serve-side actuators (tiny jax work) -------------------------------------
+
+
+class _FakeTel:
+    """Just enough of RunTelemetry for window-row capture."""
+
+    metrics_enabled = True
+    tracer = None
+
+    def __init__(self):
+        self.rows = []
+
+    def span(self, name, **args):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def instant(self, name, **args):
+        pass
+
+    def log(self, phase, step, row):
+        self.rows.append(dict(row))
+
+    def flush(self):
+        pass
+
+
+def _tiny_server(metrics_window=0, telemetry=None):
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        Freshness,
+        GalleryIndex,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+
+    rng = np.random.default_rng(0)
+    emb = rng.standard_normal((32, 8)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    index = GalleryIndex.build(emb, (np.arange(32) % 4).astype(np.int32),
+                               normalize=False)
+    engine = QueryEngine(index, EngineConfig(top_k=3, buckets=(1, 4)))
+    engine.warmup()
+    server = RetrievalServer(
+        engine, BatcherConfig(max_batch=4, max_delay_ms=1.0),
+        ServerConfig(metrics_window=metrics_window), telemetry=telemetry,
+        freshness=Freshness.collect(index=index, index_path="/tmp/f.gidx"),
+    )
+    server.replicaset.start()
+    return emb, server
+
+
+def test_hot_swap_under_concurrent_queries(tmp_path):
+    from npairloss_tpu.serve import GalleryIndex
+    from npairloss_tpu.serve.hotswap import (
+        NothingNewerError,
+        SnapshotSwapper,
+    )
+    from npairloss_tpu.serve.index import load_index
+
+    rng = np.random.default_rng(0)
+    emb = rng.standard_normal((48, 8)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    lab = (np.arange(48) % 6).astype(np.int32)
+    prefix = str(tmp_path / "g.")
+    p1 = GalleryIndex.build(emb, lab, normalize=False).save(prefix
+                                                            + "000.gidx")
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        Freshness,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+
+    engine = QueryEngine(load_index(p1), EngineConfig(top_k=3,
+                                                      buckets=(1, 4)))
+    engine.warmup()
+    server = RetrievalServer(
+        engine, BatcherConfig(max_batch=4, max_delay_ms=1.0),
+        ServerConfig(metrics_window=0),
+        freshness=Freshness.collect(index=engine.index, index_path=p1),
+    )
+    server.replicaset.start()
+    stop = threading.Event()
+    errors, answered = [], [0]
+
+    def client(k):
+        i = k
+        while not stop.is_set():
+            a = server.handle({"id": i, "embedding": emb[i % 48].tolist()})
+            (errors.append(a) if "error" in a
+             else answered.__setitem__(0, answered[0] + 1))
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.2)
+        # a newer commit with add()-grown rows (new padded size = the
+        # re-warm matters: the swap compiles the NEW shapes off-path)
+        idx2 = load_index(p1)
+        idx2.add(rng.standard_normal((7, 8)).astype(np.float32),
+                 (np.arange(7) % 6).astype(np.int32))
+        p2 = idx2.save(prefix + "001.gidx")
+        swapper = SnapshotSwapper(server, index_prefix=prefix)
+        detail = swapper.swap()
+        assert detail["swapped"] == ["index"]
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        server.replicaset.close(drain=True)
+    s = server.summary()
+    assert not errors, errors[:3]
+    assert answered[0] > 0
+    # the invariant holds through the swap; nothing dropped or double-
+    # counted, and steady state after the re-warm never compiled
+    assert s["queries"] == s["answered"] + s["errors"] + s["rejected"]
+    assert s["hot_swaps"] == 1
+    assert s["compiles_after_warmup"] == 0
+    assert server.freshness.index_path == p2
+    assert server.engine.index.size == 55
+    # healthz shape: the remediation block is absent without an engine
+    assert "remediation" not in server.healthz()
+    with pytest.raises(NothingNewerError):
+        swapper.swap()
+
+
+def test_swapper_skips_torn_newer_snapshot(tmp_path):
+    """A newer snapshot whose manifest validates but whose arrays fail
+    the restore-time checksum is skipped in favor of the next older
+    still-newer one — the resume scan's contract, applied to swap."""
+    import types
+
+    from npairloss_tpu.resilience import read_manifest
+    from npairloss_tpu.serve.hotswap import SnapshotSwapper
+    from npairloss_tpu.serve.server import Freshness
+
+    solver, batches = _make_solver(tmp_path)
+    for k in (1, 2):
+        x, lab = next(batches)
+        solver.step(x, lab)
+        solver.save_snapshot(k)
+    newest = solver.snapshot_path(2)
+    manifest = read_manifest(newest)
+    # Corrupt a PARAMS leaf specifically: restore_for_inference only
+    # checksum-verifies the params/batch_stats subset, so a damaged
+    # optimizer leaf would restore fine and prove nothing.
+    key = next(k for k in manifest["arrays"]
+               if k.startswith("['params']"))
+    manifest["arrays"][key]["crc32"] ^= 1
+    with open(os.path.join(newest, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    sw = SnapshotSwapper(
+        server=types.SimpleNamespace(freshness=None),
+        snapshot_prefix=str(tmp_path / "snap" / "m_"), model=object())
+    restored = sw._restore_newer(Freshness(snapshot_step=0))
+    assert restored is not None
+    path, state = restored
+    assert path == solver.snapshot_path(1)
+    assert "params" in state
+    # nothing newer than the valid step-1 snapshot -> None
+    assert sw._restore_newer(Freshness(snapshot_step=1)) is None
+
+
+def test_swap_applies_index_transform(tmp_path):
+    """The --index-kind reconciliation survives the swap: a flat commit
+    republished into an IVF-serving tier arrives clustered."""
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        Freshness,
+        GalleryIndex,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+    from npairloss_tpu.serve.hotswap import SnapshotSwapper
+    from npairloss_tpu.serve.index import load_index
+    from npairloss_tpu.serve.ivf import IVFIndex
+
+    rng = np.random.default_rng(0)
+    emb = rng.standard_normal((64, 8)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    lab = (np.arange(64) % 8).astype(np.int32)
+    prefix = str(tmp_path / "g.")
+    p1 = GalleryIndex.build(emb, lab, normalize=False).save(
+        prefix + "000.gidx")
+    ivf1 = IVFIndex.from_gallery(load_index(p1), clusters=4)
+    engine = QueryEngine(ivf1, EngineConfig(top_k=3, buckets=(1,),
+                                            probes=4))
+    engine.warmup()
+    server = RetrievalServer(
+        engine, BatcherConfig(max_batch=1, max_delay_ms=1.0),
+        ServerConfig(metrics_window=0),
+        freshness=Freshness.collect(index=ivf1, index_path=p1),
+    )
+    server.replicaset.start()
+    try:
+        idx2 = load_index(p1)
+        idx2.add(rng.standard_normal((4, 8)).astype(np.float32),
+                 (np.arange(4) % 8).astype(np.int32))
+        idx2.save(prefix + "001.gidx")
+        swapper = SnapshotSwapper(
+            server, index_prefix=prefix,
+            index_transform=lambda i: IVFIndex.from_gallery(i, clusters=4))
+        swapper.swap()
+        assert isinstance(server.engine.index, IVFIndex)
+        assert server.engine.index.size == 68
+        a = server.handle({"id": 0, "embedding": emb[0].tolist()})
+        assert a["neighbors"][0]["row"] == 0
+    finally:
+        server.replicaset.close(drain=True)
+
+
+def test_swapper_validation_louds():
+    _, server = _tiny_server()
+    from npairloss_tpu.serve.hotswap import SnapshotSwapper
+
+    try:
+        with pytest.raises(ValueError, match="needs an index_prefix"):
+            SnapshotSwapper(server)
+        with pytest.raises(ValueError, match="needs the model"):
+            SnapshotSwapper(server, snapshot_prefix="/tmp/x_")
+    finally:
+        server.replicaset.close(drain=True)
+
+
+def test_compile_storm_and_rewarm_reset(tmp_path):
+    tel = _FakeTel()
+    emb, server = _tiny_server(metrics_window=2, telemetry=tel)
+    try:
+        failpoints.arm("serve.compile_storm", times=2)
+        for i in range(4):
+            server.handle({"id": i, "embedding": emb[i].tolist()})
+        # two phantom post-warmup compiles counted, no real XLA work
+        assert server.engine.compiles_after_warmup == 2
+        storm_rows = [r for r in tel.rows
+                      if r.get("compiles_after_warmup")]
+        assert storm_rows and storm_rows[-1]["compiles_after_warmup"] == 2
+        out = server.rewarm()
+        assert out["warmup_s"] >= 0.0
+        assert server.engine.compiles_after_warmup == 0
+        assert server.engine.warmed
+        for i in range(4):
+            server.handle({"id": i, "embedding": emb[i].tolist()})
+        # post-rewarm rows carry the key EXPLICITLY at 0, so the
+        # watchdog can observe recovery (clean runs keep absent-at-0)
+        assert tel.rows[-1]["compiles_after_warmup"] == 0
+    finally:
+        server.replicaset.close(drain=True)
+
+
+def test_rewarm_failure_keeps_storm_evidence():
+    """A re-warm that raises must reset NOTHING: the alert that
+    triggered the failed remediation keeps its counter basis."""
+    emb, server = _tiny_server()
+    try:
+        engine = server.engine
+        failpoints.arm("serve.compile_storm", times=1)
+        server.handle({"id": 0, "embedding": emb[0].tolist()})
+        assert engine.compiles_after_warmup == 1
+
+        def boom(input_shape=None):
+            raise RuntimeError("device fell over")
+
+        engine.warmup = boom
+        with pytest.raises(RuntimeError, match="fell over"):
+            server.rewarm()
+        assert engine.warmed is True  # still the serving engine
+        assert engine.compiles_after_warmup == 1  # evidence survives
+        assert server._explicit_compile_key is False
+    finally:
+        server.replicaset.close(drain=True)
+
+
+def test_serve_cli_remediate_arg_validation_fast_fails(tmp_path):
+    """Misconfigured remediation flags exit 2 with a diagnostic BEFORE
+    any index/model work (no traceback, milliseconds)."""
+    bad_cfg = str(tmp_path / "bad.json")
+    with open(bad_cfg, "w") as f:
+        json.dump({"policies": [{"name": "x", "typo": 1}]}, f)
+    for extra in (["--remediate"],  # no --live-obs
+                  ["--live-obs", "--telemetry-dir", "/tmp/x",
+                   "--remediate", "--watch-snapshots", "/tmp/p_"],
+                  ["--live-obs", "--telemetry-dir", "/tmp/x",
+                   "--remediate", "--remediation-config", bad_cfg]):
+        out = subprocess.run(
+            [sys.executable, "-m", "npairloss_tpu", "serve",
+             "--index", "/nonexistent.gidx", *extra],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 2, (extra, out.stderr)
+        assert "Traceback" not in out.stderr, out.stderr
+
+
+def test_remediation_block_in_summary_and_healthz():
+    emb, server = _tiny_server()
+    try:
+        eng = _engine([POL], {"a": lambda a: None})
+        server.remediation = eng
+        assert server.summary()["remediation"] == {}
+        eng.tick({"s": _alert("s-1")}, 10.0)
+        block = server.healthz()["remediation"]
+        assert block["p"]["outcome"] == "attempted"
+        assert block["p"]["action"] == "a"
+        assert isinstance(block["p"]["wall_time"], float)
+    finally:
+        server.replicaset.close(drain=True)
+
+
+# -- train-side actuators -----------------------------------------------------
+
+
+def _make_solver(tmp_path, snapshot=0, display=0, **kw):
+    from npairloss_tpu import NPairLossConfig
+    from npairloss_tpu.data import synthetic_identity_batches
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.resilience import RetryPolicy
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    cfg = SolverConfig(
+        base_lr=0.5, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
+        display=display, test_interval=0, average_loss=10,
+        snapshot=snapshot, snapshot_prefix=str(tmp_path / "snap" / "m_"),
+        **kw,
+    )
+    solver = Solver(
+        get_model("mlp", hidden=(32,), embedding_dim=16),
+        NPairLossConfig(), cfg, input_shape=(16,),
+        snapshot_retry=RetryPolicy(base_delay=0.001, jitter=0.0),
+    )
+    return solver, synthetic_identity_batches(8, 8, 2, (16,), noise=0.5)
+
+
+def test_train_collapse_failpoint_poisons_row(tmp_path):
+    solver, batches = _make_solver(tmp_path, display=1)
+    events = []
+    failpoints.arm("train.collapse", times=2)
+    solver.train(batches, num_iters=4, record_fn=events.append)
+    displays = [e for e in events if e["event"] == "display"]
+    assert [e.get("an_threshold_mean") for e in displays] == \
+        [1.0, 1.0, None, None]
+
+
+def test_requested_rollback_executes_and_skips(tmp_path):
+    solver, batches = _make_solver(tmp_path, snapshot=2)
+    events = []
+    fired = {"done": False}
+
+    def record(ev):
+        events.append(ev)
+        if ev["event"] == "snapshot" and ev["iteration"] == 4 \
+                and not fired["done"]:
+            # request from inside the run (stands in for the live-obs
+            # tick thread): roll back to a snapshot predating "now"
+            fired["done"] = True
+            solver.request_rollback(RollbackRequest(
+                reason="collapse alert", before_wall_time=time.time()))
+
+    solver.train(batches, num_iters=6, record_fn=record)
+    rb = [e for e in events if e["event"] == "rollback"]
+    assert len(rb) == 1 and rb[0]["requested"] is True
+    assert rb[0]["iteration"] == 5  # taken at the next step
+    assert rb[0]["to_iteration"] in (2, 4)
+    assert solver.iteration == 6  # re-ran to the target after rollback
+
+    # a request predating every snapshot SKIPS (training continues; the
+    # remediation budget owns retries)
+    solver2, batches2 = _make_solver(tmp_path / "two", snapshot=2)
+    events2 = []
+    armed = {"done": False}
+
+    def record2(ev):
+        events2.append(ev)
+        if ev["event"] == "snapshot" and not armed["done"]:
+            armed["done"] = True
+            solver2.request_rollback(RollbackRequest(
+                reason="too early", before_wall_time=1.0))
+
+    solver2.train(batches2, num_iters=4, record_fn=record2)
+    assert not [e for e in events2 if e["event"] == "rollback"]
+    assert solver2.iteration == 4
+
+
+def test_requested_rollback_pipelined_window_boundary(tmp_path):
+    solver, batches = _make_solver(tmp_path, snapshot=2, display=4)
+    solver.cfg = __import__("dataclasses").replace(
+        solver.cfg, pipeline=True, pipeline_window=4)
+    events = []
+    fired = {"done": False}
+
+    def record(ev):
+        events.append(ev)
+        if ev["event"] == "snapshot" and ev["iteration"] >= 2 \
+                and not fired["done"]:
+            fired["done"] = True
+            solver.request_rollback(RollbackRequest(
+                reason="collapse alert", before_wall_time=time.time()))
+
+    solver.train(batches, num_iters=8, record_fn=record)
+    rb = [e for e in events if e["event"] == "rollback"]
+    assert len(rb) == 1 and rb[0]["requested"] is True
+    assert solver.iteration == 8
