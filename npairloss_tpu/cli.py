@@ -442,10 +442,14 @@ def cmd_train(args) -> int:
         from npairloss_tpu.utils.debug import enable_debug_checks
 
         enable_debug_checks(True)
-    if getattr(args, "health_metrics", False):
+    if getattr(args, "health_metrics", False) or \
+            getattr(args, "mining_health", False):
         from npairloss_tpu.obs import HealthConfig
 
-        solver.health = HealthConfig()
+        # --mining-health implies the health rows it extends: the
+        # AP/AN margin + saturation stats ride the same loss aux.
+        solver.health = HealthConfig(
+            mining_health=bool(getattr(args, "mining_health", False)))
     if getattr(args, "perf_metrics", False):
         # Continuous phase="perf" rows (ms_per_step / emb_per_sec /
         # MFU) at display cadence — docs/OBSERVABILITY.md §Perf.
@@ -1103,6 +1107,17 @@ def cmd_index(args) -> int:
             clusters=args.clusters, iters=args.kmeans_iters,
             train_size=args.train_sample,
         )
+        if args.parity_sample:
+            # The recall birth certificate (docs/OBSERVABILITY.md
+            # §Quality observatory): offline topk_recall parity per
+            # scoring mode, stamped into the commit manifest so the
+            # live shadow-recall gauge has a committed baseline.
+            from npairloss_tpu.serve.ivf import measure_parity
+
+            idx.parity = measure_parity(
+                idx, probes=args.parity_probes,
+                sample=args.parity_sample)
+            log.info("ivf parity stamped: %s", idx.parity["recall"])
     else:
         from npairloss_tpu.serve.index import GalleryIndex
 
@@ -1120,6 +1135,8 @@ def cmd_index(args) -> int:
     if isinstance(idx, IVFIndex):
         summary["clusters"] = idx.n_clusters
         summary["cap"] = idx.layout.cap
+        if idx.parity is not None:
+            summary["parity"] = idx.parity
     print(json.dumps(summary))
     return 0
 
@@ -1174,6 +1191,15 @@ def cmd_serve(args) -> int:
             log.error("--remediation-config %s: %s",
                       args.remediation_config, e)
             return 2
+    shadow_rate = float(getattr(args, "shadow_rate", 0.0) or 0.0)
+    if not (0.0 <= shadow_rate <= 1.0):
+        log.error("--shadow-rate must be in [0, 1], got %g", shadow_rate)
+        return 2
+    if shadow_rate > 0 and not getattr(args, "telemetry_dir", None):
+        log.error("--shadow-rate needs --telemetry-dir (the recall "
+                  "gauges ride the telemetry rows, and quality.jsonl "
+                  "lands there — docs/OBSERVABILITY.md §Quality)")
+        return 2
 
     if args.compile_cache:
         from npairloss_tpu.pipeline import enable_compile_cache
@@ -1295,6 +1321,7 @@ def cmd_serve(args) -> int:
                 "remediate": bool(getattr(args, "remediate", False)
                                   or getattr(args, "remediate_dry_run",
                                              False)),
+                "shadow_rate": shadow_rate,
             })
 
     if args.admission != "off" and live is None:
@@ -1306,6 +1333,7 @@ def cmd_serve(args) -> int:
         return 2
 
     preempt = PreemptionSignal().install()
+    shadow = None
     try:
         engine_cfg = EngineConfig(
             top_k=args.top_k, buckets=buckets,
@@ -1351,6 +1379,66 @@ def cmd_serve(args) -> int:
             freshness=freshness, live=live, admission=admission,
             input_shape=input_shape,
         )
+        if shadow_rate > 0:
+            # Quality observatory (docs/OBSERVABILITY.md §Quality):
+            # shadow-score a deterministic sample of live queries
+            # against the flat oracle, off the hot path.  The floor the
+            # quality log declares is whatever recall SLO this run
+            # armed; the baseline is the served IVF commit's parity
+            # birth certificate (absent for flat/in-memory indexes).
+            from npairloss_tpu.obs.quality.shadow import (
+                ShadowConfig,
+                ShadowScorer,
+            )
+
+            baseline = None
+            try:
+                from npairloss_tpu.resilience.snapshot import (
+                    read_manifest,
+                )
+
+                raw = read_manifest(index_path).get("parity")
+                baseline = raw if isinstance(raw, dict) else None
+            except Exception:  # noqa: BLE001 — baseline is optional evidence
+                baseline = None
+            shadow_ks = tuple(k for k in (1, 5, 10) if k <= args.top_k)
+            floor = floor_metric = None
+            if live is not None:
+                for spec in specs:
+                    if not (spec.metric.startswith("serve_recall_at_")
+                            and spec.op == ">="):
+                        continue
+                    tail = spec.metric.rsplit("_", 1)[-1]
+                    if tail.isdigit() and int(tail) in shadow_ks:
+                        floor, floor_metric = spec.target, spec.metric
+                        break
+                    # A floor on a K the shadow can never sample
+                    # (--top-k below it) would be silently inert —
+                    # SLO, breach detection, and the gate would all
+                    # sleep through a real regression.  Say so loudly.
+                    log.warning(
+                        "recall SLO %s targets %s but --top-k %d "
+                        "samples only recall@{%s} — that floor can "
+                        "never see a sample (raise --top-k or lower "
+                        "the SLO's K)", spec.name, spec.metric,
+                        args.top_k,
+                        ",".join(str(k) for k in shadow_ks))
+            shadow = ShadowScorer(
+                lambda: server.engine.index,
+                ShadowConfig(rate=shadow_rate,
+                             ks=shadow_ks,
+                             window=args.shadow_window,
+                             seed=args.shadow_seed),
+                telemetry=telemetry,
+                out_path=os.path.join(tel_dir, "quality.jsonl"),
+                baseline=baseline,
+                recall_floor=floor, floor_metric=floor_metric,
+            ).start()
+            server.shadow = shadow
+            log.info("shadow scoring armed: rate %g, window %d%s",
+                     shadow_rate, args.shadow_window,
+                     f", floor {floor} on {floor_metric}"
+                     if floor is not None else "")
         if getattr(args, "remediate", False):
             # Alert→actuation (docs/RESILIENCE.md §Remediation): bind
             # the live alerts to the serve-side actions this run can
@@ -1382,6 +1470,17 @@ def cmd_serve(args) -> int:
                 )
                 actions["snapshot_hotswap"] = swapper.swap
             actions["rewarm"] = lambda alert: server.rewarm()
+            if isinstance(index, IVFIndex):
+                # Recall-burn actuation (docs/OBSERVABILITY.md
+                # §Quality): widen the probe set, flat-fallback past
+                # it.  Only an IVF tier has the knob — the default
+                # policy table filters itself out elsewhere.
+                from npairloss_tpu.obs.quality.escalate import (
+                    ProbeEscalator,
+                )
+
+                escalator = ProbeEscalator(server, telemetry=telemetry)
+                actions["escalate_probes"] = escalator.escalate
             if admission is None and any(p.action == "load_shed"
                                          for p in policies):
                 # Remediation-driven shedding needs the throttle in the
@@ -1454,6 +1553,15 @@ def cmd_serve(args) -> int:
         return server.run_jsonl(_sys.stdin, _sys.stdout)
     finally:
         preempt.uninstall()
+        if shadow is not None:
+            try:
+                # Drain the shadow queue (every accepted sample
+                # scored), flush the final window + summary record —
+                # BEFORE the live stop, so the last recall rows reach
+                # the final tick, and before telemetry closes.
+                shadow.close()
+            except Exception as e:  # noqa: BLE001
+                log.error("shadow scorer close failed: %s", e)
         if live is not None:
             try:
                 # Final tick inside: an alert state that changed right
@@ -1763,9 +1871,14 @@ def cmd_prof(args) -> int:
     §Fleet observatory): aggregate a fleet run directory's per-rank
     telemetry streams into the ``npairloss-fleet-report-v1``
     straggler/skew/comms report plus one merged Perfetto timeline —
-    no backend is touched."""
+    no backend is touched.  ``--quality RUNDIR`` is its quality-
+    observatory sibling: validate and render the run's
+    ``npairloss-quality-v1`` shadow-recall log against its committed
+    baseline (§Quality observatory; backend-free too)."""
     if getattr(args, "fleet", None):
         return _prof_fleet(args)
+    if getattr(args, "quality", None):
+        return _prof_quality(args)
 
     import jax
     import numpy as np
@@ -1793,6 +1906,64 @@ def cmd_prof(args) -> int:
     print(obsperf.render_table(report))
     print(json.dumps({"report": paths["json"], "table": paths["txt"],
                       "telemetry": tel.run_dir}))
+    return 0
+
+
+def _prof_quality(args) -> int:
+    """``prof --quality RUNDIR``: offline quality-observatory report
+    (docs/OBSERVABILITY.md §Quality observatory).  Validates the run's
+    ``quality.jsonl`` against the ``npairloss-quality-v1`` contract,
+    prints the per-window recall trend with the committed parity
+    baseline alongside, and exits non-zero on a schema-invalid log —
+    the validator is the contract, exactly like the perf/fleet paths.
+    Stdlib-only: no backend is touched."""
+    from npairloss_tpu.obs.quality import (
+        load_quality_report,
+        quality_breaches,
+        quality_summary,
+        stale_shadow,
+        validate_quality_report,
+    )
+
+    run_dir = os.path.abspath(args.quality)
+    path = (run_dir if run_dir.endswith(".jsonl")
+            else os.path.join(run_dir, "quality.jsonl"))
+    if not os.path.exists(path):
+        log.error("prof --quality: no quality log at %s (serve with "
+                  "--shadow-rate > 0 to produce one)", path)
+        return 2
+    records = load_quality_report(path)
+    err = validate_quality_report(records)
+    if err is not None:
+        log.error("quality log failed its own schema check: %s", err)
+        return 1
+    summary = quality_summary(records)
+    lines = [f"quality observatory — {path}",
+             f"  windows {summary['windows']}, samples "
+             f"{summary['sampled_total']}, shadow rate "
+             f"{summary['shadow_rate']:g}"]
+    for key, row in sorted(summary.get("recall", {}).items()):
+        lines.append(
+            f"  recall@{key[3:]}: min {row['min']:.4f}  mean "
+            f"{row['mean']:.4f}  last {row['last']:.4f}")
+    base = summary.get("baseline")
+    if base:
+        lines.append(f"  committed baseline (probes {base.get('probes')},"
+                     f" sample {base.get('sample')}): "
+                     + json.dumps(base.get("recall", {})))
+    if "recall_floor" in summary:
+        lines.append(f"  declared floor: {summary['recall_floor']:g} on "
+                     f"{summary['floor_metric']} — "
+                     f"{summary['breaches']} breaching window(s)")
+    for i, metric, r, floor in quality_breaches(records):
+        lines.append(f"    breach: record {i} {metric} {r:.4f} < "
+                     f"{floor:g}")
+    stale = stale_shadow(records)
+    if stale:
+        lines.append(f"  WARNING: {stale}")
+    print("\n".join(lines))
+    print(json.dumps({"log": path, **summary,
+                      **({"stale": stale} if stale else {})}))
     return 0
 
 
@@ -2232,6 +2403,14 @@ def main(argv: Optional[list] = None) -> int:
         "magnitude, mined-pair hardness) — obs.health.HealthConfig",
     )
     t.add_argument(
+        "--mining-health", dest="mining_health", action="store_true",
+        help="extend the health rows with mining-quality trend stats "
+        "(AP-AN margin mean/p10, hard-negative saturation) from the "
+        "same loss aux — embedding collapse as a quality trend "
+        "(docs/OBSERVABILITY.md §Quality observatory); implies "
+        "--health-metrics",
+    )
+    t.add_argument(
         "--perf-metrics", dest="perf_metrics", action="store_true",
         help="emit one phase=\"perf\" telemetry row per display window "
         "(ms_per_step, emb_per_sec, MFU from XLA's analytic step FLOPs) "
@@ -2417,6 +2596,17 @@ def main(argv: Optional[list] = None) -> int:
         help="ivf k-means training subsample bound (full assignment "
         "always streams the whole gallery; default 131072)",
     )
+    ix.add_argument(
+        "--parity-sample", dest="parity_sample", type=int, default=256,
+        help="queries sampled for the build-time recall parity stamp "
+        "in the ivf commit manifest (0 disables; default 256) — the "
+        "live shadow-recall baseline (docs/OBSERVABILITY.md §Quality)",
+    )
+    ix.add_argument(
+        "--parity-probes", dest="parity_probes", type=int, default=8,
+        help="probe count the parity stamp measures at (match the "
+        "serving --probes; default 8)",
+    )
     ix.set_defaults(fn=cmd_index)
 
     sv = sub.add_parser(
@@ -2565,6 +2755,25 @@ def main(argv: Optional[list] = None) -> int:
         "included) without acting — implies --remediate",
     )
     sv.add_argument(
+        "--shadow-rate", dest="shadow_rate", type=float, default=0.0,
+        metavar="FRAC",
+        help="fraction of live queries shadow-scored off the hot path "
+        "against the flat exact oracle (deterministic by query id) — "
+        "emits live serve_recall_at_{1,5,10} + score-gap rows and the "
+        "npairloss-quality-v1 log; 0 (default) disables and keeps "
+        "every stream byte-identical; needs --telemetry-dir "
+        "(docs/OBSERVABILITY.md §Quality observatory)",
+    )
+    sv.add_argument(
+        "--shadow-window", dest="shadow_window", type=int, default=32,
+        help="shadow samples per emitted quality window row "
+        "(default 32)",
+    )
+    sv.add_argument(
+        "--shadow-seed", dest="shadow_seed", type=int, default=0,
+        help="shadow sampling seed (same seed = same shadow set)",
+    )
+    sv.add_argument(
         "--watch-snapshots", dest="watch_snapshots", metavar="PREFIX",
         help="training snapshot_prefix the hot-swap remediation "
         "watches for newer committed snapshots (the train→serve "
@@ -2707,6 +2916,14 @@ def main(argv: Optional[list] = None) -> int:
         "emit the npairloss-fleet-report-v1 straggler/skew/comms "
         "report and a merged Perfetto timeline (ignores the live-"
         "profiling flags; no backend touched)",
+    )
+    pr.add_argument(
+        "--quality", metavar="RUNDIR",
+        help="offline quality report: validate a serving run's "
+        "npairloss-quality-v1 shadow-recall log (quality.jsonl) and "
+        "render the recall trend vs the committed parity baseline "
+        "(docs/OBSERVABILITY.md §Quality observatory; no backend "
+        "touched)",
     )
     pr.add_argument("--model", default="googlenet",
                     help="model registry name (train)")

@@ -46,6 +46,13 @@ class HealthConfig:
     update_ratio: bool = True
     embedding_magnitude: bool = True
     pair_hardness: bool = True
+    # Mining-health telemetry (docs/OBSERVABILITY.md §Quality
+    # observatory): AP/AN margin-distribution + hard-negative-
+    # saturation stats derived from the SAME loss aux pair_hardness
+    # already reads — collapse as a quality trend.  Default OFF: the
+    # row-key set with the flag off is byte-identical to a pre-quality
+    # build (pinned by tests/test_quality.py).
+    mining_health: bool = False
     eps: float = 1e-12
 
 
@@ -111,7 +118,16 @@ def _finite_mean(x: jax.Array) -> jax.Array:
     return jnp.where(cnt > 0, total / jnp.maximum(cnt, 1), 0.0)
 
 
-def pair_hardness_health(aux: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+# The AN-frontier cosine past which a query's mined negatives count as
+# SATURATED: the threshold no longer discriminates — everything looks
+# like a hard negative (post-L2Normalize sims live in [-1, 1], so 0.9
+# is deep in collapse territory for random-ish classes).
+SATURATION_COSINE = 0.9
+
+
+def pair_hardness_health(
+    aux: Dict[str, jax.Array], mining: bool = False
+) -> Dict[str, jax.Array]:
     """Mined-pair hardness summary from the dense engine's loss aux.
 
     ``mined_pos/neg_per_query`` are the reference's identNum/diffNum
@@ -120,11 +136,54 @@ def pair_hardness_health(aux: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     RELATIVE_* methods), averaged over the queries that actually had
     candidates.  Thresholds drifting toward +1 while counts collapse is
     the classic embedding-collapse signature.
+
+    ``mining=True`` (HealthConfig.mining_health) adds the quality-trend
+    stats — derived from the SAME per-query thresholds, so they exist
+    across the whole GLOBAL/LOCAL × HARD/RELATIVE mining grid:
+
+      * ``ap_an_margin_mean``: mean AP−AN threshold margin over queries
+        with both frontiers defined — the distance between "what counts
+        as a positive" and "what counts as a hard negative";
+      * ``ap_an_margin_p10``: the 10th-percentile margin — the weakest
+        queries collapse FIRST, so the low tail leads the mean;
+      * ``an_saturation``: fraction of defined AN frontiers past
+        :data:`SATURATION_COSINE` — how much of the batch mines
+        negatives that are indistinguishable from positives.
+
+    With ``mining=False`` the returned key set is byte-identical to the
+    pre-quality build (the row-parity pin).  Every stat is finite by
+    construction (sentinel-masked, zero-filled when undefined) — the
+    health metrics feed assert_all_finite under --debug-checks.
     """
     stop = jax.lax.stop_gradient
-    return {
+    out = {
         "mined_pos_per_query": stop(aux["ident_num"]).mean(),
         "mined_neg_per_query": stop(aux["diff_num"]).mean(),
         "ap_threshold_mean": _finite_mean(stop(aux["pos_threshold"])),
         "an_threshold_mean": _finite_mean(stop(aux["neg_threshold"])),
     }
+    if not mining:
+        return out
+    pos = stop(aux["pos_threshold"]).astype(jnp.float32)
+    neg = stop(aux["neg_threshold"]).astype(jnp.float32)
+    ok_p = jnp.isfinite(pos) & (jnp.abs(pos) < _THRESHOLD_SENTINEL)
+    ok_n = jnp.isfinite(neg) & (jnp.abs(neg) < _THRESHOLD_SENTINEL)
+    ok = ok_p & ok_n
+    cnt = ok.sum()
+    margin = jnp.where(ok, pos - neg, 0.0)
+    out["ap_an_margin_mean"] = jnp.where(
+        cnt > 0, margin.sum() / jnp.maximum(cnt, 1), 0.0)
+    # p10 without a masked-quantile primitive: sort with undefined
+    # queries pushed to +inf, index the 10th percentile of the DEFINED
+    # count (a traced index — jnp.take handles it).
+    filled = jnp.where(ok, pos - neg, jnp.float32(jnp.inf))
+    ranked = jnp.sort(filled)
+    i10 = jnp.clip((cnt - 1) // 10, 0, ranked.shape[0] - 1)
+    p10 = jnp.take(ranked, i10)
+    out["ap_an_margin_p10"] = jnp.where(
+        (cnt > 0) & jnp.isfinite(p10), p10, 0.0)
+    cnt_n = ok_n.sum()
+    saturated = (ok_n & (neg > jnp.float32(SATURATION_COSINE))).sum()
+    out["an_saturation"] = jnp.where(
+        cnt_n > 0, saturated / jnp.maximum(cnt_n, 1), 0.0)
+    return out

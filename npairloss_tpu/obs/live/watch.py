@@ -31,6 +31,26 @@ from npairloss_tpu.obs.live.slo import SLOSpec
 
 WATCH_ALERTS_FILENAME = "alerts.watch.jsonl"
 REMEDIATION_FILENAME = "remediation.jsonl"
+QUALITY_FILENAME = "quality.jsonl"
+
+
+def _load_quality():
+    """File-path-load ``obs.quality.report`` (self-contained, stdlib
+    only) WITHOUT importing its package — whose siblings pull jax, and
+    watch must stay backend-free (the remediate loader's pattern)."""
+    import importlib.util
+    import sys
+
+    name = "npairloss_tpu.obs.quality.report"
+    if name not in sys.modules:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "quality", "report.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
 
 
 def _load_remediate():
@@ -241,6 +261,22 @@ def watch_run_dir(
             **({"error": err} if err else {}),
             **reconcile_remediation(rem_records, events),
         }
+    quality: Optional[Dict[str, Any]] = None
+    q_path = os.path.join(run_dir, QUALITY_FILENAME)
+    if os.path.exists(q_path):
+        # The run shadow-scored: validate the npairloss-quality-v1 log
+        # and surface the aggregate recall view next to the replayed
+        # alert lifecycle — the recall-floor firing the replay just
+        # reproduced and the windows that caused it read side by side.
+        qmod = _load_quality()
+        q_records = qmod.load_quality_report(q_path)
+        qerr = qmod.validate_quality_report(q_records)
+        quality = {
+            "log": q_path,
+            "valid": qerr is None,
+            **({"error": qerr} if qerr
+               else qmod.quality_summary(q_records)),
+        }
     return {
         "run_dir": run_dir,
         "streams": paths,
@@ -255,8 +291,10 @@ def watch_run_dir(
         # empty window and print every SLO as ok right next to an
         # active alert in the same summary.
         "slo": obs.evaluator.status_dict(last_t[0]),
-        # Remediation reconciliation only when the run remediated (the
-        # absent-key contract: no audit log, no block).
+        # Remediation reconciliation only when the run remediated, and
+        # the quality view only when it shadow-scored (the absent-key
+        # contract: no log, no block).
         **({"remediation": remediation}
            if remediation is not None else {}),
+        **({"quality": quality} if quality is not None else {}),
     }

@@ -72,6 +72,37 @@ def post_warmup_compile(window_s: float = 3600.0) -> SLOSpec:
     )
 
 
+def serve_recall_floor(k: int = 10, floor: float = 0.95,
+                       window_s: float = 120.0,
+                       severity: str = "critical") -> SLOSpec:
+    """Online answer quality (docs/OBSERVABILITY.md §Quality
+    observatory): the shadow scorer's live recall@K estimate vs the
+    flat brute-force oracle.  An approximate index silently trading
+    recall for speed is the regression the offline parity gate catches
+    a build too late — this fires while it happens.  No shadow rows
+    (``--shadow-rate 0``) = no samples = stays ok."""
+    return SLOSpec(
+        name="serve_recall_floor", metric=f"serve_recall_at_{k}",
+        op=">=", target=floor, window_s=window_s, burn_threshold=0.5,
+        min_samples=1, severity=severity,
+        description=f"shadow-estimated recall@{k} vs the exact oracle",
+    )
+
+
+def serve_score_gap(max_gap: float = 0.05,
+                    window_s: float = 120.0) -> SLOSpec:
+    """The shadow scorer's companion signal: how much top-1 similarity
+    the served answer leaves on the table vs the exact scan.  Recall
+    can hold while scores quietly degrade (quantization drift) — the
+    gap catches that earlier, at warning severity."""
+    return SLOSpec(
+        name="serve_score_gap", metric="serve_shadow_score_gap",
+        op="<=", target=max_gap, window_s=window_s, burn_threshold=0.5,
+        min_samples=1, severity="warning",
+        description="shadow top-1 score gap vs the exact oracle",
+    )
+
+
 def index_staleness(max_age_s: float = 3600.0,
                     severity: str = "warning") -> SLOSpec:
     """Gallery freshness (ROADMAP item 4): the served index's commit
@@ -157,6 +188,22 @@ def embedding_collapse(threshold: float = 0.98,
     )
 
 
+def mining_margin_floor(floor: float = 0.05,
+                        window_s: float = 600.0) -> SLOSpec:
+    """Mining-health early warning (needs ``--health-metrics
+    --mining-health`` rows): the mean AP−AN threshold margin — how far
+    the mined positive frontier sits above the mined negative frontier.
+    A margin collapsing to ~0 means every pair looks alike: the
+    embedding-space collapse signature, visible as a quality TREND
+    before ``an_threshold_mean`` crosses the collapse guard's bar."""
+    return SLOSpec(
+        name="mining_margin_floor", metric="train_ap_an_margin_mean",
+        op=">=", target=floor, window_s=window_s, burn_threshold=0.5,
+        min_samples=3, severity="warning",
+        description="mean AP-AN mining-threshold margin (collapse trend)",
+    )
+
+
 def fleet_straggler(max_step_lag: float = 2.0,
                     window_s: float = 300.0) -> SLOSpec:
     """Persistent straggler lag across rank-stamped streams (the fleet
@@ -198,11 +245,13 @@ def default_watchdogs(kind: str, max_queue: int = 256,
     """The standard watchdog set for a run kind.
 
     ``serve``: p99, queue saturation, post-warmup compiles, index +
-    model staleness.  ``train``: non-finite streak, snapshot staleness,
-    embedding collapse, fleet straggler lag, plus the throughput floor
-    when ``bench_floor`` is given (see :func:`bench_floor_emb_per_sec`
-    — never armed implicitly, a CPU box must not page against a TPU
-    bar).
+    model staleness, shadow recall floor + score gap (quality SLOs —
+    without shadow rows they simply never see a sample and stay ok).
+    ``train``: non-finite streak, snapshot staleness, embedding
+    collapse, mining-margin floor, fleet straggler lag, plus the
+    throughput floor when ``bench_floor`` is given (see
+    :func:`bench_floor_emb_per_sec` — never armed implicitly, a CPU box
+    must not page against a TPU bar).
     """
     if kind == "serve":
         return [
@@ -211,12 +260,15 @@ def default_watchdogs(kind: str, max_queue: int = 256,
             post_warmup_compile(),
             index_staleness(),
             model_staleness(),
+            serve_recall_floor(),
+            serve_score_gap(),
         ]
     if kind == "train":
         specs = [
             nonfinite_loss_streak(),
             snapshot_staleness(),
             embedding_collapse(),
+            mining_margin_floor(),
             fleet_straggler(),
         ]
         if bench_floor is not None:

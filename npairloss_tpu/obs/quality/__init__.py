@@ -1,0 +1,44 @@
+"""Quality observatory — online ANSWER-QUALITY observation for serving.
+
+The live observatory (obs/live) watches speed and health; this package
+watches whether the answers are still GOOD (docs/OBSERVABILITY.md
+§Quality observatory):
+
+  * :mod:`report`   — the versioned ``npairloss-quality-v1`` JSONL
+    contract (``validate_quality_report`` IS the contract) plus the
+    jax-free gate helpers ``scripts/bench_check.py --quality``
+    file-path-loads — stdlib only, self-contained, the alerts.py
+    pattern;
+  * :mod:`shadow`   — the ShadowScorer: deterministic sampling of live
+    queries, off-hot-path re-scoring against the flat brute-force
+    oracle, per-window ``serve_recall_at_{1,5,10}``/score-gap rows
+    through the EXISTING telemetry sink chain;
+  * :mod:`escalate` — the ProbeEscalator remediation actuator: widen
+    the IVF probe set under a burning recall floor, flat-fallback when
+    the probe budget exhausts.
+
+``shadow`` and ``escalate`` need jax (they build serve engines) and are
+imported lazily by their consumers; this ``__init__`` re-exports only
+the stdlib contract.  Truly jax-free processes (``bench_check``, the
+``watch`` surfacing) file-path-load ``report.py`` directly — the parent
+``obs`` package's ``__init__`` imports jax, so ``report.py`` keeps zero
+intra-package imports (the alerts.py/remediate.py contract).
+"""
+
+from npairloss_tpu.obs.quality.report import (
+    QUALITY_SCHEMA,
+    load_quality_report,
+    quality_breaches,
+    quality_summary,
+    stale_shadow,
+    validate_quality_report,
+)
+
+__all__ = [
+    "QUALITY_SCHEMA",
+    "load_quality_report",
+    "quality_breaches",
+    "quality_summary",
+    "stale_shadow",
+    "validate_quality_report",
+]

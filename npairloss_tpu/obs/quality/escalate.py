@@ -1,0 +1,117 @@
+"""ProbeEscalator — the recall-burn remediation actuator.
+
+When the recall-floor SLO burns (the shadow scorer's live
+``serve_recall_at_{k}`` gauge under the declared floor), the cheapest
+knob that buys recall back is the IVF probe width: score more clusters
+per query.  ``probes`` is baked into the engine's jitted program, so an
+escalation is a HOT-SWAP, not a flag flip — build a fresh engine tier
+with the widened ``EngineConfig``, warm every padding bucket OFF the
+serving path (the old tier keeps answering through the compiles), then
+publish atomically via :meth:`RetrievalServer.swap_engines` — zero
+dropped queries, zero serving-path compiles, the hotswap contract.
+
+The escalation ladder doubles probes per attempt up to the cluster
+count; with the probe budget exhausted (probing every cluster IS the
+exact scan, just a slower one) the next attempt **falls back to flat
+scoring**: the tier republishes on a flat ``GalleryIndex`` built from
+the same gallery rows — recall is 1.0 by construction, latency pays.
+A further attempt on a flat tier raises (nothing left to escalate),
+which the remediation engine records as an honest FAILED attempt — the
+``NothingNewerError`` pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("npairloss_tpu.obs.quality")
+
+
+class EscalationExhaustedError(RuntimeError):
+    """The tier already serves flat exact answers — no knob remains."""
+
+
+class ProbeEscalator:
+    """Escalate the served IVF probe width; flat-fallback past it.
+
+    ``factor`` multiplies ``probes`` per attempt (clamped to the
+    cluster count).  The CURRENT tier is read from the server at each
+    call, so escalations chain correctly across interleaved hot-swaps
+    (a snapshot swap preserves the escalated config — hotswap reuses
+    ``old.cfg``).  ``escalate(alert=None)`` is the remediation-action
+    signature; the returned detail dict lands on the audit record.
+    """
+
+    def __init__(self, server, telemetry=None, factor: int = 2):
+        if factor < 2:
+            raise ValueError(f"factor must be >= 2, got {factor}")
+        self.server = server
+        self.telemetry = telemetry
+        self.factor = factor
+
+    def escalate(self, alert: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        from npairloss_tpu.serve.engine import QueryEngine
+        from npairloss_tpu.serve.index import GalleryIndex
+        from npairloss_tpu.serve.ivf import IVFIndex
+
+        server = self.server
+        old = server.engine
+        index = old.index
+        if not isinstance(index, IVFIndex):
+            raise EscalationExhaustedError(
+                "serving tier is already flat (exact scan) — probe "
+                "escalation has nothing left to widen"
+                + (f" (alert {alert.get('alert_id')})" if alert else ""))
+        kc = index.n_clusters
+        effective = min(old.cfg.probes, kc)
+        if effective < kc:
+            new_probes = min(effective * self.factor, kc)
+            cfg = dataclasses.replace(old.cfg, probes=new_probes)
+            new_index = index
+            detail: Dict[str, Any] = {"probes": new_probes,
+                                      "probes_before": effective}
+            log.warning("recall remediation: escalating IVF probes "
+                        "%d -> %d (of %d clusters)",
+                        effective, new_probes, kc)
+        else:
+            # Probe budget exhausted: probing every cluster already IS
+            # the exact answer set — the remaining recall knob is the
+            # flat oracle itself.  int8 has no flat equivalent (the
+            # per-cluster scale), so the fallback scores fp32.
+            cfg = dataclasses.replace(
+                old.cfg,
+                scoring=("fp32" if old.cfg.scoring == "int8"
+                         else old.cfg.scoring))
+            new_index = GalleryIndex.build(
+                index._host_emb, index._host_labels, ids=index.ids,
+                mesh=index.mesh, axis=index.axis, normalize=False)
+            new_index.created = index.created  # same content, same age
+            detail = {"fallback": "flat", "probes_before": effective}
+            log.warning("recall remediation: probe budget exhausted "
+                        "(%d/%d) — falling back to the flat exact scan",
+                        effective, kc)
+        primary = QueryEngine(
+            new_index, cfg, model=old.model, state=old.state,
+            telemetry=self.telemetry,
+        )
+        warmup_s = primary.warmup(
+            server.input_shape if old.model is not None else None)
+        engines = [primary] + [
+            QueryEngine(new_index, cfg, model=old.model, state=old.state,
+                        telemetry=self.telemetry,
+                        share_compiled_with=primary)
+            for _ in range(len(server.engines) - 1)
+        ]
+        for e in engines[1:]:
+            e.warmed = True
+        # Same gallery content, same freshness identity: pass None so
+        # swap_engines keeps the served ages — a recall remediation is
+        # not a freshness event.
+        server.swap_engines(engines, None)
+        detail["warmup_s"] = round(warmup_s, 3)
+        if self.telemetry is not None:
+            self.telemetry.instant("serve/probe_escalation", **detail)
+        return detail

@@ -74,6 +74,16 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               state is untouched) — drives the
                               embedding-collapse watchdog and the
                               trainer-rollback remediation
+  ``serve.recall_drop``       deterministically mis-probe the IVF top-C
+                              selection for one warmed dispatch (the
+                              centroid scan runs against the negated
+                              query — worst clusters probed, recall
+                              collapses, shapes/compile signatures
+                              unchanged); supports ``name:count@delay``
+                              arming like every failpoint — drives the
+                              recall-floor watchdog and the
+                              probe-escalation remediation
+                              (docs/OBSERVABILITY.md §Quality)
   ==========================  =============================================
 
 ``times`` counts fires: an armed point fires its next ``times`` checks

@@ -429,6 +429,13 @@ def default_policies(kind: str) -> List[RemediationPolicy]:
                 action="rewarm", cooldown_s=120.0, max_attempts=2,
                 description="re-warm every padding bucket after a "
                             "post-warmup compile storm"),
+            RemediationPolicy(
+                name="probe_escalation", slo="serve_recall_floor",
+                action="escalate_probes", cooldown_s=30.0,
+                max_attempts=4,
+                description="widen the IVF probe set while the shadow "
+                            "recall estimate burns; past the probe "
+                            "budget, fall back to flat exact scoring"),
         ]
     if kind == "train":
         return [

@@ -607,6 +607,18 @@ class QueryEngine:
                 [q, np.zeros((bucket - n, q.shape[1]), np.float32)]
             )
         idx = self.index
+        # serve.recall_drop (docs/RESILIENCE.md): deterministically
+        # mis-probe the IVF top-C selection for this dispatch — the
+        # centroid scan runs against the NEGATED query, so the probe
+        # set is the worst clusters and recall collapses while shapes,
+        # sharding, and compile signatures stay identical (zero
+        # recompiles, the strict guard never trips).  Gated on
+        # ``warmed`` so warmup/re-warm dispatches never consume armed
+        # fires, and on the IVF path so a flat tier (the recall
+        # oracle) leaves the arming untouched.
+        if self._ivf and self.warmed and \
+                failpoints.should_fire("serve.recall_drop"):
+            q = -q
         args, sig = self._topk_call(bucket)
         n_before = self._cache_size()
         with self._span("serve/topk", batch=n, bucket=bucket):

@@ -125,6 +125,13 @@ class IVFIndex(GalleryIndex):
     # against the caller's captured layout), so a republish racing a
     # dispatch can never poison another generation's cache.
     _scored: Optional[Dict[str, tuple]] = None
+    # The offline recall birth certificate (docs/OBSERVABILITY.md
+    # §Quality observatory): :func:`measure_parity`'s recall@K-per-
+    # scoring-mode numbers, stamped into the commit manifest at build
+    # time so the LIVE shadow-recall gauge has a committed baseline.
+    # Preserved through load/re-commit (an ``add()`` re-commit keeps
+    # the measurement it was born with — the manifest records when).
+    parity: Optional[dict] = None
 
     # -- construction -----------------------------------------------------
 
@@ -331,11 +338,17 @@ class IVFIndex(GalleryIndex):
         }
 
     def _manifest_extra(self) -> dict:
-        return {"n_clusters": int(self.centroids_host.shape[0])}
+        return {
+            "n_clusters": int(self.centroids_host.shape[0]),
+            **({"parity": self.parity} if self.parity else {}),
+        }
 
     @classmethod
     def _from_tree(cls, tree, manifest, mesh, axis) -> "IVFIndex":
         idx = super()._from_tree(tree, manifest, mesh, axis)
+        parity = manifest.get("parity")
+        if isinstance(parity, dict):
+            idx.parity = parity
         idx.centroids_host = np.asarray(tree["centroids"], np.float32)
         idx.assign_host = np.asarray(tree["assign"], np.int32)
         if idx.assign_host.shape[0] != idx.size:
@@ -394,3 +407,59 @@ def topk_recall(
     for i in range(a.shape[0]):
         hits += len(set(a[i, :k].tolist()) & set(e[i, :k].tolist()))
     return hits / float(a.shape[0] * k)
+
+
+def measure_parity(
+    index: IVFIndex,
+    probes: int = 8,
+    ks: Tuple[int, ...] = (1, 5, 10),
+    sample: int = 256,
+    scorings: Tuple[str, ...] = SCORINGS,
+    seed: int = 0,
+) -> dict:
+    """The build-time recall birth certificate: recall@K of the probe
+    path vs the flat brute-force oracle, per scoring mode, on a bounded
+    sample of gallery rows re-used as queries.  Stamped into the IVF
+    commit manifest (``manifest["parity"]``) so the LIVE shadow-recall
+    gauge (obs.quality.shadow) has a committed baseline to be compared
+    against in /healthz and the quality report — the operating-target
+    discipline applied to answer quality.
+
+    Single-device and unwarmed engines throughout: one measurement at
+    build time, never a serving-path compile."""
+    from npairloss_tpu.serve.engine import EngineConfig, QueryEngine
+    from npairloss_tpu.serve.index import GalleryIndex
+
+    n = index.size
+    ks = tuple(k for k in ks if k <= n)
+    if not ks:
+        raise ValueError(f"gallery of {n} rows supports none of ks")
+    kmax = max(ks)
+    m = min(int(sample), n)
+    rng = np.random.default_rng(seed)
+    rows = rng.choice(n, size=m, replace=False)
+    queries = index._host_emb[rows]
+    bucket = min(64, m)
+    flat = GalleryIndex.build(
+        index._host_emb, index._host_labels, ids=index.ids,
+        normalize=False)
+    oracle = QueryEngine(
+        flat, EngineConfig(top_k=kmax, buckets=(bucket,), scoring="fp32"))
+    exact = oracle.query(queries, normalize=False)["rows"]
+    probes = max(1, min(int(probes), index.n_clusters))
+    recall: Dict[str, Dict[str, float]] = {}
+    for scoring in scorings:
+        engine = QueryEngine(
+            index, EngineConfig(top_k=kmax, buckets=(bucket,),
+                                probes=probes, scoring=scoring))
+        approx = engine.query(queries, normalize=False)["rows"]
+        recall[scoring] = {
+            f"at_{k}": round(topk_recall(approx, exact, k), 4) for k in ks
+        }
+    return {
+        "probes": probes,
+        "sample": m,
+        "ks": list(ks),
+        "recall": recall,
+        "measured_at": time.time(),
+    }

@@ -175,6 +175,12 @@ class RetrievalServer:
         self.input_shape = (tuple(input_shape)
                             if input_shape is not None else None)
         self.remediation = None
+        # Optional ShadowScorer (obs.quality.shadow): the dispatch
+        # OFFERS every answered query; the scorer samples, queues, and
+        # re-scores off the hot path.  None (the default) keeps the
+        # serving path and every emitted stream byte-identical to a
+        # shadow-free build (pinned by tests/test_quality.py).
+        self.shadow = None
         # Hot-swap state (serve/hotswap.py): count of engine-tier
         # republishes, and whether a re-warm has made the window rows'
         # compiles_after_warmup key EXPLICIT (present even at zero) so
@@ -462,6 +468,19 @@ class RetrievalServer:
                         for r in range(out["scores"].shape[1])
                     ],
                 }
+            if self.shadow is not None:
+                # Shadow offer AFTER the answers are built: a hash +
+                # bounded put per sampled query, never a wait — the
+                # scorer re-scores on its own thread (obs.quality).
+                try:
+                    for j, (i, row) in enumerate(emb_rows):
+                        # The raw query row — the oracle re-normalizes
+                        # exactly like the serving engine did.
+                        self.shadow.offer(items[i].get("id"), row,
+                                          out["rows"][j],
+                                          out["scores"][j])
+                except Exception as e:  # noqa: BLE001 — shadow must not fail answers
+                    log.error("shadow offer failed: %s", e)
         return answers
 
     # -- remediation actuators (docs/RESILIENCE.md §Remediation) -----------
@@ -605,6 +624,11 @@ class RetrievalServer:
             **({"hot_swaps": self.swaps} if self.swaps else {}),
             **({"remediation": self.remediation.last_by_policy()}
                if self.remediation is not None else {}),
+            # The online recall estimate (obs.quality): block absent =
+            # shadowing off — the freshness-JSON contract again, so a
+            # --shadow-rate 0 run keeps its pre-PR summary shape.
+            **({"quality": self.shadow.stats()}
+               if self.shadow is not None else {}),
             **{k: round(v, 3) for k, v in self._percentiles().items()},
             # Whole-run latency split: where an answer's time went,
             # stage by stage (one read at drain, not per window; from

@@ -499,7 +499,8 @@ class Solver:
             # Mined-pair hardness summaries ride the dense engine's loss
             # aux (the streaming engines never materialize it — their
             # health coverage is the norm/magnitude signals).
-            metrics.update(pair_hardness_health(aux))
+            metrics.update(pair_hardness_health(
+                aux, mining=self.health.mining_health))
         return loss, metrics
 
     def _sharded_loss(self, emb, labels):

@@ -366,6 +366,83 @@ def check_remediation_log(path: str,
     return violations
 
 
+# -- quality-log gate ---------------------------------------------------------
+
+def _load_quality():
+    """File-path-load ``obs.quality.report`` (self-contained, stdlib
+    only — the same contract as the alerts/remediate modules) WITHOUT
+    importing the package."""
+    import importlib.util
+
+    name = "npairloss_tpu.obs.quality.report"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO, "npairloss_tpu", "obs", "quality",
+                               "report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def check_quality_log(path: str,
+                      alerts_path: Optional[str] = None) -> List[str]:
+    """Gate one ``npairloss-quality-v1`` shadow-recall artifact:
+    schema-valid per the one contract (validate_quality_report); every
+    window that breached the DECLARED recall floor must be matched by a
+    recall alert that actually FIRED (cross-checked against the paired
+    alerts.jsonl — a breach with no alert log at all is refused, since
+    an unobserved quality regression cannot be distinguished from an
+    observed one); and the shadow scorer must not have silently stopped
+    sampling mid-run (the summary's stale last-sample wall time).
+    Breaches WITH a fired alert are evidence the loop worked, not
+    failures — the alert gate owns the unresolved-incident verdict."""
+    qmod = _load_quality()
+    try:
+        records = qmod.load_quality_report(path)
+    except OSError as e:
+        return [f"quality log {path} unreadable: {e}"]
+    err = qmod.validate_quality_report(records)
+    if err is not None:
+        return [f"quality log schema-invalid: {err}"]
+    violations: List[str] = []
+    breaches = qmod.quality_breaches(records)
+    if breaches:
+        if alerts_path is None:
+            alerts_path = os.path.join(
+                os.path.dirname(os.path.abspath(path)), "alerts.jsonl")
+        fired_metrics = set()
+        if os.path.exists(alerts_path):
+            alerts = _load_live_alerts()
+            try:
+                alert_records = alerts.load_alert_log(alerts_path)
+            except OSError as e:
+                return [f"alert log {alerts_path} unreadable: {e}"]
+            fired_metrics = {r.get("metric") for r in alert_records
+                             if isinstance(r, dict)
+                             and r.get("state") == "firing"}
+        for i, metric, recall, floor in breaches:
+            if metric not in fired_metrics:
+                violations.append(
+                    f"window record {i}: recall {recall:.4f} below the "
+                    f"declared floor {floor:g} with NO fired alert on "
+                    f"{metric!r} ({alerts_path}) — the quality SLO "
+                    "slept through a real regression")
+        matched = sum(1 for _, m, _, _ in breaches if m in fired_metrics)
+        if matched:
+            _log(f"{matched} floor breach(es) matched by a fired recall "
+                 "alert — the loop observed them; noted, not gated")
+    stale = qmod.stale_shadow(records)
+    if stale is not None:
+        violations.append(f"quality log: {stale}")
+    if not violations:
+        summary = qmod.quality_summary(records)
+        _log(f"quality log OK ({summary['windows']} window(s), "
+             f"{summary['sampled_total']} sample(s), "
+             f"{summary['breaches']} breach(es))")
+    return violations
+
+
 # -- the gate -----------------------------------------------------------------
 
 def _ivf_hard_gates(new_rows: Dict[str, Dict]) -> List[str]:
@@ -557,11 +634,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument(
         "--alerts-log", dest="alerts_log", metavar="PATH",
-        help="with --remediation: the paired alerts.jsonl for the "
-        "action-without-alert cross-check (default: alerts.jsonl "
-        "next to the remediation log)",
+        help="with --remediation/--quality: the paired alerts.jsonl "
+        "for the cross-checks (default: alerts.jsonl next to the "
+        "gated log)",
+    )
+    ap.add_argument(
+        "--quality", metavar="PATH",
+        help="gate a shadow-recall quality log instead of the bench "
+        "trajectory: schema-valid (npairloss-quality-v1), every "
+        "recall-floor breach matched by a fired alert, no silently-"
+        "stalled shadow scorer — the ci.sh quality-smoke wiring",
     )
     args = ap.parse_args(argv)
+
+    if args.quality:
+        violations = check_quality_log(args.quality,
+                                       alerts_path=args.alerts_log)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (quality log {args.quality})")
+        return 0
 
     if args.remediation:
         violations = check_remediation_log(args.remediation,

@@ -1071,6 +1071,176 @@ print(f"chaos D OK ({drain['hot_swaps']} hot swap(s), {drops} visible "
 EOF
 chaos_gates "$hs/tel" D
 
+echo "== quality observatory smoke (docs/OBSERVABILITY.md §Quality) =="
+# The recall loop end to end: a clean IVF serve run under a recall@10
+# SLO (shadow-scoring EVERY query against the flat oracle) fires ZERO
+# alerts and the jax-free --quality gate accepts its log; a run with
+# serve.recall_drop armed fires the recall alert, the probe-escalation
+# remediation runs, the alert resolves, --quality and --remediation
+# both accept; the watch replay reproduces firing->resolved through
+# the same engine; and the gate's teeth refuse a schema violation and
+# a floor breach with no fired alert.
+q_dir="$smoke_dir/quality"
+mkdir -p "$q_dir"
+python - "$q_dir" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+rng = np.random.default_rng(0)
+# Well-separated blobs: IVF geometry where partial probes still find
+# the true neighbors, so only the INJECTED mis-probe can drop recall.
+centers = rng.standard_normal((8, 32)).astype(np.float32)
+centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+emb = np.repeat(centers, 32, axis=0) + 0.1 * rng.standard_normal(
+    (256, 32)).astype(np.float32)
+emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+np.save(d + "/g.emb.npy", emb)
+np.save(d + "/g.labels.npy", np.repeat(np.arange(8), 32).astype(np.int32))
+with open(d + "/queries.jsonl", "w") as f:
+    for i in range(200):
+        f.write(json.dumps({"id": i, "embedding": emb[i % 256].tolist()}) + "\n")
+json.dump({"slos": [{
+    "name": "serve_recall_floor", "metric": "serve_recall_at_10",
+    "op": ">=", "target": 0.9, "window_s": 2.0, "burn_threshold": 0.5,
+    "min_samples": 1, "severity": "critical"}]},
+    open(d + "/slo.json", "w"))
+json.dump({"policies": [{
+    "name": "probe_escalation", "slo": "serve_recall_floor",
+    "action": "escalate_probes", "cooldown_s": 4.0, "max_attempts": 4}]},
+    open(d + "/rem.json", "w"))
+EOF
+JAX_PLATFORMS=cpu python -m npairloss_tpu index \
+    --emb "$q_dir/g.emb.npy" --labels "$q_dir/g.labels.npy" \
+    --no-normalize --kind ivf --clusters 8 --parity-sample 64 \
+    --out "$q_dir/g.gidx" > "$q_dir/index.log" 2>&1 \
+    || { echo "quality smoke: ivf index build failed"; cat "$q_dir/index.log"; exit 1; }
+python - "$q_dir/g.gidx/manifest.json" <<'EOF'
+import json, sys
+par = json.load(open(sys.argv[1])).get("parity")
+assert par and par["recall"]["fp32"]["at_10"] >= 0.95, par
+print(f"parity birth certificate committed (fp32 recall@10 "
+      f"{par['recall']['fp32']['at_10']}, probes {par['probes']})")
+EOF
+
+run_quality_serve() {  # $1 = tel dir, $2 = probes, $3 = failpoints, $4 = extra args
+    local tel="$1" probes="$2" fp="$3"; shift 3
+    mkfifo "$q_dir/in.$$"
+    env JAX_PLATFORMS=cpu NPAIRLOSS_FAILPOINTS="$fp" \
+        python -m npairloss_tpu serve --index "$q_dir/g.gidx" \
+        --index-kind ivf --probes "$probes" --top-k 10 --buckets 1 \
+        --deadline-ms 1 --metrics-window 4 --shadow-rate 1 \
+        --shadow-window 4 --telemetry-dir "$tel" --live-obs \
+        --slo-config "$q_dir/slo.json" --slo-tick 0.2 "$@" \
+        < "$q_dir/in.$$" > "$tel.answers.jsonl" 2> "$tel.log" &
+    qpid=$!
+    exec 9> "$q_dir/in.$$"
+    # phase 1: (possibly fault-poisoned) traffic
+    head -40 "$q_dir/queries.jsonl" | while IFS= read -r ln; do
+        printf '%s\n' "$ln" >&9; sleep 0.08
+    done
+    sleep 2.5  # fault (if armed) exhausts; alert fires; remediation runs
+    # phase 2: clean traffic — good recall windows age the burn out
+    sed -n '41,100p' "$q_dir/queries.jsonl" | while IFS= read -r ln; do
+        printf '%s\n' "$ln" >&9; sleep 0.05
+    done
+    sleep 3    # resolution lands before the drain
+    kill -TERM "$qpid" 2>/dev/null || true
+    exec 9>&-
+    rc=0; wait "$qpid" || rc=$?
+    rm -f "$q_dir/in.$$"
+    [[ "$rc" -eq 75 ]] \
+        || { echo "quality smoke: expected exit 75, got $rc"; cat "$tel.log"; exit 1; }
+}
+
+echo "-- quality clean run: zero alerts, gate accepts --"
+run_quality_serve "$q_dir/clean" 8 ""
+[[ ! -s "$q_dir/clean/alerts.jsonl" ]] \
+    || { echo "quality smoke: CLEAN run fired alerts"; cat "$q_dir/clean/alerts.jsonl"; exit 1; }
+python - "$q_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/clean.answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", drain
+assert drain["errors"] == 0 and drain["answered"] == 100, drain
+q = drain["quality"]
+assert q["sampled"] == 100 and q["windows"] >= 20, q
+assert q["last"]["recall_at_10"] == 1.0, q
+assert q["baseline"]["recall"]["fp32"]["at_10"] >= 0.95, q
+recs = [json.loads(ln) for ln in open(d + "/clean/quality.jsonl") if ln.strip()]
+assert recs[0]["kind"] == "config" and recs[0]["recall_floor"] == 0.9, recs[0]
+assert recs[-1]["kind"] == "summary", recs[-1]
+print(f"quality clean OK ({q['windows']} windows, recall@10 "
+      f"{q['last']['recall_at_10']}, baseline committed)")
+EOF
+python scripts/bench_check.py --quality "$q_dir/clean/quality.jsonl" \
+    || { echo "quality smoke: gate refused the clean log"; exit 1; }
+JAX_PLATFORMS=cpu python -m npairloss_tpu prof --quality "$q_dir/clean" \
+    > "$q_dir/prof.log" 2>&1 \
+    || { echo "quality smoke: prof --quality refused"; cat "$q_dir/prof.log"; exit 1; }
+
+echo "-- quality fault run: recall_drop -> alert -> probe escalation -> resolve --"
+run_quality_serve "$q_dir/fault" 2 "serve.recall_drop:12" \
+    --remediate --remediation-config "$q_dir/rem.json"
+python - "$q_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/fault.answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", drain
+assert drain["errors"] == 0 and drain["answered"] == 100, drain
+states = [json.loads(ln)["state"] for ln in open(d + "/fault/alerts.jsonl") if ln.strip()]
+assert "firing" in states, "recall_drop never fired the recall alert"
+assert states[-1] == "resolved", f"recall alert never resolved: {states}"
+rem = [json.loads(ln) for ln in open(d + "/fault/remediation.jsonl") if ln.strip()]
+esc = [r for r in rem if r["policy"] == "probe_escalation"]
+assert esc, "probe escalation never attempted"
+ok = [r for r in esc if r["state"] == "succeeded"]
+assert ok, f"probe escalation never succeeded: {esc}"
+assert drain["hot_swaps"] >= 1, drain  # the escalation republished the tier
+assert drain["remediation"]["probe_escalation"]["outcome"] == "succeeded", drain
+qrecs = [json.loads(ln) for ln in open(d + "/fault/quality.jsonl") if ln.strip()]
+bad = [r for r in qrecs if r.get("kind") == "window" and r["recall_at_10"] < 0.9]
+assert bad, "no breaching window recorded — the fault never reached the shadow"
+print(f"quality fault OK ({len(bad)} breaching window(s), "
+      f"{len(ok)} escalation(s) succeeded, alert resolved, "
+      f"{drain['hot_swaps']} hot swap(s))")
+EOF
+python scripts/bench_check.py --quality "$q_dir/fault/quality.jsonl" \
+    || { echo "quality smoke: gate refused the remediated fault log"; exit 1; }
+python scripts/bench_check.py --remediation "$q_dir/fault/remediation.jsonl" \
+    || { echo "quality smoke: remediation gate refused"; exit 1; }
+python scripts/bench_check.py --alerts "$q_dir/fault/alerts.jsonl" \
+    || { echo "quality smoke: alert gate refused the fire->resolve log"; exit 1; }
+# the offline feed agrees: watch must reproduce firing->resolved from
+# the recall rows on disk, and surface a valid quality block
+JAX_PLATFORMS=cpu python -m npairloss_tpu watch "$q_dir/fault" \
+    --slo-config "$q_dir/slo.json" > "$q_dir/watch.log" 2>&1 \
+    || { echo "quality smoke: watch refused the run dir"; cat "$q_dir/watch.log"; exit 1; }
+python - "$q_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+states = [json.loads(ln)["state"]
+          for ln in open(d + "/fault/alerts.watch.jsonl") if ln.strip()]
+assert "firing" in states and states[-1] == "resolved", states
+summary = json.loads(open(d + "/watch.log").read().strip().splitlines()[-1])
+assert summary["quality"]["valid"] is True, summary.get("quality")
+assert summary["quality"]["breaches"] >= 1, summary["quality"]
+print(f"watch feed agrees: {states}; quality block valid "
+      f"({summary['quality']['breaches']} breach(es) surfaced)")
+EOF
+# gate teeth: a schema violation and a breach with NO fired alert must
+# both be refused
+sed 's/npairloss-quality-v1/npairloss-quality-v0/' \
+    "$q_dir/fault/quality.jsonl" > "$q_dir/badschema.jsonl"
+python scripts/bench_check.py --quality "$q_dir/badschema.jsonl" > /dev/null \
+    && { echo "quality smoke: gate ACCEPTED a schema violation"; exit 1; }
+mkdir -p "$q_dir/ghost"
+cp "$q_dir/fault/quality.jsonl" "$q_dir/ghost/quality.jsonl"
+python scripts/bench_check.py --quality "$q_dir/ghost/quality.jsonl" > /dev/null \
+    && { echo "quality smoke: gate ACCEPTED a breach with no alert log"; exit 1; }
+echo "quality observatory smoke OK (clean zero-alert + gate, fault->alert->escalation->resolve, watch agreement, gate teeth)"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

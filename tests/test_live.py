@@ -589,9 +589,11 @@ def test_default_watchdogs():
     serve = {s.name for s in default_watchdogs("serve", max_queue=64)}
     assert {"serve_p99", "serve_queue_saturation",
             "serve_post_warmup_compile", "index_staleness",
-            "model_staleness"} == serve
+            "model_staleness", "serve_recall_floor",
+            "serve_score_gap"} == serve
     train = {s.name for s in default_watchdogs("train")}
     assert "train_nonfinite_streak" in train
+    assert "mining_margin_floor" in train
     assert "train_throughput_floor" not in train  # only with a real bar
     train_bar = {s.name
                  for s in default_watchdogs("train", bench_floor=100.0)}

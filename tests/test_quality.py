@@ -1,0 +1,831 @@
+"""Quality observatory tests (obs/quality + its serve/train wiring,
+docs/OBSERVABILITY.md §Quality observatory): deterministic shadow
+sampling, latency-invariant (never-blocking) shadow scoring, recall
+math vs hand fixtures, the npairloss-quality-v1 validator's teeth, the
+recall-floor watchdog's fire/clear hysteresis, the probe-escalation
+remediation lifecycle incl. the budget-exhausted flat fallback, the
+serve.recall_drop failpoint, the IVF parity birth certificate, the
+jax-free bench_check --quality gate, the watch surfacing, and the
+mining-health row-key byte-parity pin."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from npairloss_tpu.obs.quality.report import (
+    QUALITY_SCHEMA,
+    load_quality_report,
+    quality_breaches,
+    quality_summary,
+    stale_shadow,
+    validate_quality_report,
+)
+from npairloss_tpu.obs.quality.shadow import (
+    ShadowConfig,
+    ShadowScorer,
+    recall_against,
+    shadow_sampled,
+)
+from npairloss_tpu.resilience import failpoints
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_CHECK = os.path.join(REPO, "scripts", "bench_check.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def _unit_rows(rng, n, d):
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    return x / np.linalg.norm(x, axis=1, keepdims=True)
+
+
+@pytest.fixture(scope="module")
+def tiny_ivf():
+    """One 64x16 IVF index (4 clusters) shared by the jax-touching
+    tests — engines built per-test, the index is immutable here."""
+    from npairloss_tpu.serve.ivf import IVFIndex
+
+    rng = np.random.default_rng(0)
+    emb = _unit_rows(rng, 64, 16)
+    lab = (np.arange(64) % 8).astype(np.int32)
+    return emb, IVFIndex.build_ivf(emb, lab, clusters=4, seed=0)
+
+
+# -- deterministic sampling ---------------------------------------------------
+
+
+def test_shadow_sampling_deterministic():
+    ids = list(range(500)) + ["q-%d" % i for i in range(100)] + [None]
+    set_a = {i for i in ids if shadow_sampled(i, 0.3, seed=0)}
+    set_b = {i for i in ids if shadow_sampled(i, 0.3, seed=0)}
+    assert set_a == set_b  # same seed => same shadow set
+    set_c = {i for i in ids if shadow_sampled(i, 0.3, seed=1)}
+    assert set_a != set_c  # a different seed selects differently
+    # the rate is roughly honored and the extremes are exact
+    assert 0.15 < len(set_a) / len(ids) < 0.45
+    assert not any(shadow_sampled(i, 0.0, seed=0) for i in ids)
+    assert all(shadow_sampled(i, 1.0, seed=0) for i in ids)
+
+
+def test_shadow_config_validates():
+    with pytest.raises(ValueError, match="rate"):
+        ShadowConfig(rate=0.0)
+    with pytest.raises(ValueError, match="rate"):
+        ShadowConfig(rate=1.5)
+    with pytest.raises(ValueError, match="ks"):
+        ShadowConfig(rate=0.5, ks=(5, 1))
+    with pytest.raises(ValueError, match="window"):
+        ShadowConfig(rate=0.5, window=0)
+
+
+# -- recall math --------------------------------------------------------------
+
+
+def test_recall_math_hand_fixtures():
+    exact = [10, 20, 30, 40, 50]
+    assert recall_against([10, 20, 30, 40, 50], exact, 5) == 1.0
+    assert recall_against([10, 20, 99, 98, 97], exact, 5) == 0.4
+    assert recall_against([99, 98, 97, 96, 95], exact, 5) == 0.0
+    # @1 only compares the heads
+    assert recall_against([10, 99], exact, 1) == 1.0
+    assert recall_against([20, 10], exact, 1) == 0.0
+    # order within the top-K never matters — it is set overlap
+    assert recall_against([50, 40, 30, 20, 10], exact, 5) == 1.0
+
+
+# -- the npairloss-quality-v1 validator ---------------------------------------
+
+
+def _config(**over):
+    return {"schema": QUALITY_SCHEMA, "kind": "config",
+            "shadow_rate": 0.5, "seed": 0, "ks": [1, 5], "window": 4,
+            "wall_time": 100.0, "stale_after_s": 30.0, **over}
+
+
+def _window(t=101.0, total=4, r1=1.0, r5=1.0, **over):
+    return {"schema": QUALITY_SCHEMA, "kind": "window", "wall_time": t,
+            "samples": 4, "sampled_total": total, "recall_at_1": r1,
+            "recall_at_5": r5, "score_gap_mean": 0.0,
+            "score_gap_max": 0.01, **over}
+
+
+def _summary(t=110.0, total=4, windows=1, last=101.0, **over):
+    return {"schema": QUALITY_SCHEMA, "kind": "summary", "wall_time": t,
+            "sampled_total": total, "windows": windows, "dropped": 0,
+            "last_sample_wall_time": last, **over}
+
+
+def test_quality_validator_accepts_good_stream():
+    recs = [_config(), _window(), _window(t=102.0, total=8),
+            _summary(total=8, windows=2, last=102.0)]
+    assert validate_quality_report(recs) is None
+    s = quality_summary(recs)
+    assert s["windows"] == 2 and s["sampled_total"] == 8
+
+
+def test_quality_validator_teeth():
+    cases = [
+        ([], "empty"),
+        ([_window()], "record 0 must be the config"),
+        ([_config(schema="npairloss-quality-v0")], "schema must be"),
+        ([_config(), _config(wall_time=101.0)], "duplicate config"),
+        ([_config(shadow_rate=0.0)], "shadow_rate"),
+        ([_config(ks=[5, 1])], "ks must be"),
+        ([_config(ks=[])], "ks must be"),
+        ([_config(recall_floor=0.9)], "floor_metric"),
+        ([_config(recall_floor=1.5,
+                  floor_metric="serve_recall_at_5")], "recall_floor"),
+        ([_config(), _window(r1=1.2)], "recall_at_1"),
+        ([_config(), {k: v for k, v in _window().items()
+                      if k != "recall_at_5"}], "recall_at_5"),
+        ([_config(), _window(score_gap_mean=-0.1)], "score gaps"),
+        ([_config(), _window(score_gap_mean=0.5,
+                             score_gap_max=0.1)], "score_gap_max"),
+        ([_config(), _window(total=8), _window(t=102.0, total=4)],
+         "regressed"),
+        ([_config(), _window(t=99.0)], "precedes"),
+        ([_config(), _window(), _summary(windows=2)], "window(s)"),
+        ([_config(), _window(), _summary(), _window(t=120.0)],
+         "after the summary"),
+        ([_config(), _window(),
+          {k: v for k, v in _summary().items()
+           if k != "last_sample_wall_time"}], "last_sample_wall_time"),
+        ([_config(), {"_bad_line": 2}], "unparseable"),
+        (["nope"], "not an object"),
+    ]
+    for recs, needle in cases:
+        err = validate_quality_report(recs)
+        assert err is not None and needle in err, (recs, err, needle)
+
+
+def test_quality_breaches_and_stale():
+    cfg = _config(recall_floor=0.9, floor_metric="serve_recall_at_5")
+    good = [cfg, _window(), _summary()]
+    assert validate_quality_report(good) is None
+    assert quality_breaches(good) == []
+    breach = [cfg, _window(r5=0.5), _window(t=102.0, total=8, r5=0.95),
+              _summary(total=8, windows=2, last=102.0)]
+    assert validate_quality_report(breach) is None
+    hits = quality_breaches(breach)
+    assert len(hits) == 1 and hits[0][1] == "serve_recall_at_5"
+    assert hits[0][2] == 0.5 and hits[0][3] == 0.9
+    # no declared floor -> nothing to breach
+    assert quality_breaches([_config(), _window(r5=0.0)]) == []
+    # stale: the summary drains 40s after the last sample (> 30s)
+    stale = [_config(), _window(),
+             _summary(t=141.0, last=101.0)]
+    assert validate_quality_report(stale) is None
+    assert "silent" in stale_shadow(stale)
+    assert stale_shadow(good) is None
+    # shadowing on but NOTHING ever sampled for longer than the bound
+    empty = [_config(), {"schema": QUALITY_SCHEMA, "kind": "summary",
+                         "wall_time": 140.0, "sampled_total": 0,
+                         "windows": 0, "dropped": 0}]
+    assert validate_quality_report(empty) is None
+    assert "NOTHING" in stale_shadow(empty)
+    # offer-side evidence disambiguates (the false-positive fix): a
+    # drain long after the last QUERY is healthy idleness, not a wedge
+    idle = [_config(), _window(),
+            _summary(t=500.0, last=101.0,
+                     offered_total=4, last_offer_wall_time=101.0)]
+    assert validate_quality_report(idle) is None
+    assert stale_shadow(idle) is None
+    # ...but offers outrunning the last scored sample IS a wedge
+    wedged = [_config(), _window(),
+              _summary(t=500.0, last=101.0,
+                       offered_total=400, last_offer_wall_time=490.0)]
+    assert "stalled" in stale_shadow(wedged)
+    # zero samples with zero offers: no traffic was sampled, no wedge
+    quiet = [_config(), {"schema": QUALITY_SCHEMA, "kind": "summary",
+                         "wall_time": 500.0, "sampled_total": 0,
+                         "windows": 0, "dropped": 0,
+                         "offered_total": 0}]
+    assert validate_quality_report(quiet) is None
+    assert stale_shadow(quiet) is None
+
+
+# -- the shadow scorer --------------------------------------------------------
+
+
+def test_shadow_scorer_end_to_end(tiny_ivf, tmp_path):
+    """Known-good and known-garbage served answers through the real
+    oracle: the window recall must equal the planted fraction, the
+    quality log must validate (config/window/summary), and the gauges
+    must land in a registry (registry-only mode)."""
+    from npairloss_tpu.obs.live import MetricRegistry
+    from npairloss_tpu.serve import EngineConfig, QueryEngine
+
+    emb, idx = tiny_ivf
+    reg = MetricRegistry()
+    qp = str(tmp_path / "quality.jsonl")
+    scorer = ShadowScorer(
+        lambda: idx,
+        ShadowConfig(rate=1.0, ks=(1, 5), window=8, oracle_batch=4),
+        registry=reg, out_path=qp,
+        recall_floor=0.9, floor_metric="serve_recall_at_5",
+    ).start()
+    # exact served answers for the first 4 queries via a full-probe
+    # engine, planted garbage for the next 4
+    engine = QueryEngine(idx, EngineConfig(top_k=5, buckets=(1,),
+                                           probes=4))
+    for i in range(4):
+        out = engine.query(emb[i:i + 1], normalize=False)
+        assert scorer.offer(i, emb[i], out["rows"][0], out["scores"][0])
+    garbage = np.array([60, 61, 62, 63, 59], np.int32)
+    for i in range(4, 8):
+        assert scorer.offer(i, emb[i], garbage,
+                            np.zeros(5, np.float32))
+    deadline = time.time() + 30.0
+    while scorer.windows < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    scorer.close()
+    assert scorer.sampled_total == 8 and scorer.dropped == 0
+    recs = load_quality_report(qp)
+    assert validate_quality_report(recs) is None
+    window = next(r for r in recs if r["kind"] == "window")
+    # 4 exact (recall 1.0) + 4 garbage (recall ~0; row 59+ could
+    # overlap a true neighbor, so allow the top of the garbage band)
+    assert 0.4 <= window["recall_at_5"] <= 0.65
+    assert recs[0]["recall_floor"] == 0.9
+    assert recs[-1]["kind"] == "summary"
+    g = reg.get("serve_recall_at_5")
+    assert g is not None and g.value == window["recall_at_5"]
+    # the breach the garbage caused is visible to the gate helpers
+    assert quality_breaches(recs)
+    stats = scorer.stats()
+    assert stats["sampled"] == 8 and "last" in stats
+
+
+def test_shadow_oracle_follows_inplace_add(tmp_path):
+    """add() republishes the SAME index object in place — the oracle
+    staleness token (size, created) must force a rebuild, or served
+    answers pointing at new rows would score as misses against the
+    pre-add gallery (a false recall collapse)."""
+    from npairloss_tpu.serve import GalleryIndex
+
+    rng = np.random.default_rng(3)
+    emb = _unit_rows(rng, 32, 8)
+    idx = GalleryIndex.build(emb, (np.arange(32) % 4).astype(np.int32),
+                             normalize=False)
+    scorer = ShadowScorer(
+        lambda: idx, ShadowConfig(rate=1.0, ks=(1,), window=1,
+                                  oracle_batch=1)).start()
+    scorer.offer(0, emb[0], np.array([0], np.int32),
+                 np.ones(1, np.float32))
+    deadline = time.time() + 30.0
+    while scorer.windows < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert scorer.stats()["last"]["recall_at_1"] == 1.0
+    new_row = _unit_rows(rng, 1, 8)
+    idx.add(new_row, np.array([9], np.int32), normalize=False)
+    # the correct served answer for the new row IS the new row (32);
+    # a stale oracle would still rank the old gallery and call it a
+    # miss
+    scorer.offer(1, new_row[0], np.array([32], np.int32),
+                 np.ones(1, np.float32))
+    while scorer.windows < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    scorer.close()
+    assert scorer.stats()["last"]["recall_at_1"] == 1.0
+
+
+def test_shadow_offer_never_blocks(tiny_ivf):
+    """The latency-invariance pin: with the scoring thread WEDGED and
+    the queue bounded at 2, a thousand offers must return immediately
+    (drops counted) — the serving path never waits on the oracle, and
+    scoring runs on the shadow thread, never the caller's."""
+    emb, idx = tiny_ivf
+    wedge = threading.Event()
+    scoring_threads = []
+
+    scorer = ShadowScorer(
+        lambda: idx, ShadowConfig(rate=1.0, ks=(1,), window=2,
+                                  max_queue=2, oracle_batch=1))
+    real = scorer._score_batch
+
+    def wedged(batch):
+        scoring_threads.append(threading.get_ident())
+        wedge.wait(timeout=30.0)
+        real(batch)
+
+    scorer._score_batch = wedged
+    scorer.start()
+    rows = np.arange(1, dtype=np.int32)
+    t0 = time.perf_counter()
+    for i in range(1000):
+        scorer.offer(i, emb[0], rows, np.zeros(1, np.float32))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"offers took {elapsed:.3f}s — something blocked"
+    assert scorer.dropped > 900  # bounded queue shed the flood
+    wedge.set()
+    scorer.close()
+    assert scoring_threads  # scoring happened...
+    assert threading.get_ident() not in scoring_threads  # ...not here
+
+
+def test_server_summary_quality_block_absent_when_off(tiny_ivf):
+    """The --shadow-rate 0 parity pin: no scorer, no 'quality' key —
+    summary and /healthz keep their pre-quality shape."""
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+
+    emb, idx = tiny_ivf
+    engine = QueryEngine(idx, EngineConfig(top_k=5, buckets=(1,),
+                                           probes=4))
+    server = RetrievalServer(
+        engine, BatcherConfig(max_batch=1, max_delay_ms=1.0),
+        ServerConfig(metrics_window=0))
+    server.replicaset.start()
+    try:
+        a = server.handle({"id": 0, "embedding": emb[0].tolist()})
+        assert a["neighbors"][0]["row"] == 0
+        assert "quality" not in server.summary()
+        assert "quality" not in server.healthz()
+    finally:
+        server.replicaset.close(drain=True)
+
+
+def test_server_dispatch_offers_sampled_queries(tiny_ivf):
+    emb, idx = tiny_ivf
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+
+    engine = QueryEngine(idx, EngineConfig(top_k=5, buckets=(1,),
+                                           probes=4))
+    engine.warmup()
+    server = RetrievalServer(
+        engine, BatcherConfig(max_batch=1, max_delay_ms=1.0),
+        ServerConfig(metrics_window=0))
+    scorer = ShadowScorer(
+        lambda: server.engine.index,
+        ShadowConfig(rate=1.0, ks=(1, 5), window=3, oracle_batch=3),
+    ).start()
+    server.shadow = scorer
+    server.replicaset.start()
+    try:
+        for i in range(3):
+            a = server.handle({"id": i, "embedding": emb[i].tolist()})
+            assert "neighbors" in a
+        deadline = time.time() + 30.0
+        while scorer.windows < 1 and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        server.replicaset.close(drain=True)
+        scorer.close()
+    assert scorer.sampled_total == 3
+    assert scorer.stats()["last"]["recall_at_5"] == 1.0
+    assert "quality" in server.summary()
+
+
+# -- the recall-floor watchdog ------------------------------------------------
+
+
+def test_recall_watchdog_fire_clear_hysteresis():
+    from npairloss_tpu.obs.live import MetricRegistry, SLOEvaluator
+    from npairloss_tpu.obs.live.watchdogs import serve_recall_floor
+
+    spec = serve_recall_floor(k=10, floor=0.9, window_s=10.0)
+    assert spec.metric == "serve_recall_at_10" and spec.op == ">="
+    reg = MetricRegistry()
+    ev = SLOEvaluator([spec], reg)
+    # no samples: shadowing off stays ok forever
+    assert not ev.evaluate(now=100.0)[0].burning
+    reg.set(spec.metric, 1.0, t=100.0)
+    assert not ev.evaluate(now=100.5)[0].burning
+    # recall collapses: half the window bad -> fires
+    for i in range(6):
+        reg.set(spec.metric, 0.2, t=101.0 + i)
+    st = ev.evaluate(now=107.0)
+    assert st[0].burning and st[0].worst == 0.2
+    # hysteresis: one good sample is not recovery...
+    reg.set(spec.metric, 1.0, t=108.0)
+    assert ev.evaluate(now=108.0)[0].burning
+    # ...but the bad samples aging out of the window clears it
+    for i in range(4):
+        reg.set(spec.metric, 1.0, t=112.0 + i)
+    assert not ev.evaluate(now=116.0)[0].burning
+
+
+# -- probe escalation ---------------------------------------------------------
+
+
+def _tiny_server(idx, probes, replicas=1, top_k=5):
+    from npairloss_tpu.serve import (
+        BatcherConfig,
+        EngineConfig,
+        QueryEngine,
+        RetrievalServer,
+        ServerConfig,
+    )
+
+    cfg = EngineConfig(top_k=top_k, buckets=(1,), probes=probes)
+    primary = QueryEngine(idx, cfg)
+    primary.warmup()
+    engines = [primary] + [
+        QueryEngine(idx, cfg, share_compiled_with=primary)
+        for _ in range(replicas - 1)
+    ]
+    for e in engines[1:]:
+        e.warmed = True
+    server = RetrievalServer(
+        engines, BatcherConfig(max_batch=1, max_delay_ms=1.0),
+        ServerConfig(metrics_window=0))
+    server.replicaset.start()
+    return server
+
+
+def test_probe_escalation_ladder_and_flat_fallback(tiny_ivf):
+    from npairloss_tpu.obs.quality.escalate import (
+        EscalationExhaustedError,
+        ProbeEscalator,
+    )
+    from npairloss_tpu.serve.ivf import IVFIndex
+
+    emb, idx = tiny_ivf
+    server = _tiny_server(idx, probes=1, replicas=2)
+    try:
+        esc = ProbeEscalator(server)
+        d = esc.escalate()
+        assert d["probes"] == 2 and d["probes_before"] == 1
+        assert server.engine.cfg.probes == 2 and server.engine.warmed
+        assert len(server.engines) == 2  # replica count preserved
+        d = esc.escalate()
+        assert d["probes"] == 4  # clamped ladder top = cluster count
+        # budget exhausted: the next attempt is the flat fallback
+        before = server.freshness
+        d = esc.escalate()
+        assert d["fallback"] == "flat"
+        assert not isinstance(server.engine.index, IVFIndex)
+        assert server.freshness is before  # not a freshness event
+        a = server.handle({"id": 0, "embedding": emb[0].tolist()})
+        assert a["neighbors"][0]["row"] == 0  # flat answers are exact
+        # nothing left: an honest raise, the NothingNewerError pattern
+        with pytest.raises(EscalationExhaustedError):
+            esc.escalate()
+        assert server.swaps == 3
+    finally:
+        server.replicaset.close(drain=True)
+
+
+def test_probe_escalation_remediation_lifecycle(tiny_ivf, tmp_path):
+    """The full audited loop: firing alert -> attempted + escalation,
+    resolution -> succeeded; then a sticky alert walking the ladder to
+    the flat fallback, and past it the action RAISES -> failed — all
+    validator-clean."""
+    from npairloss_tpu.obs.quality.escalate import ProbeEscalator
+    from npairloss_tpu.resilience.remediate import (
+        RemediationEngine,
+        RemediationPolicy,
+        validate_remediation_log,
+    )
+
+    emb, idx = tiny_ivf
+    server = _tiny_server(idx, probes=1)
+    try:
+        esc = ProbeEscalator(server)
+        pol = RemediationPolicy(
+            name="probe_escalation", slo="serve_recall_floor",
+            action="escalate_probes", cooldown_s=10.0, max_attempts=4)
+        eng = RemediationEngine(
+            [pol], {"escalate_probes": esc.escalate},
+            log_path=str(tmp_path / "remediation.jsonl"))
+        alert = {"alert_id": "serve_recall_floor-1",
+                 "severity": "critical", "fired_at": 100.0}
+        active = {"serve_recall_floor": alert}
+        evs = eng.tick(active, now=100.0)
+        assert [e["state"] for e in evs] == ["attempted"]
+        assert server.engine.cfg.probes == 2
+        # alert resolves -> the attempt succeeded, detail recorded
+        evs = eng.tick({}, now=105.0)
+        assert evs[0]["state"] == "succeeded"
+        assert evs[0]["detail"]["probes"] == 2
+        # a fresh sticky incident: 4 -> flat -> exhausted(raise=failed)
+        alert2 = {"alert_id": "serve_recall_floor-2",
+                  "severity": "critical", "fired_at": 200.0}
+        active = {"serve_recall_floor": alert2}
+        eng.tick(active, now=200.0)   # probes 2 -> 4
+        assert server.engine.cfg.probes == 4
+        eng.tick(active, now=215.0)   # fails prior attempt, goes flat
+        from npairloss_tpu.serve.ivf import IVFIndex
+
+        assert not isinstance(server.engine.index, IVFIndex)
+        evs = eng.tick(active, now=230.0)  # nothing left -> raise
+        assert any(e["state"] == "failed" and "flat" in e.get(
+            "error", "").lower() or e["state"] == "failed"
+            for e in evs)
+        eng.close()
+        records = [json.loads(ln) for ln in
+                   open(tmp_path / "remediation.jsonl") if ln.strip()]
+        assert validate_remediation_log(records) is None
+        assert any(r["state"] == "succeeded" for r in records)
+        assert any(r["state"] == "failed" for r in records)
+    finally:
+        server.replicaset.close(drain=True)
+
+
+# -- serve.recall_drop failpoint ----------------------------------------------
+
+
+def test_recall_drop_failpoint(tiny_ivf):
+    from npairloss_tpu.serve import EngineConfig, GalleryIndex, QueryEngine
+
+    emb, idx = tiny_ivf
+    engine = QueryEngine(idx, EngineConfig(top_k=5, buckets=(1,),
+                                           probes=4))
+    engine.warmup()
+    before = engine.compile_stats()
+    assert engine.query(emb[7:8], normalize=False)["rows"][0, 0] == 7
+    failpoints.arm("serve.recall_drop", times=1)
+    out = engine.query(emb[7:8], normalize=False)
+    assert out["rows"][0, 0] != 7  # the probe set was poisoned
+    # exhausted: the very next dispatch answers exactly again, and the
+    # fault cost ZERO recompiles (same shapes, same signatures)
+    assert engine.query(emb[7:8], normalize=False)["rows"][0, 0] == 7
+    assert engine.compile_stats() == before
+    # a flat tier has no probe to corrupt: the arming is NOT consumed
+    flat = GalleryIndex.build(emb, (np.arange(64) % 8).astype(np.int32),
+                              normalize=False)
+    fengine = QueryEngine(flat, EngineConfig(top_k=5, buckets=(1,)))
+    fengine.warmup()
+    failpoints.arm("serve.recall_drop", times=1)
+    assert fengine.query(emb[7:8], normalize=False)["rows"][0, 0] == 7
+    assert failpoints.should_fire("serve.recall_drop")  # still armed
+
+
+def test_recall_drop_visible_to_shadow(tiny_ivf):
+    """The loop's first half: a poisoned dispatch's answers score ~0
+    recall against the oracle — the gauge the watchdog reads."""
+    emb, idx = tiny_ivf
+    from npairloss_tpu.serve import EngineConfig, QueryEngine
+
+    engine = QueryEngine(idx, EngineConfig(top_k=5, buckets=(1,),
+                                           probes=4))
+    engine.warmup()
+    scorer = ShadowScorer(
+        lambda: idx, ShadowConfig(rate=1.0, ks=(5,), window=2,
+                                  oracle_batch=2)).start()
+    failpoints.arm("serve.recall_drop", times=2)
+    for i in range(2):
+        out = engine.query(emb[i:i + 1], normalize=False)
+        scorer.offer(i, emb[i], out["rows"][0], out["scores"][0])
+    deadline = time.time() + 30.0
+    while scorer.windows < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    scorer.close()
+    assert scorer.stats()["last"]["recall_at_5"] <= 0.2
+
+
+# -- parity birth certificate -------------------------------------------------
+
+
+def test_ivf_parity_stamp_roundtrip(tiny_ivf, tmp_path):
+    from npairloss_tpu.serve.index import load_index, read_manifest
+    from npairloss_tpu.serve.ivf import measure_parity
+
+    emb, idx = tiny_ivf
+    par = measure_parity(idx, probes=4, sample=32)
+    assert par["probes"] == 4 and par["sample"] == 32
+    # full probes at fp32 == the exact scan: recall is 1.0 by math
+    assert par["recall"]["fp32"] == {"at_1": 1.0, "at_5": 1.0,
+                                     "at_10": 1.0}
+    assert set(par["recall"]) == {"fp32", "bf16", "int8"}
+    idx.parity = par
+    path = str(tmp_path / "g.gidx")
+    idx.save(path)
+    assert read_manifest(path)["parity"]["probes"] == 4
+    loaded = load_index(path)
+    assert loaded.parity == par  # the birth certificate survives load
+    idx.parity = None  # leave the module-scoped fixture untouched
+
+
+# -- mining-health ------------------------------------------------------------
+
+
+def _hardness_aux(pos_thr, neg_thr):
+    import jax.numpy as jnp
+
+    n = len(pos_thr)
+    return {
+        "ident_num": jnp.ones(n, jnp.float32),
+        "diff_num": jnp.ones(n, jnp.float32) * 3,
+        "pos_threshold": jnp.asarray(pos_thr, jnp.float32),
+        "neg_threshold": jnp.asarray(neg_thr, jnp.float32),
+    }
+
+
+def test_mining_health_keys_byte_identical_when_off():
+    from npairloss_tpu.obs.health import pair_hardness_health
+
+    aux = _hardness_aux([0.9, 0.8], [0.3, 0.4])
+    # the pre-quality key set, byte-identical with the feature off
+    assert list(pair_hardness_health(aux)) == [
+        "mined_pos_per_query", "mined_neg_per_query",
+        "ap_threshold_mean", "an_threshold_mean"]
+    on = pair_hardness_health(aux, mining=True)
+    assert list(on) == [
+        "mined_pos_per_query", "mined_neg_per_query",
+        "ap_threshold_mean", "an_threshold_mean",
+        "ap_an_margin_mean", "ap_an_margin_p10", "an_saturation"]
+
+
+def test_mining_health_values():
+    from npairloss_tpu.obs.health import pair_hardness_health
+
+    # healthy: wide margins, no saturation
+    out = pair_hardness_health(
+        _hardness_aux([0.9, 0.8, 0.7, 0.6], [0.3, 0.2, 0.1, 0.0]),
+        mining=True)
+    assert abs(float(out["ap_an_margin_mean"]) - 0.6) < 1e-6
+    assert abs(float(out["ap_an_margin_p10"]) - 0.6) < 1e-6  # min margin
+    assert float(out["an_saturation"]) == 0.0
+    # collapsing: AN frontier at the AP frontier, everything saturated
+    out = pair_hardness_health(
+        _hardness_aux([0.99, 0.99], [0.97, 0.99]), mining=True)
+    assert float(out["ap_an_margin_mean"]) < 0.02
+    assert float(out["an_saturation"]) == 1.0
+    # sentinel thresholds (no candidates) never poison the stats
+    out = pair_hardness_health(
+        _hardness_aux([1e38, 0.8], [-1e38, 0.2]), mining=True)
+    assert abs(float(out["ap_an_margin_mean"]) - 0.6) < 1e-6
+    assert float(out["an_saturation"]) == 0.0
+    # all-sentinel: finite zeros (the assert_all_finite contract)
+    out = pair_hardness_health(
+        _hardness_aux([1e38], [-1e38]), mining=True)
+    for key in ("ap_an_margin_mean", "ap_an_margin_p10",
+                "an_saturation"):
+        assert float(out[key]) == 0.0
+
+
+def test_solver_rows_mining_keys_gated(tmp_path):
+    """The row-schema pin at the Solver level: health rows WITHOUT
+    --mining-health carry exactly the pre-quality keys; with it, the
+    margin/saturation keys ride the same rows."""
+    import jax.numpy as jnp
+
+    from npairloss_tpu import REFERENCE_CONFIG
+    from npairloss_tpu.models import get_model
+    from npairloss_tpu.obs.health import HealthConfig
+    from npairloss_tpu.train import Solver, SolverConfig
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    lab = np.repeat(np.arange(4), 2).astype(np.int32)
+
+    def run(health):
+        solver = Solver(
+            get_model("mlp"), REFERENCE_CONFIG,
+            SolverConfig(base_lr=0.01, lr_policy="fixed", momentum=0.9,
+                         display=0, snapshot=0),
+            input_shape=(16,), health=health)
+        solver.init(x[:2])
+        return {k: float(v)
+                for k, v in solver.step(x, lab).items()}
+
+    base = run(HealthConfig())
+    mined = run(HealthConfig(mining_health=True))
+    new_keys = {"ap_an_margin_mean", "ap_an_margin_p10",
+                "an_saturation"}
+    assert not (new_keys & set(base))
+    assert new_keys <= set(mined)
+    assert set(mined) - set(base) == new_keys
+    for k in new_keys:
+        assert np.isfinite(mined[k])
+
+
+# -- the jax-free bench_check gate --------------------------------------------
+
+
+def _write_quality(tmp_path, records, alert_records=None):
+    os.makedirs(str(tmp_path), exist_ok=True)
+    qp = str(tmp_path / "quality.jsonl")
+    with open(qp, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    if alert_records is not None:
+        with open(str(tmp_path / "alerts.jsonl"), "w") as f:
+            for r in alert_records:
+                f.write(json.dumps(r) + "\n")
+    return qp
+
+
+def _gate(path, *extra):
+    return subprocess.run(
+        [sys.executable, BENCH_CHECK, "--quality", path, *extra],
+        capture_output=True, text=True)
+
+
+def test_bench_check_quality_gate(tmp_path):
+    cfg = _config(recall_floor=0.9, floor_metric="serve_recall_at_5")
+    clean = [cfg, _window(), _summary()]
+    qp = _write_quality(tmp_path / "clean", clean)
+    out = _gate(qp)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    # schema violation refused
+    bad = [dict(cfg, schema="npairloss-quality-v0")]
+    qp = _write_quality(tmp_path / "schema", bad)
+    out = _gate(qp)
+    assert out.returncode == 1 and "schema-invalid" in out.stdout
+
+    # a floor breach with NO alert log at all: refused
+    breach = [cfg, _window(r5=0.4), _summary()]
+    qp = _write_quality(tmp_path / "noalert", breach)
+    out = _gate(qp)
+    assert out.returncode == 1 and "NO fired alert" in out.stdout
+
+    # the same breach with a fired recall alert: the loop worked
+    fired = [{"state": "firing", "metric": "serve_recall_at_5",
+              "alert_id": "serve_recall_floor-1"}]
+    qp = _write_quality(tmp_path / "alerted", breach, fired)
+    out = _gate(qp)
+    assert out.returncode == 0, out.stdout
+
+    # ...but an alert on a DIFFERENT metric does not justify it
+    other = [{"state": "firing", "metric": "serve_p99_ms",
+              "alert_id": "p99-1"}]
+    qp = _write_quality(tmp_path / "wrongmetric", breach, other)
+    out = _gate(qp)
+    assert out.returncode == 1 and "NO fired alert" in out.stdout
+
+    # a silently-stalled shadow scorer: refused
+    stale = [cfg, _window(), _summary(t=200.0, last=101.0)]
+    qp = _write_quality(tmp_path / "stale", stale)
+    out = _gate(qp)
+    assert out.returncode == 1 and "silent" in out.stdout
+
+
+# -- watch + prof surfacing ---------------------------------------------------
+
+
+def test_watch_surfaces_quality_block(tmp_path):
+    from npairloss_tpu.obs.live import watch_run_dir
+    from npairloss_tpu.obs.live.watchdogs import serve_recall_floor
+
+    run = tmp_path / "run"
+    run.mkdir()
+    t0 = time.time()
+    rows = [{"run_id": "r", "phase": "serve", "step": i,
+             "wall_time": t0 + i, "recall_at_10": 1.0,
+             "shadow_score_gap": 0.0, "shadow_samples": 4}
+            for i in range(3)]
+    with open(run / "metrics.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    _write_quality(run, [
+        _config(ks=[10], wall_time=t0),
+        {"schema": QUALITY_SCHEMA, "kind": "window", "wall_time": t0 + 1,
+         "samples": 4, "sampled_total": 4, "recall_at_10": 1.0,
+         "score_gap_mean": 0.0, "score_gap_max": 0.0},
+        _summary(t=t0 + 2, last=t0 + 1)])
+    summary = watch_run_dir(str(run), [serve_recall_floor()])
+    assert summary["quality"]["valid"] is True
+    assert summary["quality"]["recall"]["at_10"]["min"] == 1.0
+    # healthy recall rows through the replay: no alert fired
+    assert summary["events"] == 0
+    # an invalid log is surfaced, not hidden
+    with open(run / "quality.jsonl", "a") as f:
+        f.write(json.dumps({"schema": "nope", "kind": "window"}) + "\n")
+        f.write("\n")
+    summary = watch_run_dir(str(run), [serve_recall_floor()])
+    assert summary["quality"]["valid"] is False
+    assert "error" in summary["quality"]
+
+
+def test_prof_quality_cli(tmp_path, capsys):
+    from npairloss_tpu.cli import main
+
+    run = tmp_path / "run"
+    run.mkdir()
+    _write_quality(run, [_config(), _window(), _summary()])
+    rc = main(["prof", "--quality", str(run)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "quality observatory" in out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert tail["windows"] == 1 and tail["sampled_total"] == 4
+    # schema-invalid: non-zero, the validator is the contract
+    _write_quality(run, [_config(shadow_rate=2.0)])
+    assert main(["prof", "--quality", str(run)]) == 1
+    # no log at all
+    assert main(["prof", "--quality", str(tmp_path / "none")]) == 2

@@ -104,10 +104,11 @@ def test_load_policies_roundtrip_and_louds(tmp_path):
 def test_default_policies():
     serve = default_policies("serve")
     assert [p.name for p in serve] == [
-        "hotswap_model", "hotswap_index", "load_shed", "rewarm"]
+        "hotswap_model", "hotswap_index", "load_shed", "rewarm",
+        "probe_escalation"]
     assert {p.slo for p in serve} == {
         "model_staleness", "index_staleness", "serve_queue_saturation",
-        "serve_post_warmup_compile"}
+        "serve_post_warmup_compile", "serve_recall_floor"}
     (train,) = default_policies("train")
     assert (train.slo, train.action) == (
         "embedding_collapse", "trainer_rollback")
