@@ -8,7 +8,16 @@ in-training retrieval metrics, and L2 normalization.  Subpackages:
 ``parallel`` (device-mesh plumbing + ring negative pooling), ``config``
 (prototxt front-end), ``data`` (identity-balanced pipeline with the
 native C++ runtime), ``models`` (embedding zoo), ``train`` (solver
-loop), ``utils`` (profiling + numeric debug guards).
+loop), ``utils`` (profiling + numeric debug guards), ``analysis``
+(the jax-free staticcheck invariant linter).
+
+The compute-core exports are LAZY (PEP 562): importing the package must
+not import jax, so the jax-free entry points — ``python -m
+npairloss_tpu staticcheck``, ``watch``, the bench parent, the
+bench_check gates — run in a venv with no accelerator stack installed
+at all (docs/STATICCHECK.md).  ``from npairloss_tpu import npair_loss``
+works exactly as before; it just pays the jax import at first use
+instead of at package import.
 """
 
 import logging as _logging
@@ -19,27 +28,24 @@ import logging as _logging
 # when the embedder has not (cli.cmd_train).
 _logging.getLogger("npairloss_tpu").addHandler(_logging.NullHandler())
 
-from npairloss_tpu.ops.npair_loss import (
-    REFERENCE_CONFIG,
-    MiningMethod,
-    MiningRegion,
-    NPairLossConfig,
-    npair_loss,
-    npair_loss_with_aux,
-)
-from npairloss_tpu.ops.eval_retrieval import (
-    evaluate_embeddings,
-    gallery_recall_at_k,
-)
-from npairloss_tpu.ops.metrics import retrieval_metrics
-from npairloss_tpu.ops.normalize import l2_normalize
-from npairloss_tpu.ops.pallas_npair import (
-    blockwise_npair_loss,
-    blockwise_npair_loss_with_aux,
-    blockwise_retrieval_metrics,
-)
-
 __version__ = "0.1.0"
+
+# Export name -> defining submodule, resolved on first attribute access.
+_LAZY_EXPORTS = {
+    "REFERENCE_CONFIG": "npairloss_tpu.ops.npair_loss",
+    "MiningMethod": "npairloss_tpu.ops.npair_loss",
+    "MiningRegion": "npairloss_tpu.ops.npair_loss",
+    "NPairLossConfig": "npairloss_tpu.ops.npair_loss",
+    "npair_loss": "npairloss_tpu.ops.npair_loss",
+    "npair_loss_with_aux": "npairloss_tpu.ops.npair_loss",
+    "evaluate_embeddings": "npairloss_tpu.ops.eval_retrieval",
+    "gallery_recall_at_k": "npairloss_tpu.ops.eval_retrieval",
+    "retrieval_metrics": "npairloss_tpu.ops.metrics",
+    "l2_normalize": "npairloss_tpu.ops.normalize",
+    "blockwise_npair_loss": "npairloss_tpu.ops.pallas_npair",
+    "blockwise_npair_loss_with_aux": "npairloss_tpu.ops.pallas_npair",
+    "blockwise_retrieval_metrics": "npairloss_tpu.ops.pallas_npair",
+}
 
 __all__ = [
     "REFERENCE_CONFIG",
@@ -57,3 +63,19 @@ __all__ = [
     "l2_normalize",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    mod_name = _LAZY_EXPORTS.get(name)
+    if mod_name is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(mod_name), name)
+    globals()[name] = value  # cache: the next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

@@ -1,0 +1,53 @@
+"""npairloss_tpu.analysis — the repo-wide invariant linter (staticcheck).
+
+An AST-based static-analysis suite (stdlib ``ast`` + an import-graph
+walker, itself jax-free) that enforces at lint time the contracts the
+runtime gates can only catch after the fact — often only on hardware
+CI does not have (docs/STATICCHECK.md):
+
+  * ``purity``     — transitive jax-free proof for the file-path-loaded
+                     contract modules, with a loud opt-in table;
+  * ``scopes``     — every ``jax.lax`` collective lexically inside a
+                     ``comm/<kind>`` named_scope (the static twin of
+                     the fleet observatory's zero-unattributed-bytes
+                     runtime gate);
+  * ``locks``      — ``# guarded-by:`` mutation discipline on shared
+                     state (MetricRegistry, SLOEvaluator,
+                     RemediationEngine, RetrievalServer swap state);
+  * ``contracts``  — versioned ``npairloss-*-v1`` writer/validator
+                     pairing, key twins, writer pins;
+  * ``vocab``      — failpoints / CLI flags / choice pins / watchdog
+                     names match their documented tables;
+  * ``markers``    — tier-1 timing history vs ``@pytest.mark.slow``.
+
+Every module here is stdlib-only and self-contained enough for
+``scripts/bench_check.py --static`` to file-path-load the chain from a
+jax-free process — the same contract as ``obs.live.alerts``, and the
+first thing the ``purity`` pass proves about this very package.
+"""
+
+from npairloss_tpu.analysis.findings import Finding
+from npairloss_tpu.analysis.report import (
+    STATICCHECK_SCHEMA,
+    build_report,
+    load_report,
+    validate_staticcheck_report,
+    write_report,
+)
+from npairloss_tpu.analysis.runner import (
+    PASS_NAMES,
+    load_allowlist,
+    run_suite,
+)
+
+__all__ = [
+    "Finding",
+    "STATICCHECK_SCHEMA",
+    "PASS_NAMES",
+    "build_report",
+    "load_report",
+    "validate_staticcheck_report",
+    "write_report",
+    "load_allowlist",
+    "run_suite",
+]

@@ -1,0 +1,121 @@
+"""Pass ``scopes`` — every collective call site carries a comm marker.
+
+The fleet observatory's runtime gate (``bench_check --fleet-report``,
+docs/OBSERVABILITY.md §Fleet) refuses a run with unattributed
+collective bytes — but it needs a multi-rank run to fire, and a kind
+that carries an analytic *claim* (the grad-sync allreduce) can absorb
+an uninstrumented collective's bytes without tripping it at all.  This
+pass is the static twin: every ``jax.lax`` collective call must be
+*lexically* enclosed in a ``jax.named_scope("comm/<kind>")`` block, so
+an uninstrumented new exchange path fails CI on a CPU box in
+milliseconds instead of surviving until a pod run's reconciliation.
+
+Escape hatch: a ``# comm-scope-ok: <reason>`` comment on the call line
+tolerates a site the scope rule genuinely cannot serve (document why).
+
+Stdlib-only and self-contained (the bench_check file-path-load
+contract, docs/STATICCHECK.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from npairloss_tpu.analysis.findings import Finding
+from npairloss_tpu.analysis.tree import SourceTree, const_str, dotted_name
+
+PASS_NAME = "scopes"
+
+# The jax.lax primitives that move bytes across the mesh.  axis_index
+# and axis_size are mesh *queries*, not exchanges — excluded.
+COLLECTIVES = frozenset({
+    "all_gather", "all_to_all", "ppermute", "pshuffle",
+    "psum", "psum_scatter", "pmean", "pmax", "pmin",
+})
+
+COMM_PREFIX = "comm/"
+ANNOTATION = "comm-scope-ok"
+
+# Callables that open a named scope (``utils.profiling.annotate`` is
+# ``jax.named_scope`` re-exported).
+_SCOPE_FNS = {"named_scope", "annotate"}
+
+
+def _is_collective_call(node: ast.Call) -> str:
+    """The collective's name when ``node`` calls one (``jax.lax.psum``
+    / ``lax.psum`` attribute chains), else ''."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in COLLECTIVES:
+        base = dotted_name(fn.value)
+        if base is not None and (base == "lax" or base.endswith(".lax")):
+            return fn.attr
+    return ""
+
+
+def _opens_comm_scope(item: ast.withitem) -> bool:
+    ctx = item.context_expr
+    if not isinstance(ctx, ast.Call):
+        return False
+    fn = ctx.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if name not in _SCOPE_FNS or not ctx.args:
+        return False
+    lit = const_str(ctx.args[0])
+    return bool(lit and lit.startswith(COMM_PREFIX))
+
+
+def _lax_from_imports(tree_mod: ast.Module) -> Set[str]:
+    """Names bound by ``from jax.lax import psum, ...`` — bare-name
+    collective calls."""
+    out: Set[str] = set()
+    for node in ast.walk(tree_mod):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            for alias in node.names:
+                if alias.name in COLLECTIVES:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def run(tree: SourceTree) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in tree.py_files(subdirs=("npairloss_tpu",)):
+        mod = tree.parse(rel)
+        if mod is None:
+            continue
+        bare = _lax_from_imports(mod)
+        comments = tree.comments(rel)
+
+        def visit(node: ast.AST, in_comm: bool, rel=rel,
+                  bare=bare, comments=comments) -> None:
+            if isinstance(node, ast.With):
+                entered = in_comm or any(
+                    _opens_comm_scope(i) for i in node.items)
+                for item in node.items:
+                    visit(item, in_comm)
+                for child in node.body:
+                    visit(child, entered)
+                return
+            if isinstance(node, ast.Call):
+                name = _is_collective_call(node)
+                if not name and isinstance(node.func, ast.Name) \
+                        and node.func.id in bare:
+                    name = node.func.id
+                if name and not in_comm:
+                    note = comments.get(node.lineno, "")
+                    if not note.startswith(ANNOTATION):
+                        findings.append(Finding(
+                            PASS_NAME, rel, node.lineno, name,
+                            f"jax.lax.{name} call not lexically "
+                            f"enclosed in a jax.named_scope("
+                            f"'{COMM_PREFIX}<kind>') block — its bytes "
+                            "would be unattributed (or silently absorbed "
+                            "by an analytic claim) in the fleet comms "
+                            "reconciliation; wrap the exchange or "
+                            f"annotate '# {ANNOTATION}: <reason>'"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_comm)
+
+        visit(mod, False)
+    return findings

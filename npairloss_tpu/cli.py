@@ -30,6 +30,12 @@ log = logging.getLogger("npairloss_tpu.cli")
 # tests/test_precision_policy.py, so drift is a test failure.
 _PRECISION_CHOICES = ("bf16", "fp32_parity", "mxu")
 
+# The staticcheck pass vocabulary, hardcoded for the same reason
+# (analysis itself is stdlib-only, but the parser stays literal).
+# Pinned == analysis.runner.PASS_NAMES by tests/test_staticcheck.py.
+_STATICCHECK_PASSES = ("purity", "scopes", "locks", "contracts",
+                       "vocab", "markers")
+
 
 def _identity_batch_geometry(d):
     """(identities, images-per-identity) per batch from a MultibatchData
@@ -1630,6 +1636,55 @@ def cmd_watch(args) -> int:
                     for a in summary["active"].values()) else 0
 
 
+def _add_staticcheck_options(sc) -> None:
+    """The staticcheck option vocabulary, restated here so argparse
+    construction stays import-free (the bench-parent contract, like
+    _PRECISION_CHOICES).  Option strings, choices, and defaults are
+    pinned equal to analysis.runner's own parser by
+    tests/test_staticcheck.py — both front doors feed one
+    ``run_from_args``, so drift is a test failure."""
+    sc.add_argument("root", nargs="?", default=None,
+                    help="tree to scan (default: this repo)")
+    sc.add_argument("--pass", dest="passes", action="append",
+                    choices=list(_STATICCHECK_PASSES), metavar="NAME",
+                    help="run only the named pass(es); repeatable "
+                    f"(default: all of {list(_STATICCHECK_PASSES)})")
+    sc.add_argument("--diff", metavar="BASE",
+                    help="restrict findings to files changed since the "
+                    "git ref (the fast incremental ci.sh hook)")
+    sc.add_argument("--allowlist", metavar="PATH",
+                    help="allowlist JSON (default: "
+                    "<root>/scripts/staticcheck_allow.json)")
+    sc.add_argument("--out", metavar="PATH",
+                    default="staticcheck_report.json",
+                    help="where the npairloss-staticcheck-v1 report "
+                    "lands (default %(default)s; '-' disables)")
+    sc.add_argument("--update-timings", dest="update_timings",
+                    metavar="PYTEST_LOG",
+                    help="regenerate tests/timing_history.json from a "
+                    "pytest --durations=0 log, then exit")
+    sc.add_argument("--threshold-s", dest="threshold_s", type=float,
+                    default=10.0,
+                    help="slow-marker threshold recorded by "
+                    "--update-timings (default %(default)s)")
+
+
+def cmd_staticcheck(args) -> int:
+    """``staticcheck [ROOT]`` — the repo-wide invariant linter
+    (docs/STATICCHECK.md): jax-free purity proofs for the contract
+    modules, collective comm-scope coverage, guarded-by lock
+    discipline, versioned-contract drift, vocabulary drift, and
+    tier-1 marker discipline — failing in milliseconds at lint time
+    what the runtime gates can only catch after the fact.  Jax-free
+    end to end: runnable in a venv with no accelerator stack (the
+    package import is lazy; this function imports only
+    ``npairloss_tpu.analysis``)."""
+    from npairloss_tpu.analysis.runner import run_from_args
+
+    return run_from_args(args, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
 def cmd_parse(args) -> int:
     from npairloss_tpu.config import dumps, parse_file
 
@@ -2995,6 +3050,15 @@ def main(argv: Optional[list] = None) -> int:
         "never the in-process engine's alerts.jsonl)",
     )
     w.set_defaults(fn=cmd_watch)
+
+    sc = sub.add_parser(
+        "staticcheck",
+        help="repo-wide invariant linter (docs/STATICCHECK.md) — "
+        "jax-free, enforces the contracts the runtime gates can only "
+        "catch after the fact",
+    )
+    _add_staticcheck_options(sc)
+    sc.set_defaults(fn=cmd_staticcheck)
 
     pp = sub.add_parser("parse", help="parse + dump a prototxt file")
     pp.add_argument("file")

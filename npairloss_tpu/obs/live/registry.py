@@ -131,7 +131,7 @@ class MetricRegistry:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._metrics: Dict[str, Any] = {}
+        self._metrics: Dict[str, Any] = {}  # guarded-by: _lock
 
     def _get(self, name: str, kind, **kw):
         with self._lock:

@@ -137,11 +137,13 @@ class SLOEvaluator:
             raise ValueError(f"duplicate SLO names: {names}")
         self.specs = list(specs)
         self.registry = registry
-        self._burning: Dict[str, bool] = {s.name: False for s in self.specs}
+        self._burning: Dict[str, bool] = \
+            {s.name: False for s in self.specs}  # guarded-by: _lock
         # Hysteresis state is written only by committed evaluations;
         # the lock serializes the tick thread against /healthz scrapes
         # (which evaluate read-only — a monitoring poll must never
-        # advance alerting state, see ``commit``).
+        # advance alerting state, see ``commit``).  The guarded-by
+        # annotation is enforced by `staticcheck` (docs/STATICCHECK.md).
         self._lock = threading.Lock()
 
     def evaluate(self, now: Optional[float] = None,

@@ -381,8 +381,13 @@ def _ring_thresholds(
                 include_an=an_rel)
 
         kcap = stats["topk_same"].shape[1]
-        fits = jax.lax.pmax(
-            stats["count_same"].max(), axis_name) <= kcap
+        # comm marker (obs.fleet.comms): pmax lowers to a (scalar)
+        # all-reduce — unscoped, its bytes would be silently absorbed
+        # by the grad-sync allreduce CLAIM in the fleet reconciliation
+        # instead of being marker-attributed.
+        with jax.named_scope("comm/allreduce"):
+            fits = jax.lax.pmax(
+                stats["count_same"].max(), axis_name) <= kcap
 
         def fast(_):
             n_local = feats.shape[0]

@@ -33,6 +33,11 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               resume must never see)
   ``data.worker``             crash the data prefetch worker (exercises
                               bounded respawn)
+  ``index.commit.crash``      die inside GalleryIndex.save's atomic
+                              commit, after the previous index is
+                              renamed aside but before the new one
+                              lands (loaders must see old-or-new,
+                              never a torn mix)
   ``pipeline.stage``          crash the pipelined loop's device staging
                               thread (exercises clean prefetcher drain +
                               resume, docs/PIPELINE.md)

@@ -167,20 +167,23 @@ class RemediationEngine:
                 f"(registered: {sorted(self._actions)})")
         self.dry_run = bool(dry_run)
         self._clock = clock
+        # The tick runs on the evaluator thread while /healthz scrapes
+        # read last_by_policy: every mutation of the state below holds
+        # the lock (enforced by `staticcheck`, docs/STATICCHECK.md).
         self._lock = threading.Lock()
-        self._seq = 0
-        self._last_attempt_ts: Dict[str, float] = {}
-        self._attempts: Dict[Tuple[str, str], int] = {}
-        self._pending: Dict[str, _Pending] = {}
-        self._last: Dict[str, Dict[str, Any]] = {}  # policy -> last record
+        self._seq = 0  # guarded-by: _lock
+        self._last_attempt_ts: Dict[str, float] = {}  # guarded-by: _lock
+        self._attempts: Dict[Tuple[str, str], int] = {}  # guarded-by: _lock
+        self._pending: Dict[str, _Pending] = {}  # guarded-by: _lock
+        self._last: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
         # Outstanding UNDOs, tracked separately from pendings: an undo
         # must run when its incident resolves even if the attempt that
         # engaged it was long marked failed (a forced load-shed whose
         # budget exhausted must still be RELEASED when the alert
         # clears — an actuator that can engage but not disengage is
         # worse than no actuator).
-        self._undos: Dict[str, Tuple[Callable, Dict[str, Any]]] = {}
-        self.history: List[Dict[str, Any]] = []
+        self._undos: Dict[str, Tuple[Callable, Dict[str, Any]]] = {}  # guarded-by: _lock
+        self.history: List[Dict[str, Any]] = []  # guarded-by: _lock
         self.log_path = os.path.abspath(log_path) if log_path else None
         self._f = None
         if self.log_path:
@@ -206,6 +209,7 @@ class RemediationEngine:
                 continue
             _, _, tail = str(rec.get("id", "")).rpartition("-")
             if tail.isdigit():
+                # unguarded-ok: __init__-only, the engine is unshared
                 self._seq = max(self._seq, int(tail))
 
     # -- the tick ----------------------------------------------------------
@@ -362,7 +366,7 @@ class RemediationEngine:
             rec["detail"] = detail
         return self._emit(rec)
 
-    def _emit(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+    def _emit(self, rec: Dict[str, Any]) -> Dict[str, Any]:  # holds-lock: _lock
         self.history.append(rec)
         self._last[rec["policy"]] = rec
         if self._f is not None and not self._f.closed:

@@ -153,8 +153,8 @@ class RetrievalServer:
         # primary's compiled programs).
         engines = (list(engine) if isinstance(engine, (list, tuple))
                    else [engine])
-        self.engines: List[QueryEngine] = engines
-        self.engine = engines[0]
+        self.engines: List[QueryEngine] = engines  # guarded-by: _lock
+        self.engine = engines[0]  # guarded-by: _lock
         self.cfg = cfg
         self.telemetry = telemetry
         self.preempt = preempt
@@ -162,7 +162,7 @@ class RetrievalServer:
         # drain summary — live-obs on or off) and the optional
         # LiveObservatory (obs.live): /metrics exposition + SLO status
         # on /healthz.  Both default None: the pre-PR server shape.
-        self.freshness = freshness
+        self.freshness = freshness  # guarded-by: _lock
         self.live = live
         # SLO-burn-driven admission control (serve/admission.py): when
         # set, submits consult it BEFORE routing — a shed is a
@@ -186,7 +186,7 @@ class RetrievalServer:
         # compiles_after_warmup key EXPLICIT (present even at zero) so
         # the post-warmup-compile watchdog can observe recovery — clean
         # never-remediated runs keep the absent-when-zero contract.
-        self.swaps = 0
+        self.swaps = 0  # guarded-by: _lock
         self._explicit_compile_key = False
         self.replicaset = ReplicaSet(
             engines, batcher_cfg, self._replica_dispatch,
@@ -199,10 +199,15 @@ class RetrievalServer:
         # would keep an old incident's tail in every later row);
         # the drain/healthz percentiles still read the smoothed ring.
         self._window_lat: list = []
+        # Request threads, the dispatcher, and the hot-swap path all
+        # touch the counters and the published engine tier: mutations
+        # hold the lock (enforced by `staticcheck`, docs/STATICCHECK.md;
+        # the swap attrs engine/engines/freshness/swaps are annotated
+        # where cmd_serve first publishes them, in ``swap_engines``).
         self._lock = threading.Lock()
-        self.queries = 0
-        self.answered = 0
-        self.errors = 0
+        self.queries = 0  # guarded-by: _lock
+        self.answered = 0  # guarded-by: _lock
+        self.errors = 0  # guarded-by: _lock
         self._window_t0 = time.perf_counter()
         self._window_n = 0
         self._last_batch: Dict[str, Any] = {}

@@ -443,6 +443,63 @@ def check_quality_log(path: str,
     return violations
 
 
+# -- staticcheck gate ---------------------------------------------------------
+
+def _load_staticcheck():
+    """File-path-load the ``npairloss_tpu.analysis`` chain WITHOUT
+    importing the package (the jax-free contract).  Unlike the
+    single-file loaders above, the suite is a multi-module package
+    whose driver does ``from npairloss_tpu.analysis import contracts``
+    — so the parent package names are seeded as stub modules and each
+    loaded submodule is set as an attribute on its parent."""
+    import importlib.util
+    import types
+
+    pkg = "npairloss_tpu.analysis"
+    if pkg in sys.modules:
+        return sys.modules[pkg + ".runner"]
+    for stub in ("npairloss_tpu", pkg):
+        if stub not in sys.modules:
+            sys.modules[stub] = types.ModuleType(stub)
+    base = os.path.join(REPO, "npairloss_tpu", "analysis")
+    # Dependency order: leaves first, the driver last.
+    for leaf in ("findings", "tree", "report", "purity", "scopes",
+                 "locks", "contracts", "vocab", "markers", "runner"):
+        name = f"{pkg}.{leaf}"
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(base, leaf + ".py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        setattr(sys.modules[pkg], leaf, mod)
+    return sys.modules[pkg + ".runner"]
+
+
+def check_static(root: str, diff_base: Optional[str] = None) -> List[str]:
+    """Run the invariant linter over ``root`` (docs/STATICCHECK.md):
+    every finding not in the tree's committed allowlist is a
+    violation.  The ci.sh staticcheck-stage wiring — and the teeth the
+    seeded fixture trees under tests/fixtures/staticcheck are held
+    to."""
+    runner = _load_staticcheck()
+    try:
+        report = runner.run_suite(root, diff_base=diff_base)
+    except ValueError as e:
+        return [f"staticcheck could not run: {e}"]
+    violations = [
+        f"staticcheck [{rec['pass']}] {rec['path']}:{rec['line']}: "
+        f"{rec['message']}"
+        for rec in report["findings"]
+    ]
+    if not violations:
+        ran = [p["name"] for p in report["passes"] if not p["skipped"]]
+        skipped = [p["name"] for p in report["passes"] if p["skipped"]]
+        _log(f"staticcheck OK ({', '.join(ran)}"
+             + (f"; skipped: {', '.join(skipped)}" if skipped else "")
+             + f"; {report['summary']['allowlisted']} allowlisted)")
+    return violations
+
+
 # -- the gate -----------------------------------------------------------------
 
 def _ivf_hard_gates(new_rows: Dict[str, Dict]) -> List[str]:
@@ -645,7 +702,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         "recall-floor breach matched by a fired alert, no silently-"
         "stalled shadow scorer — the ci.sh quality-smoke wiring",
     )
+    ap.add_argument(
+        "--static", nargs="?", const=REPO, default=None, metavar="ROOT",
+        help="run the invariant linter (docs/STATICCHECK.md) over ROOT "
+        "(default: this repo) instead of the bench trajectory and fail "
+        "on any finding outside the committed allowlist — the ci.sh "
+        "staticcheck-stage wiring",
+    )
+    ap.add_argument(
+        "--static-diff", dest="static_diff", metavar="BASE",
+        help="with --static: restrict findings to files changed since "
+        "the git ref (the fast incremental hook)",
+    )
     args = ap.parse_args(argv)
+
+    if args.static:
+        violations = check_static(args.static, diff_base=args.static_diff)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (staticcheck over {args.static})")
+        return 0
 
     if args.quality:
         violations = check_quality_log(args.quality,

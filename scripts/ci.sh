@@ -15,6 +15,32 @@ cd "$(dirname "$0")/.."
 echo "== lint: no bare print() in library code =="
 python scripts/check_no_print.py
 
+echo "== invariant staticcheck (docs/STATICCHECK.md) =="
+# Jax-free by contract (the tool never imports jax; JAX_PLATFORMS may
+# be anything): the full suite must run clean — every finding outside
+# scripts/staticcheck_allow.json fails here, in milliseconds, instead
+# of hours into a TPU window.
+python scripts/bench_check.py --static
+# The report artifact is itself a versioned contract: emit + revalidate.
+SC_TMP=$(mktemp -d)
+python -m npairloss_tpu staticcheck --out "$SC_TMP/staticcheck_report.json" >/dev/null
+python - "$SC_TMP/staticcheck_report.json" <<'EOF'
+import json, sys
+sys.path.insert(0, ".")
+from npairloss_tpu.analysis.report import validate_staticcheck_report
+err = validate_staticcheck_report(json.load(open(sys.argv[1])))
+assert err is None, f"staticcheck report invalid: {err}"
+EOF
+# Teeth probe: a seeded-violation fixture tree must be REFUSED — a
+# gate that accepts everything is worse than no gate.
+if python scripts/bench_check.py --static \
+        tests/fixtures/staticcheck/unscoped_collective >/dev/null 2>&1; then
+    echo "FAIL: staticcheck accepted a seeded violation (gate has no teeth)"
+    exit 1
+fi
+rm -rf "$SC_TMP"
+echo "staticcheck OK (suite clean, report valid, gate has teeth)"
+
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi

@@ -1,0 +1,11 @@
+"""Mini CLI whose documented flags exist."""
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    sub = p.add_subparsers(dest="cmd")
+    d = sub.add_parser("demo")
+    d.add_argument("--rounds", type=int, default=1)
+    args = p.parse_args(argv)
+    return 0

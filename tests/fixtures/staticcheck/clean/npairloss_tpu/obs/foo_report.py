@@ -1,0 +1,19 @@
+"""A versioned contract done right: schema constant + validator."""
+FOO_SCHEMA = "npairloss-foo-v1"
+
+FOO_KEYS = ("schema", "value")
+
+
+def build_foo(value):
+    return {"schema": FOO_SCHEMA, "value": value}
+
+
+def validate_foo_report(rec):
+    if not isinstance(rec, dict):
+        return "not an object"
+    if rec.get("schema") != FOO_SCHEMA:
+        return "bad schema"
+    for key in FOO_KEYS:
+        if key not in rec:
+            return f"missing {key!r}"
+    return None

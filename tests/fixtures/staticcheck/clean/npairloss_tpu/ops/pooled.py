@@ -1,0 +1,11 @@
+"""Clean collective usage: every exchange carries its comm marker."""
+import jax
+
+
+def pooled_mean(x, axis_name):
+    with jax.named_scope("pool/gather"), \
+            jax.named_scope("comm/all_gather"):
+        everyone = jax.lax.all_gather(x, axis_name)
+    with jax.named_scope("comm/allreduce"):
+        total = jax.lax.psum(x, axis_name)
+    return everyone, total

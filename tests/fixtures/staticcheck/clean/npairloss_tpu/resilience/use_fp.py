@@ -1,0 +1,6 @@
+"""A documented failpoint call site."""
+from npairloss_tpu.resilience import failpoints
+
+
+def risky_save():
+    failpoints.fire("demo.save.crash")

@@ -1,0 +1,26 @@
+"""Clean lock discipline: every mutation under the declared lock."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self.count += 1
+
+    def _drop_locked(self, key):  # holds-lock: _lock
+        self._items.pop(key, None)
+        self.count -= 1
+
+    def drop(self, key):
+        with self._lock:
+            self._drop_locked(key)
+
+    def debug_reset(self):
+        # unguarded-ok: test-only helper, single-threaded by contract
+        self._items = {}

@@ -1,0 +1,10 @@
+import pytest
+
+
+@pytest.mark.slow
+def test_big_thing():
+    assert True
+
+
+def test_small_thing():  # slow-ok: deliberately kept in tier-1 (fixture)
+    assert True

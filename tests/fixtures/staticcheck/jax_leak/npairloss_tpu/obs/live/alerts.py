@@ -1,0 +1,10 @@
+"""A declared contract module that BREAKS its jax-free contract:
+the helper import below transitively reaches jax at module level."""
+import json
+
+from npairloss_tpu.obs.live.helper import device_count
+
+
+def load_alert_log(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,6 @@
+"""An innocent-looking helper that drags the backend in."""
+import jax
+
+
+def device_count():
+    return jax.device_count()

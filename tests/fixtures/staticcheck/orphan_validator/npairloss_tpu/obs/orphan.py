@@ -1,0 +1,6 @@
+"""A versioned contract nobody can hold anything to."""
+ORPHAN_SCHEMA = "npairloss-orphan-v1"
+
+
+def build_orphan(value):
+    return {"schema": ORPHAN_SCHEMA, "value": value}

@@ -1,0 +1,10 @@
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    sub = p.add_subparsers(dest="cmd")
+    s = sub.add_parser("serve")
+    s.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+    return 0

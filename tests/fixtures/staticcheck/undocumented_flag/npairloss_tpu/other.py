@@ -1,0 +1,5 @@
+from npairloss_tpu.resilience import failpoints
+
+
+def poke():
+    failpoints.fire("other.fault")

@@ -1,0 +1,7 @@
+"""Fires a failpoint the runbook never heard of."""
+from npairloss_tpu.resilience import failpoints
+
+
+def dispatch():
+    if failpoints.should_fire("serve.bogus"):
+        raise OSError("injected")

@@ -1,0 +1,16 @@
+"""A guarded attribute mutated off-lock — the race staticcheck exists
+to catch before a thread does."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}  # guarded-by: _lock
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+
+    def reset(self):
+        self._items = {}

@@ -1,0 +1,2 @@
+def test_giant_compile():
+    assert True

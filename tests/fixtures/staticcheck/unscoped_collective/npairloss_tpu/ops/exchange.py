@@ -1,0 +1,7 @@
+"""A new exchange path that forgot its comm marker."""
+import jax
+
+
+def grad_sync(grads, axis_name):
+    with jax.named_scope("optim/sync"):
+        return jax.lax.psum(grads, axis_name)

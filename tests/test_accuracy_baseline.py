@@ -91,7 +91,7 @@ def test_blockwise_engine_short_replay_converges():
     assert r["final_recall_at_1"] >= 0.9, r
 
 
-def test_vit_trunk_short_replay_converges():
+def test_vit_trunk_short_replay_converges():  # slow-ok: the only ViT-trunk convergence probe in tier-1
     """The ViT trunk (reduced ViT-B/16 proxy) learns through the
     flagship mining config — the transformer family's counterpart of
     the conv-trunk rows in ACCURACY.md."""

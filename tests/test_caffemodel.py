@@ -103,7 +103,7 @@ def plain_params():
     return m.init(jax.random.PRNGKey(0), x, train=False)["params"]
 
 
-def test_googlenet_mapping_covers_trunk(plain_params):
+def test_googlenet_mapping_covers_trunk(plain_params):  # slow-ok: full-trunk caffemodel mapping coverage — the import contract
     mapping = caffe_layer_map()
     # 3 stem convs + 9 stages x 6 branch convs
     assert len(mapping) == 3 + 9 * 6
@@ -284,7 +284,7 @@ def test_resnet_import_applies_caffe_bn_scale_factor(resnet_variables):
     np.testing.assert_array_equal(b, beta)
 
 
-def test_cli_export_from_snapshot(tmp_path, plain_params):
+def test_cli_export_from_snapshot(tmp_path, plain_params):  # slow-ok: end-to-end snapshot->caffemodel export through the real CLI
     """train -> snapshot -> export-caffemodel --snapshot: the deploy
     path for a trunk trained HERE, no msgpack intermediary."""
     from npairloss_tpu import NPairLossConfig
@@ -494,7 +494,7 @@ def test_solverstate_skips_aux_classifier_blobs(plain_params):
     )
 
 
-def test_solver_resumes_from_caffe_solverstate(tmp_path, plain_params):
+def test_solver_resumes_from_caffe_solverstate(tmp_path, plain_params):  # slow-ok: the solverstate resume path has no ci.sh smoke twin
     """Solver.load_caffe_solverstate restores momentum + iteration —
     display/test/snapshot cadence and the lr schedule continue from the
     Caffe run's step."""

@@ -74,7 +74,7 @@ def _irregular_batch(rng, dim=12, max_group=4):
 
 
 @pytest.mark.parametrize("trial", range(8))
-def test_fuzz_dense_oracle_blockwise(trial):
+def test_fuzz_dense_oracle_blockwise(trial):  # slow-ok: the randomized three-way engine fuzz — tier-1's widest net
     rng = np.random.default_rng(20260731 + trial)
     cfg = _random_cfg(rng)
     f, l = _irregular_batch(rng)

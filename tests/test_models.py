@@ -168,7 +168,7 @@ def test_resnet_s2d_stem_exact_equivalence():
     np.testing.assert_allclose(out_s2d, out_std, rtol=1e-4, atol=1e-5)
 
 
-def test_googlenet_bn_trains_from_scratch_spread():
+def test_googlenet_bn_trains_from_scratch_spread():  # slow-ok: the only from-scratch GoogLeNet-BN convergence probe in tier-1
     """Inception-BN variant: BatchNorm after every conv keeps the
     embedding batch SPREAD at random init (the BN-free v1 trunk collapses
     to pairwise sims ~0.9999, which kills mining-based training from

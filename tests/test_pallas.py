@@ -120,7 +120,7 @@ REL_CONFIGS = [
     "cfg_idx,block",
     [(i, 5) for i in range(len(REL_CONFIGS))] + [(0, 6)],
 )
-def test_blockwise_relative_matches_dense(rng, cfg_idx, block):
+def test_blockwise_relative_matches_dense(rng, cfg_idx, block):  # slow-ok: the blockwise-vs-dense mining-grid parity oracle — tier-1's core contract
     """RELATIVE_* thresholds via streamed radix selection must equal the
     dense path's host-sort semantics exactly — loss, aux and grads."""
     cfg = REL_CONFIGS[cfg_idx]
@@ -149,7 +149,7 @@ def test_blockwise_relative_matches_dense(rng, cfg_idx, block):
     np.testing.assert_allclose(gb, gd, rtol=1e-5, atol=1e-7)
 
 
-def test_blockwise_sim_cache_bit_identical(rng):
+def test_blockwise_sim_cache_bit_identical(rng):  # slow-ok: sim-cache bit-identity is the streaming engine's correctness bar
     """The similarity cache (ops.pallas_npair sim_cache) stores exactly
     the fp32 values the recompute path produces, so cached and uncached
     runs must agree BIT-FOR-BIT — loss, aux monitors and gradients — on
@@ -179,7 +179,7 @@ def test_blockwise_sim_cache_bit_identical(rng):
 
 
 @pytest.mark.parametrize("bn,bm", [(4, 7), (7, 4)])
-def test_blockwise_sim_cache_asymmetric_tiles(rng, bn, bm):
+def test_blockwise_sim_cache_asymmetric_tiles(rng, bn, bm):  # slow-ok: ragged-tile cache parity guards the production block shapes
     """Cached sweeps with q_block != block exercise the _simblock index
     maps on a non-square tile grid (incl. padding on both axes); must
     still match the dense path on the flagship config."""
@@ -228,7 +228,7 @@ def test_blockwise_global_relative_int32_overflow_guard():
     )
 
 
-def test_blockwise_relative_clamp_quirk(rng):
+def test_blockwise_relative_clamp_quirk(rng):  # slow-ok: pins the reference's -FLT_MAX clamp quirk bit-exactly
     """A negative-valued relative threshold clamps to -FLT_MAX (cu:288
     etc.); all-negative features force the quirk on the blockwise path."""
     cfg = NPairLossConfig(

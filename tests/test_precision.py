@@ -31,7 +31,7 @@ def test_resolve_matmul_precision():
         resolve_matmul_precision("bf16")
 
 
-def test_default_precision_engines_agree(rng):
+def test_default_precision_engines_agree(rng):  # slow-ok: dense/blockwise/ring agreement under the default policy — the engine-trio contract
     (f,), (l,) = make_identity_batch(rng, num_ids=6, imgs_per_id=2, dim=16)
     f, l = jnp.asarray(f), jnp.asarray(l)
     loss_d, _ = npair_loss_with_aux(
