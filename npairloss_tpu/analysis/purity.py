@@ -64,6 +64,9 @@ CONTRACT_MODULES: Dict[str, str] = {
     "npairloss_tpu/obs/quality/report.py":
         "bench_check --quality file-path-loads the quality-v1 "
         "validator",
+    "npairloss_tpu/gameday/verdict.py":
+        "bench_check --gameday file-path-loads the gameday-v1 "
+        "validator",
     "scripts/bench_check.py":
         "the CI gate itself — must never hang on a backend import",
     "scripts/check_no_print.py":

@@ -1380,7 +1380,9 @@ def cmd_serve(args) -> int:
             BatcherConfig(max_batch=buckets[-1],
                           max_delay_ms=args.deadline_ms,
                           max_queue=args.max_queue),
-            ServerConfig(metrics_window=args.metrics_window),
+            ServerConfig(metrics_window=args.metrics_window,
+                         explicit_drops=getattr(args, "explicit_drops",
+                                                False)),
             telemetry=telemetry, preempt=preempt,
             freshness=freshness, live=live, admission=admission,
             input_shape=input_shape,
@@ -1667,6 +1669,50 @@ def _add_staticcheck_options(sc) -> None:
                     default=10.0,
                     help="slow-marker threshold recorded by "
                     "--update-timings (default %(default)s)")
+
+
+def cmd_gameday(args) -> int:
+    """``gameday --out DIR`` — the production gameday
+    (docs/RESILIENCE.md §Gameday): drive the composed system — trainer
+    snapshotting under ``--resume auto``, replicated serving tier with
+    live-obs + remediation + snapshot/index watching, the watch
+    evaluator — through one deterministic compressed day of traffic
+    while the chaos schedule injects every scripted fault, then write
+    the ``npairloss-gameday-v1`` verdict to ``<out>/gameday.json``.
+    Exit 0 iff the verdict passes (the jax-free twin:
+    ``scripts/bench_check.py --gameday``)."""
+    if args.duration <= 0:
+        log.error("--duration must be > 0, got %s", args.duration)
+        return 1
+    if args.replicas < 2:
+        log.error("--replicas must be >= 2 (the replica-crash entry "
+                  "needs a survivor to reroute to), got %s",
+                  args.replicas)
+        return 1
+    if args.schedule and not os.path.exists(args.schedule):
+        log.error("--schedule not found: %s", args.schedule)
+        return 1
+
+    from npairloss_tpu.gameday.runner import GamedayError, run_gameday
+
+    try:
+        report = run_gameday(
+            args.out, seed=args.seed, duration_s=args.duration,
+            schedule_path=args.schedule, replicas=args.replicas)
+    except GamedayError as e:
+        log.error("gameday run broke: %s", e)
+        return 1
+    print(json.dumps({
+        "verdict": report["verdict"],
+        "failures": report["failures"],
+        "faults": len(report["faults"]),
+        "hot_swaps": report["zero_drop"]["hot_swaps"],
+        "queries_dropped": report["zero_drop"]["queries_dropped"],
+        "answered": report["traffic"]["answered"],
+        "report": os.path.join(os.path.abspath(args.out),
+                               "gameday.json"),
+    }))
+    return 0 if report["verdict"] == "pass" else 1
 
 
 def cmd_staticcheck(args) -> int:
@@ -2835,6 +2881,13 @@ def main(argv: Optional[list] = None) -> int:
         "freshness loop's actuation half; pair with --snapshot for "
         "the initial model)",
     )
+    sv.add_argument(
+        "--explicit-drops", dest="explicit_drops", action="store_true",
+        help="write queries_dropped into the drain summary and "
+        "/healthz even at 0 (the gameday zero-drop posture: zero is "
+        "evidence, not a default — docs/RESILIENCE.md §Gameday); off, "
+        "the key appears only when nonzero",
+    )
     sv_tel = sv.add_mutually_exclusive_group()
     sv_tel.add_argument(
         "--telemetry-dir", dest="telemetry_dir", metavar="DIR",
@@ -3050,6 +3103,30 @@ def main(argv: Optional[list] = None) -> int:
         "never the in-process engine's alerts.jsonl)",
     )
     w.set_defaults(fn=cmd_watch)
+
+    gd = sub.add_parser(
+        "gameday",
+        help="production gameday (docs/RESILIENCE.md §Gameday): "
+        "deterministic traffic + scripted chaos over the composed "
+        "trainer/server/watch group, verdict-gated "
+        "(npairloss-gameday-v1)",
+    )
+    gd.add_argument("--out", required=True, metavar="DIR",
+                    help="run directory for every artifact (answers, "
+                    "telemetry, logs, gameday.json)")
+    gd.add_argument("--seed", type=int, default=0,
+                    help="traffic seed — same seed, same compressed "
+                    "day, byte for byte (default 0)")
+    gd.add_argument("--duration", type=float, default=75.0,
+                    metavar="S",
+                    help="traffic window in seconds (default 75)")
+    gd.add_argument("--schedule", metavar="PATH",
+                    help="chaos schedule JSON (default: the shipped "
+                    "compressed-day schedule)")
+    gd.add_argument("--replicas", type=int, default=2,
+                    help="serving replicas (default 2; >= 2 so the "
+                    "replica-crash entry has a survivor)")
+    gd.set_defaults(fn=cmd_gameday)
 
     sc = sub.add_parser(
         "staticcheck",

@@ -1,0 +1,546 @@
+"""Gameday runner — one supervised chaos window over the composed stack.
+
+Launches the production shape as one process group — a trainer
+snapshotting continuously (``--resume auto``, the supervisor-relaunch
+contract), a replicated serving tier (``--live-obs --remediate
+--watch-snapshots --index-prefix --explicit-drops``, SLO admission,
+shadow scoring), and the offline watch evaluator following the same
+telemetry — then drives the deterministic traffic plan
+(gameday/traffic.py) through it while the chaos schedule
+(gameday/schedule.py) injects faults: failpoints armed via
+``NPAIRLOSS_FAILPOINTS`` in each child's environment, signals delivered
+at their scripted offsets (SIGTERM mid-stream, relaunch same command).
+
+At the end it collects every artifact — answers, alert logs,
+remediation audits, quality windows, metric rows, the fleet report,
+the drain summary — and hands them to gameday/verdict.py, writing the
+``npairloss-gameday-v1`` report to ``<out>/gameday.json``.
+
+This module runs the composed system, so unlike the verdict it may
+import numpy and the package freely; everything it feeds the verdict
+is plain dicts/lists.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from npairloss_tpu.gameday import schedule as chaos
+from npairloss_tpu.gameday import traffic as tg
+from npairloss_tpu.gameday import verdict as gv
+
+log = logging.getLogger("npairloss_tpu.gameday")
+
+# SLO targets the run arms; the verdict judges against the SAME numbers
+# (one source of truth — runner passes them through to the report).
+P99_TARGET_MS = 150.0
+RECALL_FLOOR = 0.9
+MODEL_STALENESS_S = 6.0
+INDEX_STALENESS_S = 30.0
+MIN_HOT_SWAPS = 3
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def _write_json(path: str, obj: Any) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+
+
+def _child_env(failpoints_spec: str = "") -> Dict[str, str]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("NPAIRLOSS_FAILPOINTS", None)
+    if failpoints_spec:
+        env["NPAIRLOSS_FAILPOINTS"] = failpoints_spec
+    return env
+
+
+def _jsonl(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return out
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail — the writer was SIGTERMed
+    return out
+
+
+def _count_fires(paths: Sequence[str]) -> Dict[str, int]:
+    """``failpoint fired: <name>`` occurrences across the child logs —
+    the injection evidence the verdict reconciles declarations
+    against."""
+    fires: Dict[str, int] = {}
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                marker = "failpoint fired: "
+                idx = line.find(marker)
+                if idx >= 0:
+                    name = line[idx + len(marker):].strip()
+                    fires[name] = fires.get(name, 0) + 1
+    return fires
+
+
+class GamedayError(RuntimeError):
+    """The run itself broke (a child died wrong, setup failed) — as
+    opposed to a clean run whose verdict failed."""
+
+
+class _Supervisor:
+    """The process group: launch, signal, drain, never leak."""
+
+    def __init__(self):
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self.files: List[Any] = []
+
+    def open(self, path: str):
+        f = open(path, "wb")
+        self.files.append(f)
+        return f
+
+    def launch(self, name: str, cmd: List[str], *, env: Dict[str, str],
+               stdout, stderr, stdin=None) -> subprocess.Popen:
+        log.info("gameday: launching %s: %s", name, " ".join(cmd))
+        proc = subprocess.Popen(cmd, env=env, stdin=stdin,
+                                stdout=stdout, stderr=stderr,
+                                cwd=_repo_root())
+        self.procs[name] = proc
+        return proc
+
+    def cleanup(self):
+        for name, proc in self.procs.items():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        for f in self.files:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+def _python() -> List[str]:
+    return [sys.executable, "-m", "npairloss_tpu"]
+
+
+def _setup_workspace(out: str, cfg: tg.TrafficConfig):
+    """Gallery, initial index commit, solver config, SLO/policy
+    tables.  Returns (emb, labels, solver_path)."""
+    for sub in ("idx", "snap", "serve_tel", "train_tel"):
+        os.makedirs(os.path.join(out, sub), exist_ok=True)
+    rng = np.random.default_rng(cfg.seed)
+    emb = rng.standard_normal((cfg.catalog, 64)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    labels = (np.arange(cfg.catalog) % 16).astype(np.int32)
+
+    from npairloss_tpu.serve.index import GalleryIndex
+
+    index = GalleryIndex.build(emb, labels, normalize=False)
+    index.save(os.path.join(out, "idx", "g_0000.gidx"))
+
+    solver = os.path.join(out, "solver.prototxt")
+    with open(solver, "w", encoding="utf-8") as f:
+        f.write(
+            'net: "examples/tiny_net.prototxt"\n'
+            "base_lr: 0.05\n"
+            'lr_policy: "fixed"\n'
+            "momentum: 0.9\n"
+            "max_iter: 100000\n"
+            "display: 0\n"
+            "test_interval: 0\n"
+            "test_iter: 0\n"
+            "snapshot: 40\n"
+            f'snapshot_prefix: "{out}/snap/m_"\n'
+        )
+
+    _write_json(os.path.join(out, "slo.json"), {"slos": [
+        {"name": "model_staleness", "metric": "serve_model_age_s",
+         "op": "<=", "target": MODEL_STALENESS_S, "window_s": 2.0,
+         "burn_threshold": 0.5, "min_samples": 1,
+         "severity": "warning"},
+        {"name": "index_staleness", "metric": "serve_index_age_s",
+         "op": "<=", "target": INDEX_STALENESS_S, "window_s": 2.0,
+         "burn_threshold": 0.5, "min_samples": 1,
+         "severity": "warning"},
+        {"name": "serve_p99", "metric": "serve_p99_ms", "op": "<=",
+         "target": P99_TARGET_MS, "window_s": 2.0,
+         "burn_threshold": 0.5, "min_samples": 1,
+         "severity": "critical"},
+        {"name": "serve_recall_floor", "metric": "serve_recall_at_10",
+         "op": ">=", "target": RECALL_FLOOR, "window_s": 2.0,
+         "burn_threshold": 0.5, "min_samples": 1,
+         "severity": "critical"},
+    ]})
+    # Generous budgets: early hot-swap attempts legitimately fail with
+    # NothingNewer while the freshly-launched trainer is still
+    # importing — the policy must retry past that window.
+    _write_json(os.path.join(out, "rem.json"), {"policies": [
+        {"name": "hotswap_model", "slo": "model_staleness",
+         "action": "snapshot_hotswap", "cooldown_s": 3.0,
+         "max_attempts": 10},
+        {"name": "hotswap_index", "slo": "index_staleness",
+         "action": "snapshot_hotswap", "cooldown_s": 3.0,
+         "max_attempts": 10},
+        {"name": "load_shed", "slo": "serve_p99", "action": "load_shed",
+         "cooldown_s": 6.0, "max_attempts": 4},
+    ]})
+    _write_json(os.path.join(out, "train_slo.json"), {"slos": [
+        {"name": "embedding_collapse",
+         "metric": "train_an_threshold_mean", "op": "<=",
+         "target": 0.98, "window_s": 2.0, "burn_threshold": 0.5,
+         "min_samples": 3, "severity": "warning"},
+    ]})
+    _write_json(os.path.join(out, "train_rem.json"), {"policies": [
+        {"name": "trainer_rollback", "slo": "embedding_collapse",
+         "action": "trainer_rollback", "cooldown_s": 6.0,
+         "max_attempts": 5},
+    ]})
+    return emb, labels, solver
+
+
+def _train_cmd(solver: str, out: str) -> List[str]:
+    return _python() + [
+        "train", "--solver", solver, "--model", "mlp", "--synthetic",
+        "--resume", "auto", "--health-metrics",
+        # Retention GC is a CLI knob, not a Caffe solver field — the
+        # prototxt parser would silently drop it, and a 75s compressed
+        # day at CPU step rates commits hundreds of snapshots.
+        "--snapshot-keep", "10",
+        "--telemetry-dir", os.path.join(out, "train_tel"),
+        "--live-obs", "--slo-config", os.path.join(out, "train_slo.json"),
+        "--slo-tick", "0.2", "--remediate",
+        "--remediation-config", os.path.join(out, "train_rem.json"),
+    ]
+
+
+def _serve_cmd(out: str, replicas: int) -> List[str]:
+    return _python() + [
+        "serve", "--index-prefix", os.path.join(out, "idx", "g_"),
+        "--snapshot", os.path.join(out, "boot", "m_iter_40.ckpt"),
+        "--model", "mlp", "--input-size", "8",
+        "--watch-snapshots", os.path.join(out, "snap", "m_"),
+        "--compile-cache", os.path.join(out, "xla_cache"),
+        "--top-k", "10", "--buckets", "1", "--deadline-ms", "1",
+        "--max-queue", "64", "--replicas", str(replicas),
+        "--admission", "slo", "--admission-slos", "serve_p99",
+        "--explicit-drops", "--metrics-window", "4",
+        "--shadow-rate", "1", "--shadow-window", "4",
+        "--telemetry-dir", os.path.join(out, "serve_tel"),
+        "--live-obs", "--slo-config", os.path.join(out, "slo.json"),
+        "--slo-tick", "0.2", "--remediate",
+        "--remediation-config", os.path.join(out, "rem.json"),
+    ]
+
+
+def _feed(plan: tg.TrafficPlan, emb: np.ndarray, stdin, t0: float,
+          state: Dict[str, Any]) -> None:
+    """Pace the plan's query events against the monotonic clock and
+    write them to the tier's stdin.  Writes may block on pipe
+    backpressure while the tier warms or degrades — that only delays
+    later events, it never reorders or drops them."""
+    n = emb.shape[0]
+    for ev in plan.queries:
+        wait = (t0 + ev.t) - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        line = json.dumps({"id": ev.qid,
+                           "embedding": emb[ev.key % n].tolist()})
+        try:
+            stdin.write(line.encode("utf-8") + b"\n")
+            stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            state["feed_error"] = f"serve stdin broke at qid {ev.qid}: {e}"
+            return
+        state["fed"] = state.get("fed", 0) + 1
+
+
+def _ingest(plan: tg.TrafficPlan, emb: np.ndarray,
+            labels: np.ndarray, out: str, t0: float,
+            state: Dict[str, Any]) -> None:
+    """The gallery-growth stream: at each scripted ingest event,
+    ``add()`` a batch of new rows and commit the grown index under the
+    watched prefix — the hot-swap remediation's food supply."""
+    from npairloss_tpu.serve.index import GalleryIndex
+
+    cfg = plan.cfg
+    rng = np.random.default_rng(cfg.seed + 1)
+    grown_emb, grown_labels = emb, labels
+    for ev in plan.ingest:
+        wait = (t0 + ev.t) - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        new = rng.standard_normal((ev.rows, emb.shape[1])
+                                  ).astype(np.float32)
+        new /= np.linalg.norm(new, axis=1, keepdims=True)
+        new_labels = (np.arange(ev.rows) % 16).astype(np.int32)
+        try:
+            index = GalleryIndex.build(grown_emb, grown_labels,
+                                       normalize=False)
+            index.add(new, new_labels, normalize=False)
+            index.save(os.path.join(
+                out, "idx", f"g_{ev.commit_id + 1:04d}.gidx"))
+        except Exception as e:  # noqa: BLE001 — a failed commit is a
+            # run-level fact the verdict should see, not a crash
+            state["ingest_error"] = f"commit {ev.commit_id}: {e}"
+            return
+        grown_emb = np.concatenate([grown_emb, new])
+        grown_labels = np.concatenate([grown_labels, new_labels])
+        state["ingest_commits"] = state.get("ingest_commits", 0) + 1
+
+
+def run_gameday(out: str, *, seed: int = 0, duration_s: float = 75.0,
+                schedule_path: Optional[str] = None,
+                replicas: int = 2) -> Dict[str, Any]:
+    """The whole gameday: setup, launch, drive, drain, verdict.
+    Returns the ``npairloss-gameday-v1`` report (also written to
+    ``<out>/gameday.json``)."""
+    out = os.path.abspath(out)
+    os.makedirs(out, exist_ok=True)
+    entries = (chaos.load_schedule(schedule_path) if schedule_path
+               else chaos.default_schedule(duration_s))
+    cfg = tg.TrafficConfig(seed=seed, duration_s=duration_s,
+                           base_qps=6.0, peak_qps=14.0, burst_qps=45.0,
+                           bursts=2, burst_s=3.0, catalog=256,
+                           zipf_s=1.1, ingest_every_s=10.0,
+                           ingest_rows=16)
+    plan = tg.generate(cfg)
+    with open(os.path.join(out, "traffic.jsonl"), "w",
+              encoding="utf-8") as f:
+        f.write("\n".join(tg.plan_lines(plan)) + "\n")
+    emb, labels, solver = _setup_workspace(out, cfg)
+
+    sup = _Supervisor()
+    state: Dict[str, Any] = {"fed": 0}
+    trainer_exits: List[int] = []
+    try:
+        # Phase 0: one short run commits the INITIAL snapshot the
+        # server restores (and the freshness clock starts from).
+        seed_log = os.path.join(out, "seed.log")
+        with open(seed_log, "wb") as f:
+            rc = subprocess.call(
+                _python() + ["train", "--solver", solver, "--model",
+                             "mlp", "--synthetic", "--max_iter", "40"],
+                env=_child_env(), stdout=f, stderr=subprocess.STDOUT,
+                cwd=_repo_root())
+        seed_snap = os.path.join(out, "snap", "m_iter_40.ckpt",
+                                 "manifest.json")
+        if rc != 0 or not os.path.exists(seed_snap):
+            raise GamedayError(
+                f"seed training failed (rc={rc}); see {seed_log}")
+        # The chaos trainer's retention GC (--snapshot-keep) will delete
+        # m_iter_40 within seconds at CPU step rates — copy it outside
+        # the GC'd prefix so the server's initial --snapshot load can
+        # never race the deletion.
+        boot_snap = os.path.join(out, "boot", "m_iter_40.ckpt")
+        shutil.copytree(os.path.dirname(seed_snap), boot_snap)
+
+        # Launch the group: trainer (chaos-armed), serving tier
+        # (chaos-armed), watch evaluator.
+        trainer = sup.launch(
+            "train", _train_cmd(solver, out),
+            env=_child_env(chaos.env_spec(entries, "train")),
+            stdout=sup.open(os.path.join(out, "train1.log")),
+            stderr=subprocess.STDOUT)
+        serve = sup.launch(
+            "serve", _serve_cmd(out, replicas),
+            env=_child_env(chaos.env_spec(entries, "serve")),
+            stdin=subprocess.PIPE,
+            stdout=sup.open(os.path.join(out, "answers.jsonl")),
+            stderr=sup.open(os.path.join(out, "serve.log")))
+        t0 = time.monotonic()
+
+        feeder = threading.Thread(
+            target=_feed, args=(plan, emb, serve.stdin, t0, state),
+            name="gameday-feed", daemon=True)
+        feeder.start()
+        ingester = threading.Thread(
+            target=_ingest, args=(plan, emb, labels, out, t0, state),
+            name="gameday-ingest", daemon=True)
+        ingester.start()
+
+        # Watch follows the serve telemetry once it exists.
+        serve_metrics = os.path.join(out, "serve_tel", "metrics.jsonl")
+        watch = None
+        observed_signals: Dict[str, int] = {}
+        sigs = chaos.signals(entries, "train")
+        while time.monotonic() - t0 < duration_s:
+            now = time.monotonic() - t0
+            if watch is None and os.path.exists(serve_metrics):
+                watch = sup.launch(
+                    "watch",
+                    _python() + ["watch", os.path.join(out, "serve_tel"),
+                                 "--slo-config",
+                                 os.path.join(out, "slo.json"),
+                                 "--follow", "--poll-s", "0.5",
+                                 "--for", str(duration_s + 30.0)],
+                    env=_child_env(),
+                    stdout=sup.open(os.path.join(out, "watch.log")),
+                    stderr=subprocess.STDOUT)
+            if sigs and now >= sigs[0].at_s:
+                entry = sigs.pop(0)
+                signum = getattr(signal, entry.name, signal.SIGTERM)
+                log.info("gameday: delivering %s to trainer at %.1fs",
+                         entry.name, now)
+                trainer.send_signal(signum)
+                rc = trainer.wait(timeout=60)
+                trainer_exits.append(rc)
+                observed_signals[entry.name] = (
+                    observed_signals.get(entry.name, 0) + 1)
+                if rc != 75:
+                    raise GamedayError(
+                        f"trainer {entry.name} expected exit 75, "
+                        f"got {rc}; see {out}/train1.log")
+                # Relaunch the SAME command — the auto-resume
+                # contract; the consumed chaos env is NOT re-armed.
+                trainer = sup.launch(
+                    "train", _train_cmd(solver, out),
+                    env=_child_env(),
+                    stdout=sup.open(os.path.join(out, "train2.log")),
+                    stderr=subprocess.STDOUT)
+            if serve.poll() is not None:
+                raise GamedayError(
+                    f"serve died mid-window (rc={serve.returncode}); "
+                    f"see {out}/serve.log")
+            if trainer.poll() is not None:
+                raise GamedayError(
+                    f"trainer died mid-window (rc={trainer.returncode})"
+                    f"; see {out}/train1.log")
+            time.sleep(0.25)
+
+        feeder.join(timeout=30.0)
+        time.sleep(3.0)  # let the last swap's resolution land
+
+        # Drain: SIGTERM first (rc 75, the preemption contract), then
+        # EOF on stdin so the reader unblocks.
+        serve.send_signal(signal.SIGTERM)
+        time.sleep(0.2)
+        serve.stdin.close()
+        serve_rc = serve.wait(timeout=120)
+        if serve_rc != 75:
+            raise GamedayError(
+                f"serve drain expected exit 75, got {serve_rc}; "
+                f"see {out}/serve.log")
+        trainer.send_signal(signal.SIGTERM)
+        rc = trainer.wait(timeout=60)
+        trainer_exits.append(rc)
+        if rc != 75:
+            raise GamedayError(
+                f"trainer drain expected exit 75, got {rc}; "
+                f"see {out}/train2.log")
+        if watch is not None:
+            try:
+                watch.wait(timeout=45)
+            except subprocess.TimeoutExpired:
+                watch.terminate()
+                watch.wait(timeout=15)
+        ingester.join(timeout=15.0)
+    finally:
+        sup.cleanup()
+
+    if state.get("feed_error"):
+        raise GamedayError(state["feed_error"])
+    if state.get("ingest_error"):
+        raise GamedayError(f"ingest failed: {state['ingest_error']}")
+
+    return _reconcile(out, entries, plan, state, trainer_exits,
+                      observed_signals, duration_s=duration_s,
+                      seed=seed)
+
+
+def _reconcile(out: str, entries, plan: tg.TrafficPlan,
+               state: Dict[str, Any], trainer_exits: List[int],
+               observed_signals: Dict[str, int], *,
+               duration_s: float, seed: int) -> Dict[str, Any]:
+    """Load every artifact and build the verdict."""
+    answers = _jsonl(os.path.join(out, "answers.jsonl"))
+    drains = [a for a in answers if a.get("event") == "serve_drain"]
+    if not drains:
+        raise GamedayError("no serve_drain summary in answers.jsonl")
+    drain = drains[-1]
+
+    serve_tel = os.path.join(out, "serve_tel")
+    train_tel = os.path.join(out, "train_tel")
+    serve_alerts = _jsonl(os.path.join(serve_tel, "alerts.jsonl"))
+    train_alerts = _jsonl(os.path.join(train_tel, "alerts.jsonl"))
+    serve_rem = _jsonl(os.path.join(serve_tel, "remediation.jsonl"))
+    train_rem = _jsonl(os.path.join(train_tel, "remediation.jsonl"))
+    serve_rows = [r for r in _jsonl(os.path.join(serve_tel,
+                                                 "metrics.jsonl"))
+                  if "p99_ms" in r and "wall_time" in r]
+    quality = [r for r in _jsonl(os.path.join(serve_tel,
+                                              "quality.jsonl"))
+               if r.get("kind") == "window"]
+
+    from npairloss_tpu.obs.fleet.aggregate import build_fleet_report
+
+    try:
+        fleet = build_fleet_report(train_tel)
+        comms = fleet.get("comms", {"available": False})
+    except Exception as e:  # noqa: BLE001 — a missing fleet report is
+        # a reportable fact, not a crash
+        comms = {"available": False, "reason": f"fleet report: {e}"}
+
+    fires = _count_fires([os.path.join(out, name) for name in
+                          ("serve.log", "train1.log", "train2.log")])
+    for name, count in observed_signals.items():
+        fires[name] = fires.get(name, 0) + count
+
+    train2 = os.path.join(out, "train2.log")
+    resumed = False
+    if os.path.exists(train2):
+        with open(train2, "r", encoding="utf-8",
+                  errors="replace") as f:
+            resumed = "resuming from iteration" in f.read()
+
+    report = gv.build_gameday_report(
+        chaos.entry_dicts(entries),
+        traffic={
+            "planned": len(plan.queries),
+            "fed": state.get("fed", 0),
+            "answered": drain.get("answered"),
+            "errors": drain.get("errors"),
+            "rejected": drain.get("rejected"),
+            "sha256": tg.plan_digest(plan),
+        },
+        serve_alerts=serve_alerts, train_alerts=train_alerts,
+        serve_remediation=serve_rem, train_remediation=train_rem,
+        serve_rows=serve_rows, quality_windows=quality,
+        drain=drain, comms=comms,
+        trainer={"segments": len(trainer_exits),
+                 "exit_codes": trainer_exits, "resumed": resumed},
+        observed_fires=fires,
+        client_errors=int(drain.get("errors", 0)),
+        window_s=duration_s, seed=seed,
+        p99_target_ms=P99_TARGET_MS, recall_floor=RECALL_FLOOR,
+        min_hot_swaps=MIN_HOT_SWAPS,
+    )
+    _write_json(os.path.join(out, "gameday.json"), report)
+    log.info("gameday: verdict=%s (%d fault(s), %d hot-swap(s), "
+             "%d/%d answered)",
+             report["verdict"], len(report["faults"]),
+             report["zero_drop"]["hot_swaps"],
+             drain.get("answered", 0), state.get("fed", 0))
+    return report

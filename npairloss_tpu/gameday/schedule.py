@@ -1,0 +1,165 @@
+"""Declarative chaos schedule — scripted faults with declared evidence.
+
+Each :class:`ChaosEntry` arms ONE existing failpoint
+(resilience/failpoints.py; the gameday invents no new fault sites) or
+schedules ONE signal, in the same ``name:count@delay`` grammar the
+``NPAIRLOSS_FAILPOINTS`` env var speaks — and declares, up front, the
+evidence the run must produce: the alert that must fire, the
+remediation that must resolve it, and any extra checks
+(``zero_client_errors``, ``preempt_exit``, ``resume``).  The verdict
+(gameday/verdict.py) holds the run to exactly these declarations: an
+injected fault with no paging/actuation evidence fails the gameday.
+
+Stdlib-only: schedules load in the jax-free gate path too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Sequence, Tuple
+
+TARGETS = ("serve", "train")
+KINDS = ("failpoint", "signal")
+# Extra per-entry checks the verdict knows how to verify.
+EXPECT_CHECKS = ("zero_client_errors", "preempt_exit", "resume")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEntry:
+    """One scripted fault and the evidence it must leave behind.
+
+    ``failpoint`` entries arm ``name:count@delay`` in the target
+    process's environment; ``delay`` counts CHECKS at the site (the
+    grammar's contract), ``at_s`` is advisory wall-clock documentation
+    of roughly when that lands in the window.  ``signal`` entries are
+    delivered by the runner at ``at_s`` (name is the signal, e.g.
+    ``SIGTERM``)."""
+
+    name: str
+    target: str = "serve"
+    kind: str = "failpoint"
+    count: int = 1
+    delay: int = 0
+    at_s: float = 0.0
+    alert: Optional[str] = None        # SLO id that must fire+resolve
+    remediation: Optional[str] = None  # policy that must succeed
+    expect: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("ChaosEntry needs a name")
+        if self.target not in TARGETS:
+            raise ValueError(
+                f"target must be one of {TARGETS}, got {self.target!r}")
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"kind must be one of {KINDS}, got {self.kind!r}")
+        if self.kind == "failpoint" and self.count < 1:
+            raise ValueError(f"count must be >= 1, got {self.count}")
+        if self.delay < 0 or self.at_s < 0:
+            raise ValueError(
+                f"delay/at_s must be >= 0, got {self.delay}/{self.at_s}")
+        if self.remediation and not self.alert:
+            raise ValueError(
+                f"{self.name}: a remediation declaration needs the "
+                "alert that triggers it")
+        bad = [e for e in self.expect if e not in EXPECT_CHECKS]
+        if bad:
+            raise ValueError(
+                f"{self.name}: unknown expect check(s) {bad}; "
+                f"known: {EXPECT_CHECKS}")
+        if self.kind == "signal" and (self.alert or self.remediation):
+            raise ValueError(
+                f"{self.name}: signal entries declare evidence via "
+                "expect checks (preempt_exit/resume), not alerts")
+
+    def spec(self) -> str:
+        """This entry in the env grammar: ``name``, ``name:count`` or
+        ``name:count@delay`` — canonical (no redundant suffixes)."""
+        if self.kind != "failpoint":
+            raise ValueError(f"{self.name} is a {self.kind}, not a "
+                             "failpoint")
+        if self.delay:
+            return f"{self.name}:{self.count}@{self.delay}"
+        if self.count != 1:
+            return f"{self.name}:{self.count}"
+        return self.name
+
+
+def env_spec(entries: Sequence[ChaosEntry], target: str) -> str:
+    """The comma-separated ``NPAIRLOSS_FAILPOINTS`` value arming every
+    failpoint entry aimed at ``target`` ("" = nothing to arm)."""
+    return ",".join(e.spec() for e in entries
+                    if e.kind == "failpoint" and e.target == target)
+
+
+def signals(entries: Sequence[ChaosEntry],
+            target: str) -> List[ChaosEntry]:
+    """Signal entries aimed at ``target``, soonest first."""
+    out = [e for e in entries
+           if e.kind == "signal" and e.target == target]
+    return sorted(out, key=lambda e: e.at_s)
+
+
+def default_schedule(duration_s: float = 75.0) -> List[ChaosEntry]:
+    """The compressed-day schedule (docs/RESILIENCE.md §8): every
+    serving/training fault family, timed so pre-fault health exists
+    (snapshots committed, warmup done, traffic flowing)."""
+    return [
+        # Staleness poisoning: a handful of poisoned freshness probes
+        # after the tier has warmed — drives model_staleness and the
+        # snapshot hot-swap remediation.
+        ChaosEntry(name="serve.stale_model", target="serve",
+                   count=6, delay=10, at_s=0.15 * duration_s,
+                   alert="model_staleness",
+                   remediation="hotswap_model"),
+        # A p99 burst well into the window (delay counts dispatches,
+        # so it lands once real traffic has flowed) — drives serve_p99
+        # and load shedding.
+        ChaosEntry(name="serve.latency", target="serve",
+                   count=40, delay=200, at_s=0.5 * duration_s,
+                   alert="serve_p99", remediation="load_shed"),
+        # One replica dies mid-burst; the reroute contract says no
+        # client ever notices — checked, not alerted.
+        ChaosEntry(name="serve.replica_crash", target="serve",
+                   count=1, delay=120, at_s=0.35 * duration_s,
+                   expect=("zero_client_errors",)),
+        # Embedding collapse after snapshots exist — drives the
+        # embedding-collapse watchdog and the trainer rollback.
+        ChaosEntry(name="train.collapse", target="train",
+                   count=160, delay=60, at_s=0.3 * duration_s,
+                   alert="embedding_collapse",
+                   remediation="trainer_rollback"),
+        # Mid-stream preemption: the trainer must exit 75 with an
+        # emergency snapshot and resume on relaunch.
+        ChaosEntry(name="SIGTERM", target="train", kind="signal",
+                   at_s=0.4 * duration_s,
+                   expect=("preempt_exit", "resume")),
+    ]
+
+
+def entry_dicts(entries: Sequence[ChaosEntry]) -> List[dict]:
+    return [dataclasses.asdict(e) for e in entries]
+
+
+def load_schedule(path: str) -> List[ChaosEntry]:
+    """Load ``{"entries": [...]}`` — validation is ChaosEntry's
+    (loud), so a typo'd target or an impossible declaration fails at
+    load, not at verdict time."""
+    with open(path, "r", encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or "entries" not in obj:
+        raise ValueError(f"{path}: expected an object with 'entries'")
+    entries = []
+    for i, raw in enumerate(obj["entries"]):
+        if not isinstance(raw, dict):
+            raise ValueError(f"{path}: entry {i} is not an object")
+        kwargs = dict(raw)
+        if "expect" in kwargs:
+            kwargs["expect"] = tuple(kwargs["expect"])
+        try:
+            entries.append(ChaosEntry(**kwargs))
+        except TypeError as e:
+            raise ValueError(f"{path}: entry {i}: {e}") from None
+    return entries

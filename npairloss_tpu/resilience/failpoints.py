@@ -56,11 +56,15 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               watchdog and the backpressure path
   ``serve.replica_crash``     kill one serving replica mid-dispatch
                               (serve/replicas.py): its in-flight batch
-                              fails, queued batches on it fail fast, the
+                              and queued batches REROUTE to a surviving
+                              replica (zero client-visible errors), the
                               router stops selecting it, and the
                               remaining replicas absorb the load — the
                               front end's answered+errors+rejected
-                              invariant must hold through the crash
+                              invariant must hold through the crash;
+                              supports ``@delay`` arming so the crash
+                              lands mid-window (docs/RESILIENCE.md
+                              §Gameday)
   ``serve.stale_model``       add ``STALE_AGE_FAULT_S`` to the model age
                               the serving freshness probe publishes —
                               the model-staleness alert fires without

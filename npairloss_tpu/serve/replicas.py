@@ -13,17 +13,20 @@ the tier and a replica restart deserializes from the shared persistent
 compile cache instead of recompiling.
 
 Crash containment: the ``serve.replica_crash`` failpoint
-(docs/RESILIENCE.md) kills a replica mid-dispatch — its in-flight batch
-fails (the front end answers those queries with errors), every batch
-still queued on it fails fast, and the router stops sending it traffic;
-the remaining replicas absorb the load.  The front end's accounting
-invariant (``queries == answered + errors + rejected``) holds through
-the crash — pinned by tests/test_serve_replicas.py and the resilience
-table.
+(docs/RESILIENCE.md) kills a replica mid-dispatch — its in-flight
+batch, and every batch still queued on it, REROUTES to a surviving
+replica (the server's ``_reroute``; replicas share one compiled-program
+set, so the reroute costs no compile), and the router stops sending it
+traffic: the crash is invisible to clients while any replica survives.
+Only a whole-tier loss fails the work to error answers.  The front
+end's accounting invariant (``queries == answered + errors +
+rejected``) holds through the crash — pinned by
+tests/test_serve_replicas.py and the resilience table.
 
 Drain is per-replica: ``close(drain=True)`` drains every live replica's
-queue to answers (the SIGTERM contract), and a dead replica's queue
-fails loudly instead of hanging the drain.
+queue to answers (the SIGTERM contract); a dead replica's queue drains
+by rerouting, and fails loudly — never hangs — when no live replica
+remains.
 """
 
 from __future__ import annotations
@@ -43,8 +46,9 @@ log = logging.getLogger("npairloss_tpu.serve")
 
 
 class ReplicaCrashError(RuntimeError):
-    """A replica died (injected or real) — its in-flight work fails and
-    the router must stop sending it traffic."""
+    """A replica died (injected or real) and no live replica remains to
+    absorb its work — with survivors the work reroutes instead, and
+    this error never reaches a client."""
 
 
 @dataclasses.dataclass
@@ -100,8 +104,9 @@ class ReplicaSet:
 
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         for rep in self.replicas:
-            # A dead replica cannot drain its queue to answers — its
-            # dispatch fails every batch fast, which IS its drain.
+            # A dead replica drains by rerouting its queued batches to
+            # the survivors; with the whole tier down its dispatch
+            # fails every batch fast, which IS its drain.
             rep.batcher.close(drain=drain, timeout=timeout)
 
     # -- routing -----------------------------------------------------------

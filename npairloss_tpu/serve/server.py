@@ -120,11 +120,16 @@ class ServerConfig:
     """``metrics_window``: queries per emitted latency/throughput row
     (0 = none); ``latency_window``: ring capacity for the percentile
     estimate; ``poll_s``: front-end wakeup period for noticing a drain
-    request while idle."""
+    request while idle; ``explicit_drops``: carry ``queries_dropped``
+    in the summary/healthz even at zero (a gameday verdict's zero-drop
+    gate must read a MEASURED 0, not an absent key — the
+    ``compiles_after_warmup`` explicit-key posture; default off keeps
+    clean streams byte-identical to pre-PR)."""
 
     metrics_window: int = 100
     latency_window: int = 1024
     poll_s: float = 0.1
+    explicit_drops: bool = False
 
 
 class RetrievalServer:
@@ -241,25 +246,49 @@ class RetrievalServer:
     def _replica_dispatch(self, replica):
         """Per-replica dispatch wrapper: crash containment around the
         shared answer logic.  The ``serve.replica_crash`` failpoint
-        (docs/RESILIENCE.md) kills THIS replica: its in-flight batch
-        fails (error answers), every batch still queued on it fails
-        fast, and the router stops selecting it."""
-        from npairloss_tpu.serve.replicas import ReplicaCrashError
+        (docs/RESILIENCE.md) kills THIS replica: its in-flight batch —
+        and every batch still queued on it — REROUTES to a surviving
+        replica (zero client-visible errors), and the router stops
+        selecting it.  Only a whole-tier loss fails the work."""
 
         def dispatch(items: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             if not replica.alive:
-                raise ReplicaCrashError(
-                    f"replica {replica.name} is down")
+                return self._reroute(replica, items)
             if failpoints.should_fire("serve.replica_crash"):
                 replica.alive = False
                 log.error("replica %s crashed (injected); %d live "
-                          "replica(s) remain", replica.name,
-                          self.replicaset.alive_count)
-                raise ReplicaCrashError(
-                    f"replica {replica.name} crashed")
+                          "replica(s) remain — rerouting its work",
+                          replica.name, self.replicaset.alive_count)
+                return self._reroute(replica, items)
             return self._dispatch(items, engine=replica.engine)
 
         return dispatch
+
+    def _reroute(self, dead, items: List[Dict[str, Any]]
+                 ) -> List[Dict[str, Any]]:
+        """Dispatch a dead replica's batch on a surviving replica's
+        engine — the ``serve.replica_crash`` containment promise: a
+        replica loss stays invisible to clients while ANY replica
+        survives.  Runs on the dead replica's own dispatcher thread
+        (replicas share one compiled-program set, so the reroute costs
+        no extra compile and never waits on another queue); a
+        whole-tier loss raises, failing the batch to error answers.
+        Deliberately NOT ``replicaset.pick()``: pick counts a
+        whole-tier miss in ``rejected``, and these queries are about to
+        be counted in ``errors`` — one query must land in exactly one
+        term of the drain invariant."""
+        from npairloss_tpu.serve.replicas import ReplicaCrashError
+
+        live = [r for r in self.replicaset.replicas if r.alive]
+        if not live:
+            raise ReplicaCrashError(
+                f"replica {dead.name} is down and no live replica "
+                "remains")
+        target = min(live, key=lambda r: r.batcher.queue_depth)
+        log.warning("rerouting %d quer%s from dead replica %s to %s",
+                    len(items), "y" if len(items) == 1 else "ies",
+                    dead.name, target.name)
+        return self._dispatch(items, engine=target.engine)
 
     # -- telemetry ---------------------------------------------------------
 
@@ -599,13 +628,30 @@ class RetrievalServer:
         call): admit, wait, account latency."""
         return self.handle_many([record], timeout=timeout)[0]
 
+    def _queries_dropped(self) -> int:
+        """The drain invariant's residual: admitted queries no term of
+        ``answered + errors + rejected`` accounts for.  At drain (all
+        batchers closed, every future resolved) a nonzero residual is a
+        real drop — a query the tier swallowed; read mid-flight it also
+        counts queries still in their batch, which is why the key is
+        absent-when-zero unless ``explicit_drops`` asks for the
+        measured 0."""
+        return (self.queries - self.answered - self.errors
+                - self._rejected_total())
+
     def summary(self) -> Dict[str, Any]:
+        dropped = self._queries_dropped()
         return {
             "event": "serve_drain",
             "queries": self.queries,
             "answered": self.answered,
             "errors": self.errors,
             "rejected": self._rejected_total(),
+            # Zero-drop evidence (docs/RESILIENCE.md §Gameday): present
+            # whenever nonzero, and present AT zero when explicit_drops
+            # is on — the gameday zero-drop gate refuses an absent key.
+            **({"queries_dropped": dropped}
+               if (dropped or self.cfg.explicit_drops) else {}),
             "batches": self.replicaset.batches,
             # Replica/admission state only when the feature is on (the
             # single-replica summary keeps its pre-PR shape).

@@ -385,6 +385,73 @@ def _load_quality():
     return sys.modules[name]
 
 
+def _load_gameday():
+    """File-path-load ``gameday.verdict`` (self-contained, stdlib only
+    — the same contract as the alerts/remediate/quality modules)
+    WITHOUT importing the package."""
+    import importlib.util
+
+    name = "npairloss_tpu.gameday.verdict"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO, "npairloss_tpu", "gameday",
+                               "verdict.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def check_gameday_report(path: str) -> List[str]:
+    """Gate one ``npairloss-gameday-v1`` verdict: schema-valid and
+    PASSING per the one contract (validate_gameday_report recomputes
+    every gate from the report's own evidence — schema violations,
+    an unremediated injected fault, an SLO breach outside the declared
+    incident windows, a dropped query, or a tampered ``verdict:
+    "pass"`` are all refused).  When the run directory's serve alert
+    log sits next to the report, the fault blocks are additionally
+    cross-checked against it: a fault claiming its alert fired while
+    the on-disk log shows no firing for that SLO is a fabricated
+    report, refused."""
+    gmod = _load_gameday()
+    try:
+        report = gmod.load_gameday_report(path)
+    except OSError as e:
+        return [f"gameday report {path} unreadable: {e}"]
+    except ValueError as e:
+        return [f"gameday report {path} not JSON: {e}"]
+    err = gmod.validate_gameday_report(report)
+    if err is not None:
+        return [f"gameday verdict refused: {err}"]
+    violations: List[str] = []
+    alerts_path = os.path.join(
+        os.path.dirname(os.path.abspath(path)), "serve_tel",
+        "alerts.jsonl")
+    if os.path.exists(alerts_path):
+        alerts = _load_live_alerts()
+        try:
+            records = alerts.load_alert_log(alerts_path)
+        except OSError as e:
+            return [f"alert log {alerts_path} unreadable: {e}"]
+        fired_slos = {r.get("slo") for r in records
+                      if isinstance(r, dict)
+                      and r.get("state") == "firing"}
+        for fault in report.get("faults", []):
+            if (fault.get("target") == "serve" and fault.get("alert")
+                    and fault.get("alert_fired")
+                    and fault["alert"] not in fired_slos):
+                violations.append(
+                    f"fault {fault.get('name')}: report claims alert "
+                    f"{fault['alert']!r} fired but {alerts_path} shows "
+                    "no firing for it — fabricated evidence")
+    if not violations:
+        zero = report["zero_drop"]
+        _log(f"gameday verdict OK ({len(report['faults'])} fault(s) "
+             f"remediated, {zero['hot_swaps']} hot-swap(s), "
+             f"{zero['queries_dropped']} dropped)")
+    return violations
+
+
 def check_quality_log(path: str,
                       alerts_path: Optional[str] = None) -> List[str]:
     """Gate one ``npairloss-quality-v1`` shadow-recall artifact:
@@ -703,6 +770,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "stalled shadow scorer — the ci.sh quality-smoke wiring",
     )
     ap.add_argument(
+        "--gameday", metavar="PATH",
+        help="gate a gameday verdict instead of the bench trajectory: "
+        "schema-valid (npairloss-gameday-v1) and PASSING — every "
+        "injected fault remediated, SLOs held outside incident "
+        "windows, zero dropped queries across the hot-swaps — with "
+        "the fault blocks cross-checked against the run's serve "
+        "alert log when present — the ci.sh gameday-stage wiring",
+    )
+    ap.add_argument(
         "--static", nargs="?", const=REPO, default=None, metavar="ROOT",
         help="run the invariant linter (docs/STATICCHECK.md) over ROOT "
         "(default: this repo) instead of the bench trajectory and fail "
@@ -723,6 +799,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"REGRESSION: {v}")
             return 1
         print(f"bench_check OK (staticcheck over {args.static})")
+        return 0
+
+    if args.gameday:
+        violations = check_gameday_report(args.gameday)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (gameday verdict {args.gameday})")
         return 0
 
     if args.quality:

@@ -1267,6 +1267,53 @@ python scripts/bench_check.py --quality "$q_dir/ghost/quality.jsonl" > /dev/null
     && { echo "quality smoke: gate ACCEPTED a breach with no alert log"; exit 1; }
 echo "quality observatory smoke OK (clean zero-alert + gate, fault->alert->escalation->resolve, watch agreement, gate teeth)"
 
+echo "== gameday: composed-system soak (docs/RESILIENCE.md §8) =="
+# The whole stack as one production-shaped group — snapshotting trainer
+# (preempted mid-stream, relaunched, resumed), replicated serving tier
+# (SLO admission, shadow scoring, snapshot/index hot-swap), watch
+# evaluator — driven by the seeded compressed day while the chaos
+# schedule arms every fault family.  The npairloss-gameday-v1 verdict
+# IS the pass/fail contract: every injected fault alerted AND
+# remediated, SLOs held outside declared incident windows, zero
+# dropped queries across >= 3 live hot-swaps, comms fully attributed.
+g_dir="$smoke_dir/gameday"
+JAX_PLATFORMS=cpu python -m npairloss_tpu gameday \
+    --out "$g_dir" --seed 0 --duration 75 > "$g_dir.cli.log" 2>&1 \
+    || { echo "gameday: run failed"; tail -30 "$g_dir.cli.log"; \
+         tail -30 "$g_dir/serve.log" 2>/dev/null; exit 1; }
+python scripts/bench_check.py --gameday "$g_dir/gameday.json" \
+    || { echo "gameday: gate refused a passing run"; exit 1; }
+python - "$g_dir" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1] + "/gameday.json"))
+assert r["verdict"] == "pass", r["failures"]
+assert r["zero_drop"]["hot_swaps"] >= 3, r["zero_drop"]
+assert r["zero_drop"]["queries_dropped"] == 0, r["zero_drop"]
+bad = [f["name"] for f in r["faults"] if not f["ok"]]
+assert not bad, bad
+print(f"gameday: {len(r['faults'])} fault(s) injected+remediated, "
+      f"{r['zero_drop']['hot_swaps']} hot-swap(s), 0 dropped, "
+      f"{r['drain']['answered']} answered "
+      f"(traffic sha {r['traffic']['sha256'][:12]})")
+EOF
+# gate teeth: a schema tamper and doctored evidence under a forged
+# "pass" verdict must BOTH be refused (the validator recomputes every
+# gate from the report's own evidence)
+sed 's/npairloss-gameday-v1/npairloss-gameday-v0/' \
+    "$g_dir/gameday.json" > "$g_dir/badschema.json"
+python scripts/bench_check.py --gameday "$g_dir/badschema.json" > /dev/null \
+    && { echo "gameday: gate ACCEPTED a schema violation"; exit 1; }
+python - "$g_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+r = json.load(open(d + "/gameday.json"))
+r["zero_drop"]["queries_dropped"] = 7  # doctored; verdict left "pass"
+json.dump(r, open(d + "/tampered.json", "w"))
+EOF
+python scripts/bench_check.py --gameday "$g_dir/tampered.json" > /dev/null \
+    && { echo "gameday: gate ACCEPTED doctored evidence under a pass verdict"; exit 1; }
+echo "gameday smoke OK (compressed day, scripted chaos, verdict gate + teeth)"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 # `|| rc=$?` keeps set -e from aborting on test failures so the

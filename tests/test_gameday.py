@@ -1,0 +1,322 @@
+"""Gameday harness: traffic determinism, chaos grammar, verdict teeth.
+
+Load-bearing pins (docs/RESILIENCE.md §8):
+  * the traffic plan is a pure function of the seed — same seed, same
+    compressed day BYTE FOR BYTE (``plan_lines``/``plan_digest``), and
+    the day's statistics (Zipf hot-key share, burst amplitude) are
+    pinned so a silent generator regression cannot flatten the load
+    shape the chaos schedule was timed against;
+  * the chaos schedule speaks the existing ``name:count@delay``
+    failpoint grammar exactly, and its validation is loud — a typo'd
+    target or an evidence-free remediation declaration fails at load;
+  * the ``npairloss-gameday-v1`` validator IS the pass/fail contract:
+    it recomputes every gate from the report's own evidence, so a
+    tampered ``verdict: "pass"`` over failing blocks is refused —
+    unremediated faults, SLO breaches outside incident windows,
+    missing/nonzero ``queries_dropped``, too few hot-swaps, and
+    unattributed comms bytes all have teeth.
+
+Everything here is jax-free and fast (tier-1): the gameday's stdlib
+modules must stay importable in gate processes.
+"""
+
+import json
+
+import pytest
+
+from npairloss_tpu.gameday import schedule as chaos
+from npairloss_tpu.gameday import traffic as tg
+from npairloss_tpu.gameday.verdict import (
+    GAMEDAY_SCHEMA,
+    build_gameday_report,
+    incident_windows,
+    validate_gameday_report,
+)
+
+
+# -- traffic: determinism ----------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(seed=0, duration_s=60.0, base_qps=4.0, peak_qps=16.0,
+                burst_qps=60.0, bursts=2, burst_s=2.0, catalog=256,
+                zipf_s=1.1, ingest_every_s=10.0, ingest_rows=16)
+    base.update(kw)
+    return tg.TrafficConfig(**base)
+
+
+def test_same_seed_same_day_byte_for_byte():
+    a = tg.generate(_cfg(seed=7))
+    b = tg.generate(_cfg(seed=7))
+    assert tg.plan_lines(a) == tg.plan_lines(b)
+    assert tg.plan_digest(a) == tg.plan_digest(b)
+
+
+def test_different_seed_different_day():
+    assert (tg.plan_digest(tg.generate(_cfg(seed=0)))
+            != tg.plan_digest(tg.generate(_cfg(seed=1))))
+
+
+def test_plan_lines_round_trip_canonical_json():
+    plan = tg.generate(_cfg())
+    lines = tg.plan_lines(plan)
+    # Header carries the full config; every line parses; keys sorted.
+    head = json.loads(lines[0])
+    assert head["cfg"]["seed"] == 0 and len(head["bursts"]) == 2
+    for line in lines:
+        obj = json.loads(line)
+        assert line == json.dumps(obj, sort_keys=True)
+
+
+def test_ingest_stream_schedule():
+    plan = tg.generate(_cfg(duration_s=60.0, ingest_every_s=10.0))
+    assert [i.commit_id for i in plan.ingest] == [0, 1, 2, 3, 4]
+    assert all(i.rows == 16 for i in plan.ingest)
+    assert tg.generate(_cfg(ingest_every_s=0.0)).ingest == ()
+
+
+# -- traffic: statistical pins -----------------------------------------------
+
+
+def test_zipf_hot_key_skew_pinned():
+    stats = tg.plan_stats(tg.generate(_cfg(duration_s=120.0)))
+    # Zipf(s=1.1, catalog=256): key 0 carries ~13% of mass — order of
+    # magnitude above uniform (1/256 ~ 0.4%).  A flattened sampler
+    # (uniform draw) cannot clear the 0.05 floor.
+    assert stats["top_key"] == 0
+    assert stats["top_key_share"] > 0.05
+    assert stats["distinct_keys"] > 30  # and the tail is long
+
+
+def test_burst_amplitude_pinned():
+    plan = tg.generate(_cfg(duration_s=120.0, bursts=3, burst_s=3.0))
+    stats = tg.plan_stats(plan)
+    # Inside burst windows the rate is burst_qps (60): the realized
+    # windowed rate must sit far above the diurnal peak (16) and in
+    # the neighborhood of the configured amplitude.
+    assert stats["burst_queries"] > 0
+    assert 30.0 < stats["burst_rate_qps"] < 100.0
+    # And the diurnal remainder stays well below burst amplitude.
+    span = 120.0 - 9.0
+    off_rate = (stats["queries"] - stats["burst_queries"]) / span
+    assert off_rate < 20.0
+
+
+def test_traffic_config_validation_is_loud():
+    with pytest.raises(ValueError, match="burst_qps must exceed"):
+        _cfg(burst_qps=10.0)
+    with pytest.raises(ValueError, match="cover the whole window"):
+        _cfg(bursts=30, burst_s=2.0)
+    with pytest.raises(ValueError, match="catalog"):
+        _cfg(catalog=1)
+    with pytest.raises(ValueError, match="base_qps"):
+        _cfg(base_qps=0.0)
+
+
+# -- chaos schedule ----------------------------------------------------------
+
+
+def test_env_spec_speaks_the_failpoint_grammar():
+    entries = chaos.default_schedule(75.0)
+    assert chaos.env_spec(entries, "serve") == (
+        "serve.stale_model:6@10,serve.latency:40@200,"
+        "serve.replica_crash:1@120")
+    assert chaos.env_spec(entries, "train") == "train.collapse:160@60"
+    # Canonical spec drops redundant suffixes.
+    assert chaos.ChaosEntry(name="x.y").spec() == "x.y"
+    assert chaos.ChaosEntry(name="x.y", count=3).spec() == "x.y:3"
+
+
+def test_signals_sorted_and_separated():
+    entries = chaos.default_schedule(75.0)
+    sigs = chaos.signals(entries, "train")
+    assert [s.name for s in sigs] == ["SIGTERM"]
+    assert sigs[0].expect == ("preempt_exit", "resume")
+    assert chaos.signals(entries, "serve") == []
+
+
+def test_chaos_entry_validation_is_loud():
+    with pytest.raises(ValueError, match="target"):
+        chaos.ChaosEntry(name="x", target="db")
+    with pytest.raises(ValueError, match="needs the"):
+        chaos.ChaosEntry(name="x", remediation="p")  # no alert
+    with pytest.raises(ValueError, match="unknown expect"):
+        chaos.ChaosEntry(name="x", expect=("warp_drive",))
+    with pytest.raises(ValueError, match="signal entries"):
+        chaos.ChaosEntry(name="SIGTERM", kind="signal", alert="a")
+    with pytest.raises(ValueError):
+        chaos.ChaosEntry(name="x", kind="signal").spec()
+
+
+def test_load_schedule_round_trip(tmp_path):
+    entries = chaos.default_schedule(75.0)
+    path = tmp_path / "sched.json"
+    path.write_text(json.dumps({"entries": chaos.entry_dicts(entries)}))
+    loaded = chaos.load_schedule(str(path))
+    assert loaded == entries
+    path.write_text(json.dumps({"entries": [{"name": "x", "target": "db"}]}))
+    with pytest.raises(ValueError, match="target"):
+        chaos.load_schedule(str(path))
+
+
+# -- verdict -----------------------------------------------------------------
+
+
+def _alert_pair(aid, slo, t0, t1):
+    base = {"schema": "alerts-v1", "alert_id": aid, "slo": slo,
+            "metric": "m", "severity": "warning", "ts": t0,
+            "fired_at": t0, "bad_fraction": 1.0, "samples": 4,
+            "target": 1.0, "op": "<=", "message": "x"}
+    return [dict(base, state="firing"),
+            dict(base, state="resolved", ts=t1, bad_fraction=0.0)]
+
+
+def _rem(aid, slo, policy, state, t):
+    return {"schema": "remediation-v1", "id": f"r-{aid}", "policy": policy,
+            "action": "act", "alert_id": aid, "slo": slo,
+            "severity": "warning", "state": state, "ts": t, "attempt": 1,
+            "max_attempts": 5, "dry_run": False, "message": "x"}
+
+
+def _passing_report(**over):
+    entries = chaos.entry_dicts(chaos.default_schedule(75.0))
+    serve_alerts = (_alert_pair("a1", "model_staleness", 12.0, 18.0)
+                    + _alert_pair("a2", "serve_p99", 40.0, 46.0))
+    train_alerts = _alert_pair("a3", "embedding_collapse", 25.0, 35.0)
+    kw = dict(
+        traffic={"planned": 400, "fed": 400, "answered": 390,
+                 "errors": 0, "rejected": 10, "sha256": "d" * 64},
+        serve_alerts=serve_alerts, train_alerts=train_alerts,
+        serve_remediation=[
+            _rem("a1", "model_staleness", "hotswap_model", "succeeded",
+                 16.0),
+            _rem("a2", "serve_p99", "load_shed", "succeeded", 44.0)],
+        train_remediation=[
+            _rem("a3", "embedding_collapse", "trainer_rollback",
+                 "succeeded", 30.0)],
+        serve_rows=[{"p99_ms": 40.0, "wall_time": float(t)}
+                    for t in range(0, 76, 5)],
+        quality_windows=[{"recall_at_10": 0.97, "wall_time": float(t)}
+                         for t in range(0, 76, 10)],
+        drain={"queries": 400, "answered": 390, "errors": 0,
+               "rejected": 10, "queries_dropped": 0, "hot_swaps": 4},
+        comms={"available": True, "unattributed_bytes": 0},
+        trainer={"segments": 2, "exit_codes": [75, 75], "resumed": True},
+        observed_fires={"serve.stale_model": 6, "serve.latency": 40,
+                        "serve.replica_crash": 1, "train.collapse": 160,
+                        "SIGTERM": 1},
+        client_errors=0, window_s=75.0, seed=0,
+        p99_target_ms=150.0, recall_floor=0.9, min_hot_swaps=3)
+    kw.update(over)
+    return build_gameday_report(entries, **kw)
+
+
+def test_passing_report_validates():
+    report = _passing_report()
+    assert report["verdict"] == "pass" and report["failures"] == []
+    assert report["schema"] == GAMEDAY_SCHEMA
+    assert validate_gameday_report(report) is None
+
+
+def test_unfired_fault_fails():
+    report = _passing_report(observed_fires={
+        "serve.stale_model": 6, "serve.latency": 40,
+        "train.collapse": 160})  # replica_crash never fired
+    assert report["verdict"] == "fail"
+    assert any("never fired" in f for f in report["failures"])
+    assert "replica_crash" in validate_gameday_report(report)
+
+
+def test_unremediated_fault_fails():
+    report = _passing_report(serve_remediation=[
+        _rem("a1", "model_staleness", "hotswap_model", "failed", 16.0),
+        _rem("a2", "serve_p99", "load_shed", "succeeded", 44.0)])
+    assert any("unremediated" in f for f in report["failures"])
+    err = validate_gameday_report(report)
+    assert err is not None and "unremediated" in err
+
+
+def test_breach_inside_incident_window_excused():
+    # The p99 spike lands inside the serve_p99 alert's window
+    # [40 - 30, 46 + 10]: excused, verdict still passes.
+    rows = [{"p99_ms": 40.0, "wall_time": float(t)}
+            for t in range(0, 76, 5)]
+    rows.append({"p99_ms": 900.0, "wall_time": 42.0})
+    report = _passing_report(serve_rows=rows)
+    assert report["verdict"] == "pass"
+    assert report["slo"]["p99"]["in_incident"] > 0
+
+
+def test_breach_outside_incident_window_fails():
+    rows = [{"p99_ms": 40.0, "wall_time": float(t)}
+            for t in range(0, 76, 5)]
+    rows.append({"p99_ms": 900.0, "wall_time": 74.5})  # outside pads
+    report = _passing_report(serve_rows=rows)
+    assert report["verdict"] == "fail"
+    assert any("p99 breached outside" in f for f in report["failures"])
+
+
+def test_zero_drop_gate_demands_explicit_evidence():
+    report = _passing_report(drain={
+        "queries": 400, "answered": 390, "errors": 0, "rejected": 10,
+        "hot_swaps": 4})  # queries_dropped absent
+    assert any("queries_dropped missing" in f
+               for f in report["failures"])
+    report = _passing_report(drain={
+        "queries": 400, "answered": 383, "errors": 0, "rejected": 10,
+        "queries_dropped": 7, "hot_swaps": 4})
+    assert any("dropped queries: 7" in f for f in report["failures"])
+
+
+def test_too_few_hot_swaps_fails():
+    report = _passing_report(drain={
+        "queries": 400, "answered": 390, "errors": 0, "rejected": 10,
+        "queries_dropped": 0, "hot_swaps": 2})
+    assert any("too few hot-swaps" in f for f in report["failures"])
+
+
+def test_unattributed_comms_bytes_fail_only_when_available():
+    report = _passing_report(comms={"available": True,
+                                    "unattributed_bytes": 12})
+    assert any("unattributed comms" in f for f in report["failures"])
+    report = _passing_report(comms={"available": False,
+                                    "reason": "no fleet_comms.json"})
+    assert report["verdict"] == "pass"
+
+
+def test_tampered_pass_verdict_refused():
+    report = _passing_report(drain={
+        "queries": 400, "answered": 383, "errors": 0, "rejected": 10,
+        "queries_dropped": 7, "hot_swaps": 4})
+    tampered = dict(report, verdict="pass", failures=[])
+    err = validate_gameday_report(tampered)
+    assert err is not None and "dropped queries" in err
+
+
+def test_wrong_schema_tag_refused():
+    report = dict(_passing_report(), schema="npairloss-gameday-v0")
+    assert "schema" in validate_gameday_report(report)
+
+
+def test_missing_block_key_refused():
+    report = _passing_report()
+    bad = dict(report, zero_drop={
+        k: v for k, v in report["zero_drop"].items()
+        if k != "queries_dropped"})
+    assert "zero_drop missing key" in validate_gameday_report(bad)
+    assert "non-empty" in validate_gameday_report(
+        dict(report, faults=[]))
+
+
+def test_incident_windows_pads_and_horizon():
+    wins = incident_windows(
+        _alert_pair("a1", "s", 100.0, 110.0), pad_before_s=30.0,
+        pad_after_s=10.0)
+    assert wins == [{"slo": "s", "alert_id": "a1", "start": 70.0,
+                     "end": 120.0}]
+    # Never-resolved alert stays open to the horizon.
+    firing_only = _alert_pair("a2", "s", 100.0, 110.0)[:1]
+    wins = incident_windows(firing_only, horizon=200.0)
+    assert wins[0]["end"] == 210.0
+    # Torn tail lines are ignored, not fatal.
+    assert incident_windows([{"_bad_line": "x"}]) == []
