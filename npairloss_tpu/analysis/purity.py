@@ -67,6 +67,9 @@ CONTRACT_MODULES: Dict[str, str] = {
     "npairloss_tpu/gameday/verdict.py":
         "bench_check --gameday file-path-loads the gameday-v1 "
         "validator",
+    "npairloss_tpu/obs/qtrace/report.py":
+        "bench_check --qtrace file-path-loads the qtrace-v1 "
+        "validator",
     "scripts/bench_check.py":
         "the CI gate itself — must never hang on a backend import",
     "scripts/check_no_print.py":

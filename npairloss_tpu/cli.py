@@ -1206,6 +1206,12 @@ def cmd_serve(args) -> int:
                   "gauges ride the telemetry rows, and quality.jsonl "
                   "lands there — docs/OBSERVABILITY.md §Quality)")
         return 2
+    if getattr(args, "qtrace", False) and \
+            not getattr(args, "telemetry_dir", None):
+        log.error("--qtrace needs --telemetry-dir (the exemplar "
+                  "artifact qtrace.json lands there — "
+                  "docs/OBSERVABILITY.md §Query tracing)")
+        return 2
 
     if args.compile_cache:
         from npairloss_tpu.pipeline import enable_compile_cache
@@ -1328,6 +1334,7 @@ def cmd_serve(args) -> int:
                                   or getattr(args, "remediate_dry_run",
                                              False)),
                 "shadow_rate": shadow_rate,
+                "qtrace": bool(getattr(args, "qtrace", False)),
             })
 
     if args.admission != "off" and live is None:
@@ -1375,6 +1382,29 @@ def cmd_serve(args) -> int:
             admission = controller_from_args(
                 args.admission_slos, registry=live.registry)
             live.add_listener(admission.on_statuses)
+        qtracer = None
+        if getattr(args, "qtrace", False):
+            from npairloss_tpu.obs.qtrace import QTraceConfig, QueryTracer
+
+            slo_ms = float(getattr(args, "qtrace_slo_ms", 0.0) or 0.0)
+            if slo_ms <= 0 and live is not None:
+                # Default the per-query SLO to the armed p99 watchdog's
+                # target: one latency bar, two enforcement points (the
+                # pager on the aggregate, the exemplar on the query).
+                for spec in specs:
+                    if spec.metric == "serve_p99_ms" and spec.op == "<=":
+                        slo_ms = float(spec.target)
+                        break
+            if slo_ms <= 0:
+                slo_ms = 250.0
+            qtracer = QueryTracer(
+                QTraceConfig(
+                    exemplars=args.qtrace_exemplars, slo_ms=slo_ms),
+                registry=live.registry if live is not None else None,
+                out_path=os.path.join(tel_dir, "qtrace.json"),
+            )
+            log.info("query tracing armed: slo %.1f ms, %d exemplars",
+                     slo_ms, args.qtrace_exemplars)
         server = RetrievalServer(
             engines,
             BatcherConfig(max_batch=buckets[-1],
@@ -1385,7 +1415,7 @@ def cmd_serve(args) -> int:
                                                 False)),
             telemetry=telemetry, preempt=preempt,
             freshness=freshness, live=live, admission=admission,
-            input_shape=input_shape,
+            input_shape=input_shape, qtrace=qtracer,
         )
         if shadow_rate > 0:
             # Quality observatory (docs/OBSERVABILITY.md §Quality):
@@ -1582,6 +1612,40 @@ def cmd_serve(args) -> int:
                 telemetry.close()
             except Exception as e:  # noqa: BLE001
                 log.error("telemetry close failed: %s", e)
+
+
+def cmd_timeline(args) -> int:
+    """``timeline RUNDIR`` — merge every timeline source under a run
+    directory (trainer rank traces, the serve host trace, qtrace
+    exemplar span trees, alert/remediation/chaos instants) into one
+    Perfetto-loadable ``timeline.json`` (docs/OBSERVABILITY.md §Query
+    tracing).  Stdlib-only: runs on any box that can read the
+    artifacts."""
+    from npairloss_tpu.obs.fleet.merge_traces import merge_timeline
+    from npairloss_tpu.obs.tracing import validate_chrome_trace
+
+    run_dir = os.path.abspath(args.run_dir)
+    if not os.path.isdir(run_dir):
+        log.error("timeline: %s is not a directory", run_dir)
+        return 2
+    path, merged = merge_timeline(run_dir, out_path=args.out)
+    if path is None:
+        log.error(
+            "timeline: no mergeable source under %s (looked for rank "
+            "traces, serve_tel/trace.json, qtrace.json, alerts.jsonl, "
+            "remediation.jsonl, gameday.json)", run_dir)
+        return 1
+    err = validate_chrome_trace(merged)
+    if err is not None:
+        log.error("merged timeline failed trace validation: %s", err)
+        return 1
+    sources = merged["otherData"]["sources"]
+    log.info("timeline: %d event(s) from %s", len(merged["traceEvents"]),
+             ", ".join(k for k, v in sources.items() if v))
+    print(json.dumps({"timeline": path,
+                      "events": len(merged["traceEvents"]),
+                      "sources": sources}))
+    return 0
 
 
 def cmd_watch(args) -> int:
@@ -2888,6 +2952,30 @@ def main(argv: Optional[list] = None) -> int:
         "evidence, not a default — docs/RESILIENCE.md §Gameday); off, "
         "the key appears only when nonzero",
     )
+    sv.add_argument(
+        "--qtrace", action="store_true",
+        help="per-query tracing (docs/OBSERVABILITY.md §Query "
+        "tracing): per-stage spans from admission to answer, always-on "
+        "stage histograms + p99 budget decomposition, and the "
+        "npairloss-qtrace-v1 exemplar artifact (qtrace.json in the "
+        "telemetry dir; SLO-violating and slowest-tail queries keep "
+        "full span trees) — needs --telemetry-dir; off (default) "
+        "keeps every stream byte-identical",
+    )
+    sv.add_argument(
+        "--qtrace-exemplars", dest="qtrace_exemplars", type=int,
+        default=64, metavar="N",
+        help="exemplar ring capacity — full span trees retained for "
+        "the worst queries (default 64; evicts the fastest retained "
+        "exemplar when full)",
+    )
+    sv.add_argument(
+        "--qtrace-slo-ms", dest="qtrace_slo_ms", type=float,
+        default=0.0, metavar="MS",
+        help="per-query latency SLO for exemplar retention + the "
+        "violations counter (default 0 = the armed serve_p99 "
+        "watchdog's target when --live-obs is on, else 250)",
+    )
     sv_tel = sv.add_mutually_exclusive_group()
     sv_tel.add_argument(
         "--telemetry-dir", dest="telemetry_dir", metavar="DIR",
@@ -2899,6 +2987,20 @@ def main(argv: Optional[list] = None) -> int:
         help="span tracing only (serve/admit|batch|dispatch|topk)",
     )
     sv.set_defaults(fn=cmd_serve)
+
+    tl = sub.add_parser(
+        "timeline",
+        help="merge a run directory's timeline sources (trainer rank "
+        "traces, serve host spans, qtrace exemplar span trees, "
+        "alert/remediation/chaos instants) into one Perfetto-loadable "
+        "timeline.json — docs/OBSERVABILITY.md §Query tracing",
+    )
+    tl.add_argument("run_dir", metavar="RUNDIR",
+                    help="run/telemetry directory (gameday out dirs "
+                    "with serve_tel/ + train_tel/ work as-is)")
+    tl.add_argument("--out", default=None, metavar="PATH",
+                    help="output path (default: RUNDIR/timeline.json)")
+    tl.set_defaults(fn=cmd_timeline)
 
     im = sub.add_parser(
         "import-caffemodel",

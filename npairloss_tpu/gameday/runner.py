@@ -252,6 +252,10 @@ def _serve_cmd(out: str, replicas: int) -> List[str]:
         "--live-obs", "--slo-config", os.path.join(out, "slo.json"),
         "--slo-tick", "0.2", "--remediate",
         "--remediation-config", os.path.join(out, "rem.json"),
+        # Per-query tracing: the p99-attribution verdict check reads
+        # the qtrace_dominant window rows and the qtrace.json reroute
+        # counters this arms (docs/OBSERVABILITY.md §Query tracing).
+        "--qtrace", "--qtrace-slo-ms", str(P99_TARGET_MS),
     ]
 
 
@@ -494,6 +498,23 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
                                               "quality.jsonl"))
                if r.get("kind") == "window"]
 
+    # Qtrace evidence for the p99-attribution check: totals (reroute /
+    # hot-swap markers) + the rolling budget decomposition.  A missing
+    # or torn artifact is a reportable fact — the stage-declaring
+    # faults will fail their attribution gate, which is the point.
+    qtrace_block: Dict[str, Any] = {"available": False}
+    try:
+        with open(os.path.join(serve_tel, "qtrace.json"), "r",
+                  encoding="utf-8") as f:
+            qt = json.load(f)
+        if isinstance(qt, dict) and isinstance(qt.get("totals"), dict):
+            qtrace_block = {"available": True,
+                            "totals": qt["totals"],
+                            "budget": qt.get("budget", {}),
+                            "slo_ms": qt.get("slo_ms")}
+    except (OSError, ValueError) as e:
+        qtrace_block = {"available": False, "reason": str(e)}
+
     from npairloss_tpu.obs.fleet.aggregate import build_fleet_report
 
     try:
@@ -535,9 +556,21 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
         client_errors=int(drain.get("errors", 0)),
         window_s=duration_s, seed=seed,
         p99_target_ms=P99_TARGET_MS, recall_floor=RECALL_FLOOR,
-        min_hot_swaps=MIN_HOT_SWAPS,
+        min_hot_swaps=MIN_HOT_SWAPS, qtrace=qtrace_block,
     )
     _write_json(os.path.join(out, "gameday.json"), report)
+    try:
+        # One Perfetto file for the whole day: trainer rank lanes,
+        # serve spans + exemplar query trees, chaos/alert/remediation
+        # instants (obs/fleet/merge_traces.py).  Evidence, not a gate —
+        # a failed merge is logged, never fatal.
+        from npairloss_tpu.obs.fleet.merge_traces import merge_timeline
+
+        tl_path, _ = merge_timeline(out)
+        if tl_path:
+            log.info("gameday: merged timeline at %s", tl_path)
+    except Exception as e:  # noqa: BLE001 — the timeline is evidence
+        log.error("gameday: timeline merge failed: %s", e)
     log.info("gameday: verdict=%s (%d fault(s), %d hot-swap(s), "
              "%d/%d answered)",
              report["verdict"], len(report["faults"]),

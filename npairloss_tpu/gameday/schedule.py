@@ -23,6 +23,13 @@ TARGETS = ("serve", "train")
 KINDS = ("failpoint", "signal")
 # Extra per-entry checks the verdict knows how to verify.
 EXPECT_CHECKS = ("zero_client_errors", "preempt_exit", "resume")
+# Declarable p99-attribution evidence: the qtrace stage the fault's
+# incident window must show as dominant (the obs.qtrace stage
+# vocabulary — restated here because the gate path loads this module
+# without the package), plus "reroute" for faults whose signature is
+# the crash-reroute marker rather than a stage.
+STAGE_CHECKS = ("admit_wait", "queue_wait", "batch_assemble",
+                "dispatch", "score", "topk_merge", "reroute")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +52,7 @@ class ChaosEntry:
     alert: Optional[str] = None        # SLO id that must fire+resolve
     remediation: Optional[str] = None  # policy that must succeed
     expect: Tuple[str, ...] = ()
+    stage: Optional[str] = None        # dominant qtrace stage expected
 
     def __post_init__(self):
         if not self.name:
@@ -73,6 +81,16 @@ class ChaosEntry:
             raise ValueError(
                 f"{self.name}: signal entries declare evidence via "
                 "expect checks (preempt_exit/resume), not alerts")
+        if self.stage is not None:
+            if self.stage not in STAGE_CHECKS:
+                raise ValueError(
+                    f"{self.name}: unknown stage {self.stage!r}; "
+                    f"known: {STAGE_CHECKS}")
+            if self.stage != "reroute" and not self.alert:
+                raise ValueError(
+                    f"{self.name}: a stage declaration needs the alert "
+                    "whose incident window anchors the attribution "
+                    "check (reroute is marker-counted, not windowed)")
 
     def spec(self) -> str:
         """This entry in the env grammar: ``name``, ``name:count`` or
@@ -116,15 +134,20 @@ def default_schedule(duration_s: float = 75.0) -> List[ChaosEntry]:
                    remediation="hotswap_model"),
         # A p99 burst well into the window (delay counts dispatches,
         # so it lands once real traffic has flowed) — drives serve_p99
-        # and load shedding.
+        # and load shedding.  The declared stage is queue_wait, not
+        # dispatch: on a saturated single-slot tier only the first
+        # stalled query pays the stall as dispatch time — everyone
+        # behind it pays it as queue wait (the ci.sh qtrace smoke
+        # covers the throttled-traffic case where dispatch dominates).
         ChaosEntry(name="serve.latency", target="serve",
                    count=40, delay=200, at_s=0.5 * duration_s,
-                   alert="serve_p99", remediation="load_shed"),
+                   alert="serve_p99", remediation="load_shed",
+                   stage="queue_wait"),
         # One replica dies mid-burst; the reroute contract says no
         # client ever notices — checked, not alerted.
         ChaosEntry(name="serve.replica_crash", target="serve",
                    count=1, delay=120, at_s=0.35 * duration_s,
-                   expect=("zero_client_errors",)),
+                   expect=("zero_client_errors",), stage="reroute"),
         # Embedding collapse after snapshots exist — drives the
         # embedding-collapse watchdog and the trainer rollback.
         ChaosEntry(name="train.collapse", target="train",

@@ -9,6 +9,13 @@ report, and :func:`validate_gameday_report` IS the pass/fail contract:
   * every injected fault fired, its declared alert fired AND resolved,
     and its declared remediation succeeded (signal faults: exit 75 +
     a resumed segment);
+  * every fault that declares a ``stage`` shows it in the qtrace
+    evidence (the ``serve.p99_attribution`` check): the dominant stage
+    of the worst decomposed window row inside the fault's own incident
+    windows must match the declaration — or, for ``reroute``, the
+    qtrace artifact must have counted crash-reroute markers — so the
+    per-stage attribution is proven against scripted faults, not
+    decorative;
   * p99 and shadow recall held on every metric row OUTSIDE the
     declared incident windows (injected faults are supposed to breach
     — each fired alert opens a window ``[fired_at - pad_before,
@@ -39,15 +46,16 @@ GAMEDAY_SCHEMA = "npairloss-gameday-v1"
 # Top-level keys every report carries, in order.
 REPORT_KEYS = (
     "schema", "window_s", "seed", "traffic", "faults", "incidents",
-    "slo", "drain", "zero_drop", "comms", "trainer", "verdict",
-    "failures",
+    "slo", "drain", "zero_drop", "comms", "trainer", "qtrace",
+    "verdict", "failures",
 )
 TRAFFIC_KEYS = ("planned", "fed", "answered", "errors", "rejected",
                 "sha256")
 FAULT_KEYS = (
     "name", "target", "kind", "count", "delay", "at_s", "alert",
-    "remediation", "expect", "observed_fires", "fired", "alert_fired",
-    "alert_resolved", "remediation_state", "checks", "ok",
+    "remediation", "expect", "stage", "observed_fires", "fired",
+    "alert_fired", "alert_resolved", "remediation_state",
+    "stage_observed", "checks", "ok",
 )
 P99_KEYS = ("target_ms", "rows", "in_incident", "breaches_outside",
             "worst_outside_ms")
@@ -151,19 +159,54 @@ def _remediation_state(records: Sequence[Dict[str, Any]], policy: str
     return "missing"
 
 
+def _observed_stage(entry: Dict[str, Any], *, windows, serve_rows,
+                    qtrace: Optional[Dict[str, Any]]) -> str:
+    """The ``serve.p99_attribution`` evidence for one fault: the
+    qtrace dominant stage of the WORST decomposed row inside the
+    fault's own alert windows (the row where the fault bit hardest) —
+    or ``"reroute"`` when the artifact counted crash-reroute markers
+    (a reroute is a marker, not a stage, so it has no window).  ""
+    means no evidence: qtrace off, no decomposed rows, no markers."""
+    if entry.get("stage") == "reroute":
+        reroutes = int(((qtrace or {}).get("totals") or {})
+                       .get("reroutes", 0))
+        return "reroute" if reroutes > 0 else ""
+    mine = [w for w in windows if w.get("slo") == entry.get("alert")]
+    best, best_ms = "", -1.0
+    for row in serve_rows:
+        if not isinstance(row, dict) or "wall_time" not in row:
+            continue
+        stage = row.get("qtrace_dominant")
+        ms = row.get("qtrace_dominant_ms")
+        if not stage or not isinstance(ms, (int, float)):
+            continue
+        if not _in_windows(float(row["wall_time"]), mine):
+            continue
+        if ms > best_ms:
+            best, best_ms = str(stage), float(ms)
+    return best
+
+
 def _eval_fault(entry: Dict[str, Any], *, alerts, remediation,
                 observed_fires: Dict[str, int], client_errors: int,
-                trainer: Dict[str, Any]) -> Dict[str, Any]:
+                trainer: Dict[str, Any], windows=(), serve_rows=(),
+                qtrace: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
     name = entry["name"]
     kind = entry.get("kind", "failpoint")
     observed = int(observed_fires.get(name, 0))
     fired = observed > 0
     alert = entry.get("alert")
     remedy = entry.get("remediation")
+    stage = entry.get("stage")
     alert_fired = alert_resolved = False
     if alert:
         alert_fired, alert_resolved = _alert_events(alerts, alert)
     state = _remediation_state(remediation, remedy) if remedy else None
+    stage_observed = (_observed_stage(entry, windows=windows,
+                                      serve_rows=serve_rows,
+                                      qtrace=qtrace)
+                      if stage else "")
     checks: Dict[str, bool] = {}
     for check in entry.get("expect") or ():
         if check == "zero_client_errors":
@@ -181,6 +224,8 @@ def _eval_fault(entry: Dict[str, Any], *, alerts, remediation,
             ok = ok and alert_fired and alert_resolved
         if remedy:
             ok = ok and state == "succeeded"
+    if stage:
+        ok = ok and stage_observed == stage
     return {
         "name": name, "target": entry.get("target", "serve"),
         "kind": kind, "count": int(entry.get("count", 1)),
@@ -188,9 +233,11 @@ def _eval_fault(entry: Dict[str, Any], *, alerts, remediation,
         "at_s": float(entry.get("at_s", 0.0)),
         "alert": alert, "remediation": remedy,
         "expect": list(entry.get("expect") or ()),
+        "stage": stage,
         "observed_fires": observed, "fired": fired,
         "alert_fired": alert_fired, "alert_resolved": alert_resolved,
-        "remediation_state": state, "checks": checks, "ok": ok,
+        "remediation_state": state, "stage_observed": stage_observed,
+        "checks": checks, "ok": ok,
     }
 
 
@@ -219,6 +266,7 @@ def build_gameday_report(
     pad_before_s: float = 30.0,
     pad_after_s: float = 10.0,
     min_hot_swaps: int = 3,
+    qtrace: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble (and self-judge) the report.  Inputs are plain dicts/
     lists — the runner loads the artifacts; this function only
@@ -239,7 +287,8 @@ def build_gameday_report(
                      if e.get("target", "serve") == "serve"
                      else train_remediation),
         observed_fires=observed_fires, client_errors=client_errors,
-        trainer=trainer) for e in entries]
+        trainer=trainer, windows=windows, serve_rows=serve_rows,
+        qtrace=qtrace) for e in entries]
 
     n, inside, breaches, worst = _slo_gate(
         serve_rows, "p99_ms", lambda v: v > p99_target_ms, windows)
@@ -279,6 +328,8 @@ def build_gameday_report(
         "zero_drop": zero_drop,
         "comms": dict(comms),
         "trainer": {key: trainer.get(key) for key in TRAINER_KEYS},
+        "qtrace": (dict(qtrace) if isinstance(qtrace, dict)
+                   else {"available": False}),
         "verdict": "fail",
         "failures": [],
     }
@@ -309,6 +360,12 @@ def _gate_failures(report: Dict[str, Any]) -> List[str]:
                 f"unremediated injected fault: {name} (remediation "
                 f"{fault.get('remediation')} state="
                 f"{fault.get('remediation_state')})")
+        elif (fault.get("stage")
+              and fault.get("stage_observed") != fault.get("stage")):
+            failures.append(
+                f"p99 attribution mismatch: {name} declared stage "
+                f"{fault.get('stage')!r}, evidence showed "
+                f"{fault.get('stage_observed') or 'nothing'!r}")
         else:
             bad = [c for c, ok in (fault.get("checks") or {}).items()
                    if not ok]
@@ -393,6 +450,9 @@ def validate_gameday_report(obj: Any) -> Optional[str]:
         return "incidents must be a list"
     if not isinstance(obj["failures"], list):
         return "failures must be a list"
+    if not isinstance(obj["qtrace"], dict):
+        return "qtrace must be an object (the summarized qtrace "\
+               "evidence, or {\"available\": false})"
 
     # Recompute the gates from the evidence; the stored verdict and
     # failures must agree with them.

@@ -1,0 +1,38 @@
+"""Query-level tracing: per-stage tail-latency attribution from socket
+to answer (docs/OBSERVABILITY.md §Query tracing).
+
+``QueryTracer`` assigns a trace id at ingestion and records one span
+per serving-tier stage; exemplar span trees for SLO-violating and
+slowest-tail queries land in the versioned ``npairloss-qtrace-v1``
+artifact (contract: :mod:`npairloss_tpu.obs.qtrace.report`, jax-free,
+gated by ``bench_check --qtrace``), and the rolling p99 budget
+decomposition surfaces in ``/healthz``, window rows, and the drain
+summary.  The fleet merger folds the exemplars and markers into one
+Perfetto timeline next to trainer rank lanes and gameday instants.
+"""
+
+from npairloss_tpu.obs.qtrace.core import (
+    QTraceConfig,
+    QueryTrace,
+    QueryTracer,
+)
+from npairloss_tpu.obs.qtrace.report import (
+    MARKER_NAMES,
+    QTRACE_SCHEMA,
+    STAGES,
+    load_qtrace_report,
+    qtrace_p99_consistency,
+    validate_qtrace_report,
+)
+
+__all__ = [
+    "MARKER_NAMES",
+    "QTRACE_SCHEMA",
+    "QTraceConfig",
+    "QueryTrace",
+    "QueryTracer",
+    "STAGES",
+    "load_qtrace_report",
+    "qtrace_p99_consistency",
+    "validate_qtrace_report",
+]

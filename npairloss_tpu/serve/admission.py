@@ -148,9 +148,13 @@ class AdmissionController:
 
     # -- the gate ----------------------------------------------------------
 
-    def admit(self) -> bool:
+    def admit(self, trace=None) -> bool:
         """True = admit this query; False = shed it (the caller rejects
-        with backpressure and counts it in ``rejected``)."""
+        with backpressure and counts it in ``rejected``).  ``trace``
+        (optional) is the query's qtrace context: a probe-trickle
+        admission stamps it, so an exemplar that was admitted WHILE
+        shedding is readable as the deliberate measured pulse it is —
+        its tail latency indicts the overload, not the gate."""
         with self._lock:
             if not (self.shedding or self.forced):
                 return True
@@ -159,6 +163,8 @@ class AdmissionController:
                     self._since_probe >= self.cfg.probe_every:
                 self._since_probe = 0
                 self.probes_admitted += 1
+                if trace is not None:
+                    trace.probe = True
                 if self.registry is not None:
                     self.registry.inc("serve_probe_admitted")
                 return True

@@ -41,6 +41,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -547,7 +548,8 @@ class QueryEngine:
         return np.asarray(emb)[:n]
 
     def query(
-        self, embeddings: np.ndarray, normalize: bool = True
+        self, embeddings: np.ndarray, normalize: bool = True,
+        stages: Optional[Dict[str, float]] = None,
     ) -> Dict[str, np.ndarray]:
         """Top-k for ``(B, D)`` query embeddings.
 
@@ -555,6 +557,14 @@ class QueryEngine:
         largest), dispatches the jitted streamed/sharded top-k, and maps
         winning gallery rows to labels/ids host-side.  Returns
         ``{"scores", "rows", "labels", "ids"}``, each (B, top_k).
+
+        ``stages`` (optional) is a per-call accumulator the qtrace
+        layer passes in: the device top-k wall time lands in
+        ``score_us`` and the host label/id gather in ``merge_us``,
+        summed across bucket chunks.  Per-call (not an engine
+        attribute) on purpose — a crash reroute dispatches two batches
+        on one engine concurrently, and racing attributes would charge
+        one batch's score time to the other's trace.
         """
         q = np.asarray(embeddings, np.float32)
         if q.ndim != 2 or q.shape[1] != self.index.dim:
@@ -573,7 +583,7 @@ class QueryEngine:
         if normalize:
             q = l2_normalize_rows(q)
         max_b = self.cfg.buckets[-1]
-        outs = [self._query_bucketed(q[i:i + max_b])
+        outs = [self._query_bucketed(q[i:i + max_b], stages=stages)
                 for i in range(0, q.shape[0], max_b)]
         return {
             key: np.concatenate([o[key] for o in outs])
@@ -599,7 +609,10 @@ class QueryEngine:
         return ((idx.emb, idx.labels, idx.valid),
                 ("topk", bucket, idx.padded_size, idx.dim))
 
-    def _query_bucketed(self, q: np.ndarray) -> Dict[str, np.ndarray]:
+    def _query_bucketed(
+        self, q: np.ndarray,
+        stages: Optional[Dict[str, float]] = None,
+    ) -> Dict[str, np.ndarray]:
         n = q.shape[0]
         bucket = self.bucket_for(n)
         if bucket > n:
@@ -621,17 +634,27 @@ class QueryEngine:
             q = -q
         args, sig = self._topk_call(bucket)
         n_before = self._cache_size()
+        t_score = time.perf_counter()
         with self._span("serve/topk", batch=n, bucket=bucket):
             scores, rows = self._topk_fn(jnp.asarray(q), *args)
             scores = np.asarray(scores)[:n]
             rows = np.asarray(rows)[:n]
         self._count_compiles(sig, n_before)
-        return {
+        t_merge = time.perf_counter()
+        out = {
             "scores": scores,
             "rows": rows,
             "labels": idx._host_labels[rows],
             "ids": idx.ids[rows],
         }
+        if stages is not None:
+            # Device scoring vs host gather, accumulated across bucket
+            # chunks (the qtrace score/topk_merge split).
+            stages["score_us"] = stages.get("score_us", 0.0) \
+                + (t_merge - t_score) * 1e6
+            stages["merge_us"] = stages.get("merge_us", 0.0) \
+                + (time.perf_counter() - t_merge) * 1e6
+        return out
 
     # -- warmup ------------------------------------------------------------
 

@@ -76,6 +76,7 @@ class ReplicaSet:
         dispatch_factory: Callable[[Replica], Callable],
         span_fn=None,
         on_batch=None,
+        on_pick=None,
     ):
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
@@ -84,7 +85,7 @@ class ReplicaSet:
             rep = Replica(name=f"r{i}", engine=engine)
             rep.batcher = MicroBatcher(
                 dispatch_factory(rep), batcher_cfg,
-                span_fn=span_fn, on_batch=on_batch,
+                span_fn=span_fn, on_batch=on_batch, on_pick=on_pick,
             )
             self.replicas.append(rep)
         # Rejections that never reached a batcher (no live replica) —

@@ -147,6 +147,7 @@ class RetrievalServer:
         live=None,
         admission=None,
         input_shape=None,
+        qtrace=None,
     ):
         from npairloss_tpu.serve.replicas import ReplicaSet
 
@@ -173,6 +174,12 @@ class RetrievalServer:
         # set, submits consult it BEFORE routing — a shed is a
         # fast-reject counted in the ``rejected`` invariant.
         self.admission = admission
+        # Per-query stage tracing (obs.qtrace): trace ids assigned at
+        # ingestion ride each record through admission, the router, the
+        # batcher, and the engine; None (the default) keeps every
+        # emitted stream byte-identical to a qtrace-free build (the
+        # shadow=None posture, pinned by tests/test_qtrace.py).
+        self.qtrace = qtrace
         # Raw-input shape for encode-path re-warms (None = embedding-
         # only serving) and the optional RemediationEngine whose
         # last-action-per-policy the summary/healthz surface
@@ -196,6 +203,7 @@ class RetrievalServer:
         self.replicaset = ReplicaSet(
             engines, batcher_cfg, self._replica_dispatch,
             span_fn=self._span, on_batch=self._record_batch,
+            on_pick=self._qtrace_pick if qtrace is not None else None,
         )
         self._lat = collections.deque(maxlen=max(cfg.latency_window, 1))
         # THIS window's latencies, cleared at each emission: window rows
@@ -260,7 +268,8 @@ class RetrievalServer:
                           "replica(s) remain — rerouting its work",
                           replica.name, self.replicaset.alive_count)
                 return self._reroute(replica, items)
-            return self._dispatch(items, engine=replica.engine)
+            return self._dispatch(items, engine=replica.engine,
+                                  replica=replica.name)
 
         return dispatch
 
@@ -288,7 +297,14 @@ class RetrievalServer:
         log.warning("rerouting %d quer%s from dead replica %s to %s",
                     len(items), "y" if len(items) == 1 else "ies",
                     dead.name, target.name)
-        return self._dispatch(items, engine=target.engine)
+        if self.qtrace is not None:
+            # The reroute instant explains the detour in any exemplar
+            # that rode it (and the gameday attribution check reads the
+            # marker count as the replica-crash evidence).
+            self.qtrace.marker("crash_reroute", dead=dead.name,
+                               target=target.name, queries=len(items))
+        return self._dispatch(items, engine=target.engine,
+                              replica=target.name)
 
     # -- telemetry ---------------------------------------------------------
 
@@ -300,11 +316,48 @@ class RetrievalServer:
     def _record_batch(self, stats: Dict[str, Any]) -> None:
         self._last_batch = stats
 
-    def _record_latency(self, seconds: float) -> None:
+    # -- qtrace glue (no-ops unless a QueryTracer is attached) -------------
+
+    def _qtrace_begin(self, rec):
+        """Assign a trace id at ingestion; the context rides the record
+        itself so the batcher/replica threads need no side channel."""
+        if self.qtrace is None or not isinstance(rec, dict):
+            return None
+        qt = self.qtrace.begin(rec.get("id"))
+        rec["_qt"] = qt
+        return qt
+
+    def _qtrace_pick(self, item) -> None:
+        """Batcher ``on_pick`` hook: the dispatcher pulled this record
+        off its replica's admission queue — ``queue_wait`` ends."""
+        qt = item.get("_qt") if isinstance(item, dict) else None
+        if qt is not None:
+            self.qtrace.picked(qt)
+
+    def _qtrace_drop(self, qt, error: bool = False) -> None:
+        """A query that will never be answered: counted by the tracer,
+        excluded from both aggregation populations (the same population
+        the latency rings keep — see ``_record_latency``)."""
+        if qt is not None and self.qtrace is not None:
+            self.qtrace.drop(qt, error=error)
+
+    def _record_latency(self, seconds: float, qt=None) -> None:
+        if qt is not None and self.qtrace is not None:
+            # Finish the trace BEFORE the window-threshold check so the
+            # query that closes a window lands in that window's stage
+            # decomposition, mirroring its latency sample below.
+            self.qtrace.finish(qt)
         qps, lat_snap = 0.0, None
         with self._lock:
             self._lat.append(seconds * 1e3)
-            self._window_lat.append(seconds * 1e3)
+            if self.cfg.metrics_window:
+                # One population, two views: a sample enters the
+                # smoothed ring AND the window list here or nowhere
+                # (dropped/errored queries enter neither) — with
+                # windows off the per-window list must stay empty, not
+                # accumulate a divergent unbounded copy of the ring
+                # (pinned by tests/test_qtrace.py).
+                self._window_lat.append(seconds * 1e3)
             self.answered += 1
             self._window_n += 1
             if (self.cfg.metrics_window
@@ -318,15 +371,17 @@ class RetrievalServer:
         if lat_snap is not None:
             self._emit_window(qps, lat_snap)
 
-    def _account(self, answer: Dict[str, Any], t0: float) -> Dict[str, Any]:
+    def _account(self, answer: Dict[str, Any], t0: float,
+                 qt=None) -> Dict[str, Any]:
         """Per-answer bookkeeping: an ``{"id", "error"}`` answer (a
         malformed record the dispatch answered individually) counts as
         an error, everything else as an answered query with latency."""
         if "error" in answer:
             with self._lock:
                 self.errors += 1
+            self._qtrace_drop(qt, error=True)
         else:
-            self._record_latency(time.perf_counter() - t0)
+            self._record_latency(time.perf_counter() - t0, qt)
         return answer
 
     def _percentiles(
@@ -393,6 +448,11 @@ class RetrievalServer:
             "batches": self.replicaset.batches,
             "rejected": self._rejected_total(),
             **self._window_latency_split(),
+            # THIS window's p99 budget decomposition: the dominant
+            # stage among its worst queries (absent with qtrace off —
+            # the spans_dropped byte-identity contract).
+            **(self.qtrace.window_row()
+               if self.qtrace is not None else {}),
             **{f"batch_{k}": round(v, 3) if isinstance(v, float) else v
                for k, v in self._last_batch.items()},
         }
@@ -424,7 +484,8 @@ class RetrievalServer:
     # -- serving core ------------------------------------------------------
 
     def _dispatch(self, items: List[Dict[str, Any]],
-                  engine: Optional[QueryEngine] = None
+                  engine: Optional[QueryEngine] = None,
+                  replica: Optional[str] = None
                   ) -> List[Dict[str, Any]]:
         """Batcher dispatch: coalesced query records -> per-query
         answers.  A malformed record (missing field, wrong embedding
@@ -437,6 +498,17 @@ class RetrievalServer:
 
         if engine is None:
             engine = self.engine
+        qts = ([qt for it in items
+                if isinstance(it, dict)
+                and (qt := it.get("_qt")) is not None]
+               if self.qtrace is not None else [])
+        if qts:
+            # ``batch_assemble`` ends here; everything from this point
+            # to the answers — parse, encode, failpoint stalls, the
+            # engine call — is the ``dispatch`` stage (score/topk_merge
+            # are split back out of it below).
+            self.qtrace.dispatch_begin(qts, replica=replica)
+        stages: Optional[Dict[str, float]] = {} if qts else None
         if failpoints.should_fire("serve.latency"):
             # Deterministic latency fault (docs/RESILIENCE.md): every
             # query in this batch pays the stall — the p99 spike the
@@ -481,8 +553,15 @@ class RetrievalServer:
                 for i, _ in enc_rows:
                     answers[i] = {"id": items[i].get("id"),
                                   "error": str(e)}
+        t_merge = 0.0
         if emb_rows:
-            out = engine.query(np.stack([x for _, x in emb_rows]))
+            batch = np.stack([x for _, x in emb_rows])
+            # Only thread the stage-clock dict through when tracing is
+            # live: engine stand-ins (tests, external adapters) need not
+            # grow the kwarg to serve an untraced tier.
+            out = (engine.query(batch) if stages is None
+                   else engine.query(batch, stages=stages))
+            t_asm0 = time.perf_counter()
             ages = (self.freshness.ages()
                     if self.freshness is not None else {})
             for j, (i, _) in enumerate(emb_rows):
@@ -502,6 +581,10 @@ class RetrievalServer:
                         for r in range(out["scores"].shape[1])
                     ],
                 }
+            # Host-side answer assembly is merge work: it joins the
+            # device top-K with labels/ids/freshness into the wire
+            # shape, so it lands in ``topk_merge``, not dispatch self.
+            t_merge = time.perf_counter() - t_asm0
             if self.shadow is not None:
                 # Shadow offer AFTER the answers are built: a hash +
                 # bounded put per sampled query, never a wait — the
@@ -515,6 +598,12 @@ class RetrievalServer:
                                           out["scores"][j])
                 except Exception as e:  # noqa: BLE001 — shadow must not fail answers
                     log.error("shadow offer failed: %s", e)
+        if qts:
+            self.qtrace.dispatch_end(
+                qts,
+                score_us=(stages or {}).get("score_us", 0.0),
+                merge_us=((stages or {}).get("merge_us", 0.0)
+                          + t_merge * 1e6))
         return answers
 
     # -- remediation actuators (docs/RESILIENCE.md §Remediation) -----------
@@ -543,6 +632,11 @@ class RetrievalServer:
             self.swaps += 1
         for rep, eng in zip(self.replicaset.replicas, engines):
             rep.engine = eng
+        if self.qtrace is not None:
+            # The generation-flip instant: answers after this marker
+            # come from the new snapshot — a tail spike next to it is
+            # swap cost, not load (docs/OBSERVABILITY.md runbook).
+            self.qtrace.marker("hotswap_flip", generation=self.swaps)
         log.warning("hot-swap %d: serving tier republished (%s)",
                     self.swaps,
                     freshness.identity() if freshness else "same identity")
@@ -580,13 +674,23 @@ class RetrievalServer:
         :class:`QueueFullError` on backpressure — from a full replica
         queue, a fully-down tier, or the admission controller shedding
         under SLO burn (all counted in ``rejected``)."""
+        qt = (record.get("_qt")
+              if self.qtrace is not None and isinstance(record, dict)
+              else None)
         with self._span("serve/admit"):
             with self._lock:  # HTTP front end submits from many threads
                 self.queries += 1
-            if self.admission is not None and not self.admission.admit():
+            if self.admission is not None and \
+                    not self.admission.admit(trace=qt):
                 raise QueueFullError(
                     "load shed: SLO burning (admission control); retry "
                     "after backoff")
+            if qt is not None:
+                # ``admit_wait`` closes BEFORE the enqueue: the record
+                # becomes visible to the dispatcher the instant it
+                # lands in the queue, and the queue put is the only
+                # ordering edge between this thread and ``picked``.
+                self.qtrace.admitted(qt)
             return self.replicaset.submit(record), time.perf_counter()
 
     def handle_many(
@@ -599,15 +703,17 @@ class RetrievalServer:
         micro-batches instead of each paying its own deadline wait."""
         staged: List[Any] = []
         for rec in records:
+            qt = self._qtrace_begin(rec)
             try:
-                staged.append((rec, *self.submit(rec)))
+                staged.append((rec, *self.submit(rec), qt))
             except QueueFullError as e:
                 # counted in batcher.rejected — NOT also in errors, or
                 # the drain invariant queries == answered + errors +
                 # rejected double-counts every rejection
-                staged.append((rec, None, str(e)))
+                self._qtrace_drop(qt)
+                staged.append((rec, None, str(e), None))
         answers = []
-        for rec, fut, t0_or_err in staged:
+        for rec, fut, t0_or_err, qt in staged:
             if fut is None:
                 answers.append({"id": rec.get("id"),
                                 "error": t0_or_err})
@@ -617,9 +723,10 @@ class RetrievalServer:
             except Exception as e:  # noqa: BLE001 — answer the error
                 with self._lock:
                     self.errors += 1
+                self._qtrace_drop(qt, error=True)
                 answers.append({"id": rec.get("id"), "error": str(e)})
                 continue
-            answers.append(self._account(answer, t0_or_err))
+            answers.append(self._account(answer, t0_or_err, qt))
         return answers
 
     def handle(self, record: Dict[str, Any],
@@ -680,6 +787,11 @@ class RetrievalServer:
             # --shadow-rate 0 run keeps its pre-PR summary shape.
             **({"quality": self.shadow.stats()}
                if self.shadow is not None else {}),
+            # The per-stage p99 budget decomposition (obs.qtrace):
+            # block absent = tracing off — the freshness-JSON contract
+            # once more, so an untraced run keeps its pre-PR shape.
+            **({"qtrace": self.qtrace.summary_block()}
+               if self.qtrace is not None else {}),
             **{k: round(v, 3) for k, v in self._percentiles().items()},
             # Whole-run latency split: where an answer's time went,
             # stage by stage (one read at drain, not per window; from
@@ -719,6 +831,11 @@ class RetrievalServer:
         summary record.  Idempotent enough for every exit path."""
         self.replicaset.close(drain=True)
         s = self.summary()
+        if self.qtrace is not None and self.qtrace.out_path:
+            try:
+                self.qtrace.write()
+            except Exception as e:  # noqa: BLE001 — the artifact is not the run
+                log.error("qtrace artifact write failed: %s", e)
         if self.telemetry is not None:
             with contextlib.suppress(Exception):
                 if self.telemetry.metrics_enabled:
@@ -747,14 +864,16 @@ class RetrievalServer:
 
         def flush_ready(block: bool) -> None:
             while pending:
-                rec_id, fut, t0 = pending[0]
+                rec_id, fut, t0, qt = pending[0]
                 if not block and not fut.done():
                     return
                 try:
-                    answer = self._account(fut.result(timeout=120.0), t0)
+                    answer = self._account(fut.result(timeout=120.0),
+                                           t0, qt)
                 except Exception as e:  # noqa: BLE001
                     with self._lock:
                         self.errors += 1
+                    self._qtrace_drop(qt, error=True)
                     answer = {"id": rec_id, "error": str(e)}
                 pending.popleft()
                 emit(answer)
@@ -804,12 +923,14 @@ class RetrievalServer:
                         self.errors += 1
                     emit({"id": None, "error": f"bad request JSON: {e}"})
                     continue
+                qt = self._qtrace_begin(rec)
                 try:
                     fut, t0 = self.submit(rec)
-                    pending.append((rec.get("id"), fut, t0))
+                    pending.append((rec.get("id"), fut, t0, qt))
                 except QueueFullError as e:
                     # counted in batcher.rejected, not errors (drain
                     # invariant: queries == answered + errors + rejected)
+                    self._qtrace_drop(qt)
                     emit({"id": rec.get("id"), "error": str(e)})
                 flush_ready(block=False)
         finally:

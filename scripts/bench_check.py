@@ -402,6 +402,53 @@ def _load_gameday():
     return sys.modules[name]
 
 
+def _load_qtrace():
+    """File-path-load ``obs.qtrace.report`` (self-contained, stdlib
+    only — the same contract as the alerts/remediate/quality/gameday
+    modules) WITHOUT importing the package."""
+    import importlib.util
+
+    name = "npairloss_tpu.obs.qtrace.report"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO, "npairloss_tpu", "obs", "qtrace",
+                               "report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def check_qtrace_log(path: str) -> List[str]:
+    """Gate one ``npairloss-qtrace-v1`` exemplar artifact: schema-valid
+    per the one contract (validate_qtrace_report — stage vocabulary,
+    span nesting/ordering, trace-id uniqueness) AND internally
+    consistent (qtrace_p99_consistency — an exemplar set whose worst
+    span tree disagrees with the logged p99 budget by more than the
+    artifact's declared ring tolerance is doctored evidence: the
+    retention rule guarantees the worst query is always retained)."""
+    qmod = _load_qtrace()
+    try:
+        report = qmod.load_qtrace_report(path)
+    except OSError as e:
+        return [f"qtrace artifact {path} unreadable: {e}"]
+    except ValueError as e:
+        return [f"qtrace artifact {path} not JSON: {e}"]
+    err = qmod.validate_qtrace_report(report)
+    if err is not None:
+        return [f"qtrace artifact refused: {err}"]
+    err = qmod.qtrace_p99_consistency(report)
+    if err is not None:
+        return [f"qtrace artifact inconsistent: {err}"]
+    totals = report["totals"]
+    budget = report["budget"]
+    _log(f"qtrace artifact OK ({totals['queries']} query(ies), "
+         f"{totals['exemplars']} exemplar(s), p99 "
+         f"{budget['p99_ms']:.1f}ms dominated by "
+         f"{budget['dominant'] or 'n/a'})")
+    return []
+
+
 def check_gameday_report(path: str) -> List[str]:
     """Gate one ``npairloss-gameday-v1`` verdict: schema-valid and
     PASSING per the one contract (validate_gameday_report recomputes
@@ -779,6 +826,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "alert log when present — the ci.sh gameday-stage wiring",
     )
     ap.add_argument(
+        "--qtrace", metavar="PATH",
+        help="gate a query-trace exemplar artifact instead of the "
+        "bench trajectory: schema-valid (npairloss-qtrace-v1), stage "
+        "vocabulary and span nesting intact, trace ids unique, and "
+        "the exemplar worst case consistent with the logged p99 "
+        "budget within the ring tolerance — the ci.sh qtrace-smoke "
+        "wiring",
+    )
+    ap.add_argument(
         "--static", nargs="?", const=REPO, default=None, metavar="ROOT",
         help="run the invariant linter (docs/STATICCHECK.md) over ROOT "
         "(default: this repo) instead of the bench trajectory and fail "
@@ -808,6 +864,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"REGRESSION: {v}")
             return 1
         print(f"bench_check OK (gameday verdict {args.gameday})")
+        return 0
+
+    if args.qtrace:
+        violations = check_qtrace_log(args.qtrace)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (qtrace artifact {args.qtrace})")
         return 0
 
     if args.quality:

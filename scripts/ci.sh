@@ -664,6 +664,120 @@ print(f"watch feed agrees: {states}")
 EOF
 echo "live observatory smoke OK (0 false positives, fire->resolve, gate teeth, watch agreement)"
 
+echo "== query tracing smoke (docs/OBSERVABILITY.md §Query tracing) =="
+# Per-query stage attribution end-to-end: a throttled serve run with
+# the serve.latency failpoint armed must retain SLO-violating
+# exemplars whose dominant stage is DISPATCH (the feed is slower than
+# the 0.25s stall, so each faulted query pays the stall as dispatch
+# self-time and no queue builds behind it — the gameday covers the
+# saturated case where the same fault shows up as queue_wait); the
+# jax-free bench_check --qtrace gate accepts the real artifact and
+# refuses doctored copies; the merged timeline carries the exemplar
+# span trees next to the alert instants.
+qt_dir="$smoke_dir/qtrace"
+mkdir -p "$qt_dir"
+mkfifo "$qt_dir/in.$$"
+env JAX_PLATFORMS=cpu NPAIRLOSS_FAILPOINTS="serve.latency:6@4" \
+    python -m npairloss_tpu serve --index "$live_dir/g.gidx" \
+    --top-k 3 --buckets 1 --deadline-ms 1 --metrics-window 4 \
+    --telemetry-dir "$qt_dir/tel" --live-obs \
+    --slo-config "$live_dir/slo.json" --slo-tick 0.2 \
+    --qtrace --qtrace-slo-ms 150 \
+    < "$qt_dir/in.$$" > "$qt_dir/answers.jsonl" 2> "$qt_dir/serve.log" &
+qtpid=$!
+exec 8> "$qt_dir/in.$$"
+# Readiness probe: the FIFO buffers lines while the server is still
+# importing/warming, and a buffered backlog arrives as a BURST whose
+# tail pays queue_wait, not dispatch — the very confound this smoke
+# must exclude.  One query, wait for its answer, then throttle the
+# rest; the @4 delay keeps the stalls clear of the probe boundary.
+head -1 "$live_dir/queries.jsonl" >&8
+for _ in $(seq 1 120); do
+    [[ -s "$qt_dir/answers.jsonl" ]] && break
+    sleep 0.5
+done
+[[ -s "$qt_dir/answers.jsonl" ]] \
+    || { echo "qtrace smoke: server never answered the probe"; cat "$qt_dir/serve.log"; exit 1; }
+sed -n '2,24p' "$live_dir/queries.jsonl" | while IFS= read -r ln; do
+    printf '%s\n' "$ln" >&8; sleep 0.3
+done
+sleep 3   # fault long gone: fast windows age the p99 burn out -> resolve
+kill -TERM "$qtpid" 2>/dev/null || true
+exec 8>&-
+rc=0; wait "$qtpid" || rc=$?
+rm -f "$qt_dir/in.$$"
+[[ "$rc" -eq 75 ]] \
+    || { echo "qtrace smoke: expected exit 75, got $rc"; cat "$qt_dir/serve.log"; exit 1; }
+python - "$qt_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+rep = json.load(open(d + "/tel/qtrace.json"))
+t, b = rep["totals"], rep["budget"]
+assert t["queries"] == 24 and t["errors"] == 0, t
+assert t["violations"] >= 1, f"no SLO violation retained: {t}"
+slo_ex = [ex for ex in rep["exemplars"] if ex["reason"] == "slo"]
+assert slo_ex, "fault run retained no SLO exemplars"
+assert b["dominant"] == "dispatch", \
+    f"injected dispatch stall attributed to {b['dominant']!r}: {b}"
+for ex in slo_ex:
+    stages = {e["name"]: e["dur"] for e in ex["events"]
+              if e["name"].startswith("qtrace/") and e["name"] != "qtrace/query"}
+    worst = max(stages, key=stages.get)
+    assert worst == "qtrace/dispatch", (ex["trace_id"], worst, stages)
+drain = [json.loads(ln) for ln in open(d + "/answers.jsonl") if ln.strip()][-1]
+assert drain.get("event") == "serve_drain", drain
+assert drain["qtrace"]["budget"]["dominant"] == "dispatch", drain["qtrace"]
+rows = [json.loads(ln) for ln in open(d + "/tel/metrics.jsonl") if ln.strip()]
+doms = [r["qtrace_dominant"] for r in rows
+        if r.get("phase") == "serve" and "qtrace_dominant" in r]
+assert "dispatch" in doms, f"no window row pinned the stall on dispatch: {doms}"
+states = [json.loads(ln)["state"] for ln in open(d + "/tel/alerts.jsonl") if ln.strip()]
+assert "firing" in states and states[-1] == "resolved", states
+print(f"qtrace smoke: {len(slo_ex)} SLO exemplar(s), dominant dispatch "
+      f"(p99 {b['p99_ms']:.0f}ms), alert fired+resolved")
+EOF
+python scripts/bench_check.py --qtrace "$qt_dir/tel/qtrace.json" \
+    || { echo "qtrace smoke: gate refused the real artifact"; exit 1; }
+# gate teeth: a schema rename and a duplicated trace id must be refused
+sed 's/npairloss-qtrace-v1/npairloss-qtrace-v0/' \
+    "$qt_dir/tel/qtrace.json" > "$qt_dir/badschema.json"
+python scripts/bench_check.py --qtrace "$qt_dir/badschema.json" > /dev/null \
+    && { echo "qtrace smoke: gate ACCEPTED a schema violation"; exit 1; }
+python - "$qt_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+rep = json.load(open(d + "/tel/qtrace.json"))
+assert len(rep["exemplars"]) >= 2, "need two exemplars to forge a duplicate"
+tid = rep["exemplars"][0]["trace_id"]
+rep["exemplars"][1]["trace_id"] = tid
+for ev in rep["exemplars"][1]["events"]:
+    ev["args"]["trace_id"] = tid
+json.dump(rep, open(d + "/dup.json", "w"))
+EOF
+python scripts/bench_check.py --qtrace "$qt_dir/dup.json" > /dev/null \
+    && { echo "qtrace smoke: gate ACCEPTED a duplicate trace id"; exit 1; }
+# the composed-system timeline: serve query spans + alert instants in
+# one Perfetto file (gameday layout: the telemetry dir as serve_tel)
+mkdir -p "$qt_dir/run"
+cp -r "$qt_dir/tel" "$qt_dir/run/serve_tel"
+JAX_PLATFORMS=cpu python -m npairloss_tpu timeline "$qt_dir/run" \
+    > "$qt_dir/timeline.log" 2>&1 \
+    || { echo "qtrace smoke: timeline merge failed"; cat "$qt_dir/timeline.log"; exit 1; }
+python - "$qt_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+out = json.loads(open(d + "/timeline.log").read().strip().splitlines()[-1])
+assert out["sources"]["qtrace"] is True and out["sources"]["serve_host"] is True, out
+merged = json.load(open(out["timeline"]))
+events = merged["traceEvents"]
+spans = {e["name"] for e in events if e.get("ph") == "X" and e.get("pid", 0) >= 1000}
+assert "qtrace/query" in spans and "qtrace/dispatch" in spans, spans
+instants = {e["name"] for e in events if e.get("ph") == "i"}
+assert any(n.startswith("alert:") and n.endswith("firing") for n in instants), instants
+print(f"timeline OK ({out['events']} events; serve query spans + alert instants merged)")
+EOF
+echo "qtrace smoke OK (dispatch attribution, artifact gate + teeth, merged timeline)"
+
 echo "== overload / admission-control smoke (docs/SERVING.md §Approximate index) =="
 # The graceful-degradation scenario (ISSUE 11): a 2-replica IVF tier
 # under a p99 SLO is rammed past capacity (deterministically — the

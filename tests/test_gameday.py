@@ -194,7 +194,14 @@ def _passing_report(**over):
         train_remediation=[
             _rem("a3", "embedding_collapse", "trainer_rollback",
                  "succeeded", 30.0)],
-        serve_rows=[{"p99_ms": 40.0, "wall_time": float(t)}
+        # Healthy rows attribute to dispatch; the rows inside the
+        # serve_p99 incident window show the queue_wait signature the
+        # serve.latency entry declares (worst decomposed row wins).
+        serve_rows=[{"p99_ms": 40.0, "wall_time": float(t),
+                     "qtrace_dominant": ("queue_wait" if 35 <= t <= 50
+                                         else "dispatch"),
+                     "qtrace_dominant_ms": (220.0 if 35 <= t <= 50
+                                            else 6.0)}
                     for t in range(0, 76, 5)],
         quality_windows=[{"recall_at_10": 0.97, "wall_time": float(t)}
                          for t in range(0, 76, 10)],
@@ -206,7 +213,11 @@ def _passing_report(**over):
                         "serve.replica_crash": 1, "train.collapse": 160,
                         "SIGTERM": 1},
         client_errors=0, window_s=75.0, seed=0,
-        p99_target_ms=150.0, recall_floor=0.9, min_hot_swaps=3)
+        p99_target_ms=150.0, recall_floor=0.9, min_hot_swaps=3,
+        qtrace={"available": True,
+                "totals": {"queries": 400, "reroutes": 1,
+                           "hotswap_flips": 4},
+                "budget": {"p99_ms": 42.0, "dominant": "dispatch"}})
     kw.update(over)
     return build_gameday_report(entries, **kw)
 
@@ -238,10 +249,14 @@ def test_unremediated_fault_fails():
 
 def test_breach_inside_incident_window_excused():
     # The p99 spike lands inside the serve_p99 alert's window
-    # [40 - 30, 46 + 10]: excused, verdict still passes.
+    # [40 - 30, 46 + 10]: excused, verdict still passes.  The spike
+    # row carries the queue_wait decomposition the serve.latency entry
+    # declares (it IS the window's worst decomposed row).
     rows = [{"p99_ms": 40.0, "wall_time": float(t)}
             for t in range(0, 76, 5)]
-    rows.append({"p99_ms": 900.0, "wall_time": 42.0})
+    rows.append({"p99_ms": 900.0, "wall_time": 42.0,
+                 "qtrace_dominant": "queue_wait",
+                 "qtrace_dominant_ms": 870.0})
     report = _passing_report(serve_rows=rows)
     assert report["verdict"] == "pass"
     assert report["slo"]["p99"]["in_incident"] > 0
