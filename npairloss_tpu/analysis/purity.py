@@ -70,6 +70,14 @@ CONTRACT_MODULES: Dict[str, str] = {
     "npairloss_tpu/obs/qtrace/report.py":
         "bench_check --qtrace file-path-loads the qtrace-v1 "
         "validator",
+    "npairloss_tpu/resilience/wal.py":
+        "bench_check --wal file-path-loads the wal-v1 validator",
+    "npairloss_tpu/resilience/failpoints.py":
+        "wal.py's fault-injection seam; rides along in the --wal "
+        "loader chain",
+    "npairloss_tpu/resilience/retrying.py":
+        "wal.py's replay/segment-open retry policies; rides along in "
+        "the --wal loader chain",
     "scripts/bench_check.py":
         "the CI gate itself — must never hang on a backend import",
     "scripts/check_no_print.py":

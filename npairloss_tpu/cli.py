@@ -1197,6 +1197,11 @@ def cmd_serve(args) -> int:
             log.error("--remediation-config %s: %s",
                       args.remediation_config, e)
             return 2
+    if getattr(args, "wal_dir", None) and not args.index_prefix:
+        log.error("--wal-dir needs --index-prefix (ingest checkpoints "
+                  "publish under the prefix, and cold restart reloads "
+                  "the newest one — docs/RESILIENCE.md §Durability)")
+        return 2
     shadow_rate = float(getattr(args, "shadow_rate", 0.0) or 0.0)
     if not (0.0 <= shadow_rate <= 1.0):
         log.error("--shadow-rate must be in [0, 1], got %g", shadow_rate)
@@ -1267,6 +1272,70 @@ def cmd_serve(args) -> int:
         return idx
 
     index = _reconcile_index(index)
+
+    # Durable-ingest arm (docs/RESILIENCE.md §Durability): open the WAL
+    # (recovery truncates any torn tail loudly), then replay every
+    # record ABOVE the loaded artifact's watermark into the pending
+    # buffer — exactly-once: records the snapshot already contains are
+    # skipped.  Pending records reach a SERVED index only through
+    # checkpoint publication + hot-swap; an in-place add to the live
+    # gallery would recompile on the serving path.
+    wal = None
+    if getattr(args, "wal_dir", None):
+        import numpy as np
+
+        from npairloss_tpu.resilience.wal import (
+            WalCorruptionError,
+            WriteAheadLog,
+        )
+        from npairloss_tpu.serve.index import INDEX_SUFFIX
+        from npairloss_tpu.serve.server import decode_ingest_payload
+
+        base_watermark = int(getattr(index, "ingest_watermark", 0))
+        _ingest = {"base": index_path, "pending": []}
+
+        def _apply_ingest(payload):
+            _ingest["pending"].append(
+                (int(payload["seq"]), decode_ingest_payload(payload)))
+
+        def _publish_checkpoint(wm: int):
+            pending = [p for p in _ingest["pending"] if p[0] <= wm]
+            if not pending:
+                return None
+            base = load_index(_ingest["base"], mesh=mesh)
+            emb = np.concatenate([d[0] for _, d in pending])
+            labels = np.concatenate([d[1] for _, d in pending])
+            ids = np.concatenate([d[2] for _, d in pending])
+            base.add(emb, labels, ids=ids)
+            base.ingest_watermark = wm
+            # 'w' sorts after every digit, so checkpoints always win
+            # load_newest over the plain numbered commits they grew
+            # from, and among themselves by watermark.
+            path = base.save(
+                f"{args.index_prefix}w{wm:012d}{INDEX_SUFFIX}")
+            _ingest["base"] = path
+            _ingest["pending"] = [p for p in _ingest["pending"]
+                                  if p[0] > wm]
+            log.info("ingest checkpoint: %s (watermark %d, +%d row(s))",
+                     path, wm, int(emb.shape[0]))
+            return path
+
+        try:
+            wal = WriteAheadLog(
+                args.wal_dir,
+                flush_interval_s=max(args.wal_flush_ms, 0.0) / 1e3)
+            replayed = 0
+            for payload in wal.replay(after_seq=base_watermark):
+                _apply_ingest(payload)
+                replayed += 1
+        except WalCorruptionError as e:
+            log.error("--wal-dir %s refused: %s", args.wal_dir, e)
+            return 2
+        _wal_st = wal.stats()
+        log.info("wal: recovered %s — last_seq %d, replayed %d "
+                 "record(s) above watermark %d, torn_records %d",
+                 args.wal_dir, _wal_st["last_seq"], replayed,
+                 base_watermark, _wal_st["torn_records"])
 
     model = state = None
     input_shape = None
@@ -1417,6 +1486,16 @@ def cmd_serve(args) -> int:
             freshness=freshness, live=live, admission=admission,
             input_shape=input_shape, qtrace=qtracer,
         )
+        if wal is not None:
+            server.attach_wal(
+                wal, _apply_ingest,
+                checkpoint_fn=_publish_checkpoint,
+                checkpoint_every=args.wal_checkpoint_every,
+                watermark=max(base_watermark, wal.last_seq),
+                checkpoint_watermark=base_watermark)
+            log.info("durable ingest armed: wal %s, flush %.1f ms, "
+                     "checkpoint every %d batch(es)", args.wal_dir,
+                     args.wal_flush_ms, args.wal_checkpoint_every)
         if shadow_rate > 0:
             # Quality observatory (docs/OBSERVABILITY.md §Quality):
             # shadow-score a deterministic sample of live queries
@@ -1570,7 +1649,35 @@ def cmd_serve(args) -> int:
             # drop.  The serve.stale_model failpoint poisons the
             # published model age so the staleness→hot-swap loop is
             # deterministically drivable.
+            import time as _time
+
+            _qtrace_last = [0.0]
+
             def _freshness_probe():
+                if qtracer is not None:
+                    # Crash-consistent exemplar artifact: checkpoint
+                    # qtrace.json on the probe cadence (atomic
+                    # tmp+rename), so a host crash loses at most a
+                    # couple of seconds of markers instead of the whole
+                    # artifact — the drain write stays the final word.
+                    now = _time.monotonic()
+                    if now - _qtrace_last[0] >= 2.0:
+                        _qtrace_last[0] = now
+                        try:
+                            qtracer.write()
+                        except OSError as e:
+                            log.error("qtrace checkpoint failed: %s", e)
+                if wal is not None:
+                    # Ingest-durability gauges (/metrics + the SLO
+                    # registry): what the tier has acked vs published,
+                    # and the torn-tail evidence recovery counted.
+                    st = wal.stats()
+                    live.registry.set("serve_ingest_watermark",
+                                      float(server.ingest_watermark))
+                    live.registry.set("serve_wal_durable_seq",
+                                      float(st["durable_seq"]))
+                    live.registry.set("serve_wal_torn_records",
+                                      float(st["torn_records"]))
                 f = server.freshness
                 if f is None:
                     return
@@ -1591,6 +1698,13 @@ def cmd_serve(args) -> int:
         return server.run_jsonl(_sys.stdin, _sys.stdout)
     finally:
         preempt.uninstall()
+        if wal is not None:
+            try:
+                # Drain-time checkpoint already ran inside the server's
+                # drain; this is the final fsync + flusher join.
+                wal.close()
+            except Exception as e:  # noqa: BLE001
+                log.error("wal close failed: %s", e)
         if shadow is not None:
             try:
                 # Drain the shadow queue (every accepted sample
@@ -2975,6 +3089,30 @@ def main(argv: Optional[list] = None) -> int:
         help="per-query latency SLO for exemplar retention + the "
         "violations counter (default 0 = the armed serve_p99 "
         "watchdog's target when --live-obs is on, else 250)",
+    )
+    sv.add_argument(
+        "--wal-dir", dest="wal_dir", metavar="DIR",
+        help="durable-ingest write-ahead log directory "
+        "(npairloss-wal-v1 — docs/RESILIENCE.md §Durability): every "
+        "stdin ingest record is WAL-appended + fsynced BEFORE its ack, "
+        "cold restart replays records above the newest index "
+        "snapshot's watermark, and checkpoints publish under "
+        "--index-prefix (required with this flag); off (default) "
+        "rejects ingest records",
+    )
+    sv.add_argument(
+        "--wal-flush-ms", dest="wal_flush_ms", type=float, default=0.0,
+        metavar="MS",
+        help="group-commit fsync interval: acks wait for the covering "
+        "flush (amortizes fsyncs across concurrent ingests); 0 "
+        "(default) fsyncs inline on every append",
+    )
+    sv.add_argument(
+        "--wal-checkpoint-every", dest="wal_checkpoint_every",
+        type=int, default=8, metavar="N",
+        help="publish an ingest checkpoint (and GC covered WAL "
+        "segments) every N acked ingest batches; a final checkpoint "
+        "always lands at drain (default 8; 0 = drain-only)",
     )
     sv_tel = sv.add_mutually_exclusive_group()
     sv_tel.add_argument(

@@ -9,7 +9,9 @@ telemetry — then drives the deterministic traffic plan
 (gameday/traffic.py) through it while the chaos schedule
 (gameday/schedule.py) injects faults: failpoints armed via
 ``NPAIRLOSS_FAILPOINTS`` in each child's environment, signals delivered
-at their scripted offsets (SIGTERM mid-stream, relaunch same command).
+at their scripted offsets (SIGTERM mid-stream relaunches the trainer;
+SIGKILL cold-restarts the serving tier from its published artifacts +
+WAL, the durable-ingest drill of docs/RESILIENCE.md §Durability).
 
 At the end it collects every artifact — answers, alert logs,
 remediation audits, quality windows, metric rows, the fleet report,
@@ -116,8 +118,8 @@ class _Supervisor:
         self.procs: Dict[str, subprocess.Popen] = {}
         self.files: List[Any] = []
 
-    def open(self, path: str):
-        f = open(path, "wb")
+    def open(self, path: str, mode: str = "wb"):
+        f = open(path, mode)
         self.files.append(f)
         return f
 
@@ -256,11 +258,40 @@ def _serve_cmd(out: str, replicas: int) -> List[str]:
         # the qtrace_dominant window rows and the qtrace.json reroute
         # counters this arms (docs/OBSERVABILITY.md §Query tracing).
         "--qtrace", "--qtrace-slo-ms", str(P99_TARGET_MS),
+        # Durable ingest (docs/RESILIENCE.md §Durability): the gallery
+        # growth stream rides stdin through the WAL, and the SIGKILL
+        # drill's cold restart recovers from this directory + the
+        # published checkpoints alone.  Checkpoints land under the
+        # same watched prefix, so hot-swap feeds on them too.
+        "--wal-dir", os.path.join(out, "wal"),
+        "--wal-flush-ms", "2", "--wal-checkpoint-every", "4",
     ]
 
 
-def _feed(plan: tg.TrafficPlan, emb: np.ndarray, stdin, t0: float,
-          state: Dict[str, Any]) -> None:
+def _send(io: Dict[str, Any], line: bytes,
+          deadline_s: float = 20.0) -> bool:
+    """Write one line to the serve stdin currently installed in ``io``
+    — shared by the feeder and the ingester, so the lock also keeps
+    their lines whole.  A broken pipe means the tier was SIGKILLed;
+    retry against whatever stdin the supervisor installs at relaunch
+    (the host-crash drill's client-side contract: the stream resumes,
+    it does not abort).  False when the gap outlives the deadline."""
+    t_end = time.monotonic() + deadline_s
+    while True:
+        try:
+            with io["lock"]:
+                stdin = io["stdin"]
+                stdin.write(line)
+                stdin.flush()
+            return True
+        except (BrokenPipeError, ValueError, OSError):
+            if time.monotonic() >= t_end:
+                return False
+            time.sleep(0.2)
+
+
+def _feed(plan: tg.TrafficPlan, emb: np.ndarray, io: Dict[str, Any],
+          t0: float, state: Dict[str, Any]) -> None:
     """Pace the plan's query events against the monotonic clock and
     write them to the tier's stdin.  Writes may block on pipe
     backpressure while the tier warms or degrades — that only delays
@@ -272,46 +303,46 @@ def _feed(plan: tg.TrafficPlan, emb: np.ndarray, stdin, t0: float,
             time.sleep(wait)
         line = json.dumps({"id": ev.qid,
                            "embedding": emb[ev.key % n].tolist()})
-        try:
-            stdin.write(line.encode("utf-8") + b"\n")
-            stdin.flush()
-        except (BrokenPipeError, OSError) as e:
-            state["feed_error"] = f"serve stdin broke at qid {ev.qid}: {e}"
+        if not _send(io, line.encode("utf-8") + b"\n"):
+            state["feed_error"] = f"serve stdin broke at qid {ev.qid}"
             return
         state["fed"] = state.get("fed", 0) + 1
 
 
 def _ingest(plan: tg.TrafficPlan, emb: np.ndarray,
-            labels: np.ndarray, out: str, t0: float,
+            labels: np.ndarray, io: Dict[str, Any], t0: float,
             state: Dict[str, Any]) -> None:
-    """The gallery-growth stream: at each scripted ingest event,
-    ``add()`` a batch of new rows and commit the grown index under the
-    watched prefix — the hot-swap remediation's food supply."""
-    from npairloss_tpu.serve.index import GalleryIndex
-
+    """The gallery-growth stream, riding the DURABLE ingest path: each
+    scripted event becomes a stdin ingest record the tier must
+    WAL-append + fsync before acking; the vectors reach the served
+    index via published checkpoints + hot-swap (the remediation's food
+    supply, same as the old out-of-band commits).  Every batch sent is
+    kept in ``state["ingest_sent"]`` — the oracle the host-crash
+    verdict replays the final artifacts against."""
     cfg = plan.cfg
     rng = np.random.default_rng(cfg.seed + 1)
-    grown_emb, grown_labels = emb, labels
+    dim = emb.shape[1]
     for ev in plan.ingest:
         wait = (t0 + ev.t) - time.monotonic()
         if wait > 0:
             time.sleep(wait)
-        new = rng.standard_normal((ev.rows, emb.shape[1])
-                                  ).astype(np.float32)
+        new = rng.standard_normal((ev.rows, dim)).astype(np.float32)
         new /= np.linalg.norm(new, axis=1, keepdims=True)
         new_labels = (np.arange(ev.rows) % 16).astype(np.int32)
-        try:
-            index = GalleryIndex.build(grown_emb, grown_labels,
-                                       normalize=False)
-            index.add(new, new_labels, normalize=False)
-            index.save(os.path.join(
-                out, "idx", f"g_{ev.commit_id + 1:04d}.gidx"))
-        except Exception as e:  # noqa: BLE001 — a failed commit is a
-            # run-level fact the verdict should see, not a crash
-            state["ingest_error"] = f"commit {ev.commit_id}: {e}"
+        # Ids far above the catalog range, strided so batches can
+        # never collide — replay determinism needs the CLIENT to own
+        # identity (the WAL forbids auto-assignment).
+        ids = [10_000_000 + ev.commit_id * 10_000 + j
+               for j in range(ev.rows)]
+        rid = f"ing-{ev.commit_id}"
+        line = json.dumps({"id": rid, "ingest": {
+            "ids": ids, "labels": new_labels.tolist(),
+            "embeddings": new.tolist()}})
+        if not _send(io, line.encode("utf-8") + b"\n"):
+            state["ingest_error"] = f"serve stdin broke at {rid}"
             return
-        grown_emb = np.concatenate([grown_emb, new])
-        grown_labels = np.concatenate([grown_labels, new_labels])
+        state.setdefault("ingest_sent", {})[rid] = {"ids": ids,
+                                                    "emb": new}
         state["ingest_commits"] = state.get("ingest_commits", 0) + 1
 
 
@@ -375,13 +406,15 @@ def run_gameday(out: str, *, seed: int = 0, duration_s: float = 75.0,
             stdout=sup.open(os.path.join(out, "answers.jsonl")),
             stderr=sup.open(os.path.join(out, "serve.log")))
         t0 = time.monotonic()
+        io: Dict[str, Any] = {"stdin": serve.stdin,
+                              "lock": threading.Lock()}
 
         feeder = threading.Thread(
-            target=_feed, args=(plan, emb, serve.stdin, t0, state),
+            target=_feed, args=(plan, emb, io, t0, state),
             name="gameday-feed", daemon=True)
         feeder.start()
         ingester = threading.Thread(
-            target=_ingest, args=(plan, emb, labels, out, t0, state),
+            target=_ingest, args=(plan, emb, labels, io, t0, state),
             name="gameday-ingest", daemon=True)
         ingester.start()
 
@@ -390,6 +423,7 @@ def run_gameday(out: str, *, seed: int = 0, duration_s: float = 75.0,
         watch = None
         observed_signals: Dict[str, int] = {}
         sigs = chaos.signals(entries, "train")
+        serve_sigs = chaos.signals(entries, "serve")
         while time.monotonic() - t0 < duration_s:
             now = time.monotonic() - t0
             if watch is None and os.path.exists(serve_metrics):
@@ -424,6 +458,42 @@ def run_gameday(out: str, *, seed: int = 0, duration_s: float = 75.0,
                     env=_child_env(),
                     stdout=sup.open(os.path.join(out, "train2.log")),
                     stderr=subprocess.STDOUT)
+            if serve_sigs and now >= serve_sigs[0].at_s:
+                entry = serve_sigs.pop(0)
+                signum = getattr(signal, entry.name, signal.SIGKILL)
+                log.info("gameday: delivering %s to serve at %.1fs",
+                         entry.name, now)
+                serve.send_signal(signum)
+                serve.wait(timeout=60)
+                observed_signals[entry.name] = (
+                    observed_signals.get(entry.name, 0) + 1)
+                state.setdefault("kill_walls", []).append(time.time())
+                # A SIGKILL ran no handler: no drain, no final qtrace
+                # write.  Preserve the periodically-checkpointed
+                # artifact before the relaunched tier overwrites it —
+                # reconcile merges its marker totals back in.
+                qt = os.path.join(out, "serve_tel", "qtrace.json")
+                if os.path.exists(qt):
+                    os.replace(qt, os.path.join(
+                        out, "serve_tel",
+                        f"qtrace.pre{len(state['kill_walls'])}.json"))
+                # Cold restart from the published artifacts + WAL
+                # alone — same command, consumed chaos NOT re-armed;
+                # answers APPEND so the dead tier's acks stay evidence.
+                serve = sup.launch(
+                    "serve", _serve_cmd(out, replicas),
+                    env=_child_env(),
+                    stdin=subprocess.PIPE,
+                    stdout=sup.open(
+                        os.path.join(out, "answers.jsonl"), "ab"),
+                    stderr=sup.open(
+                        os.path.join(out, "serve2.log"), "ab"))
+                with io["lock"]:
+                    old_stdin, io["stdin"] = io["stdin"], serve.stdin
+                try:
+                    old_stdin.close()
+                except OSError:
+                    pass
             if serve.poll() is not None:
                 raise GamedayError(
                     f"serve died mid-window (rc={serve.returncode}); "
@@ -474,6 +544,61 @@ def run_gameday(out: str, *, seed: int = 0, duration_s: float = 75.0,
                       seed=seed)
 
 
+def _host_crash_evidence(out: str, answers: List[Dict[str, Any]],
+                         state: Dict[str, Any],
+                         drain: Dict[str, Any]) -> Dict[str, Any]:
+    """The durable-ingest oracle: replay every ACKED ingest batch
+    against the artifacts the cold restart actually published.  The
+    ingester kept each batch's ids + vectors in memory; an ack in
+    answers.jsonl means the tier claimed durability BEFORE the
+    SIGKILL — so every acked id must be in the final index exactly
+    once, and every acked vector must retrieve ITSELF from it
+    (recall parity after replay, recomputed, not trusted)."""
+    kills = state.get("kill_walls") or []
+    if not kills:
+        return {"available": False,
+                "reason": "no serve SIGKILL delivered"}
+    sent = state.get("ingest_sent") or {}
+    acked: Dict[str, Dict[str, Any]] = {}
+    for a in answers:
+        rid = a.get("id")
+        if (rid in sent and isinstance(a.get("ingested"), int)
+                and a["ingested"] > 0):
+            acked[rid] = sent[rid]
+    from npairloss_tpu.serve.index import load_newest
+
+    found = load_newest(os.path.join(out, "idx", "g_"))
+    if found is None:
+        return {"available": False,
+                "reason": "no loadable index commit"}
+    final_path, final = found
+    final_ids = np.asarray(final.ids).astype(np.int64)
+    id_set = set(int(i) for i in final_ids.tolist())
+    lost = acked_vectors = 0
+    hits = total = 0
+    final_emb = np.asarray(final._host_emb, dtype=np.float32)
+    for rid, batch in acked.items():
+        ids = batch["ids"]
+        acked_vectors += len(ids)
+        lost += sum(1 for i in ids if int(i) not in id_set)
+        top = np.argmax(batch["emb"] @ final_emb.T, axis=1)
+        hits += int(np.sum(final_ids[top]
+                           == np.asarray(ids, dtype=np.int64)))
+        total += len(ids)
+    wal_stats = (drain.get("ingest") or {}).get("wal") or {}
+    return {
+        "available": True,
+        "kills": len(kills),
+        "acked_batches": len(acked),
+        "acked_vectors": int(acked_vectors),
+        "lost": int(lost),
+        "duplicates": int(final_ids.shape[0] - len(id_set)),
+        "torn_records": int(wal_stats.get("torn_records", 0)),
+        "self_recall": round(hits / total, 4) if total else 0.0,
+        "final_index": os.path.basename(final_path),
+    }
+
+
 def _reconcile(out: str, entries, plan: tg.TrafficPlan,
                state: Dict[str, Any], trainer_exits: List[int],
                observed_signals: Dict[str, int], *,
@@ -498,6 +623,22 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
                                               "quality.jsonl"))
                if r.get("kind") == "window"]
 
+    # Synthetic incident per SIGKILL: no in-process pager can observe
+    # its own SIGKILL, so the RUNNER contributes the alert pair that
+    # excuses the restart's SLO turbulence — firing at the kill wall,
+    # resolved at the first metric window the reborn tier published
+    # (the backlog it inherits lands inside the padded window).
+    for i, t_kill in enumerate(state.get("kill_walls") or []):
+        after = sorted(float(r["wall_time"]) for r in serve_rows
+                       if float(r.get("wall_time", 0.0)) >= t_kill)
+        t_rec = after[0] if after else t_kill + 30.0
+        serve_alerts.append({"state": "firing",
+                             "alert_id": f"host_crash_{i}",
+                             "slo": "host_crash", "fired_at": t_kill})
+        serve_alerts.append({"state": "resolved",
+                             "alert_id": f"host_crash_{i}",
+                             "slo": "host_crash", "ts": t_rec})
+
     # Qtrace evidence for the p99-attribution check: totals (reroute /
     # hot-swap markers) + the rolling budget decomposition.  A missing
     # or torn artifact is a reportable fact — the stage-declaring
@@ -514,6 +655,31 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
                             "slo_ms": qt.get("slo_ms")}
     except (OSError, ValueError) as e:
         qtrace_block = {"available": False, "reason": str(e)}
+    # Marker totals from SIGKILLed instances: their periodically
+    # checkpointed artifacts were preserved as qtrace.preN.json before
+    # the relaunch overwrote the live one — a reroute counted by a
+    # tier that later died is still injection evidence.
+    for name in sorted(os.listdir(serve_tel)):
+        if not (name.startswith("qtrace.pre") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(serve_tel, name), "r",
+                      encoding="utf-8") as f:
+                pre = json.load(f)
+        except (OSError, ValueError):
+            continue
+        totals = (pre.get("totals") if isinstance(pre, dict)
+                  else None)
+        if not isinstance(totals, dict):
+            continue
+        if not qtrace_block.get("available"):
+            qtrace_block = {"available": True, "totals": {},
+                            "budget": pre.get("budget", {}),
+                            "slo_ms": pre.get("slo_ms")}
+        merged = qtrace_block.setdefault("totals", {})
+        for key, val in totals.items():
+            if isinstance(val, int) and not isinstance(val, bool):
+                merged[key] = int(merged.get(key, 0)) + val
 
     from npairloss_tpu.obs.fleet.aggregate import build_fleet_report
 
@@ -525,7 +691,8 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
         comms = {"available": False, "reason": f"fleet report: {e}"}
 
     fires = _count_fires([os.path.join(out, name) for name in
-                          ("serve.log", "train1.log", "train2.log")])
+                          ("serve.log", "serve2.log", "train1.log",
+                           "train2.log")])
     for name, count in observed_signals.items():
         fires[name] = fires.get(name, 0) + count
 
@@ -535,6 +702,8 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
         with open(train2, "r", encoding="utf-8",
                   errors="replace") as f:
             resumed = "resuming from iteration" in f.read()
+
+    host_crash = _host_crash_evidence(out, answers, state, drain)
 
     report = gv.build_gameday_report(
         chaos.entry_dicts(entries),
@@ -557,6 +726,7 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
         window_s=duration_s, seed=seed,
         p99_target_ms=P99_TARGET_MS, recall_floor=RECALL_FLOOR,
         min_hot_swaps=MIN_HOT_SWAPS, qtrace=qtrace_block,
+        host_crash=host_crash,
     )
     _write_json(os.path.join(out, "gameday.json"), report)
     try:

@@ -6,7 +6,8 @@ schedules ONE signal, in the same ``name:count@delay`` grammar the
 ``NPAIRLOSS_FAILPOINTS`` env var speaks — and declares, up front, the
 evidence the run must produce: the alert that must fire, the
 remediation that must resolve it, and any extra checks
-(``zero_client_errors``, ``preempt_exit``, ``resume``).  The verdict
+(``zero_client_errors``, ``preempt_exit``, ``resume``,
+``ingest_durable``, ``ingest_no_duplicates``).  The verdict
 (gameday/verdict.py) holds the run to exactly these declarations: an
 injected fault with no paging/actuation evidence fails the gameday.
 
@@ -22,7 +23,8 @@ from typing import List, Optional, Sequence, Tuple
 TARGETS = ("serve", "train")
 KINDS = ("failpoint", "signal")
 # Extra per-entry checks the verdict knows how to verify.
-EXPECT_CHECKS = ("zero_client_errors", "preempt_exit", "resume")
+EXPECT_CHECKS = ("zero_client_errors", "preempt_exit", "resume",
+                 "ingest_durable", "ingest_no_duplicates")
 # Declarable p99-attribution evidence: the qtrace stage the fault's
 # incident window must show as dominant (the obs.qtrace stage
 # vocabulary — restated here because the gate path loads this module
@@ -159,6 +161,14 @@ def default_schedule(duration_s: float = 75.0) -> List[ChaosEntry]:
         ChaosEntry(name="SIGTERM", target="train", kind="signal",
                    at_s=0.4 * duration_s,
                    expect=("preempt_exit", "resume")),
+        # Host crash mid-ingest: SIGKILL the serving tier (no handler
+        # runs, no drain, no final checkpoint), cold-restart it from
+        # the published artifacts + WAL alone, and prove from the
+        # host_crash evidence block that every ACKED ingest batch
+        # survived exactly once (docs/RESILIENCE.md §Durability).
+        ChaosEntry(name="SIGKILL", target="serve", kind="signal",
+                   at_s=0.55 * duration_s,
+                   expect=("ingest_durable", "ingest_no_duplicates")),
     ]
 
 

@@ -47,8 +47,16 @@ GAMEDAY_SCHEMA = "npairloss-gameday-v1"
 REPORT_KEYS = (
     "schema", "window_s", "seed", "traffic", "faults", "incidents",
     "slo", "drain", "zero_drop", "comms", "trainer", "qtrace",
-    "verdict", "failures",
+    "host_crash", "verdict", "failures",
 )
+# Durable-ingest evidence the SIGKILL drill stores (host_crash block;
+# ``{"available": false}`` on runs that scripted no serve kill).  The
+# ingest_durable / ingest_no_duplicates fault checks are RECOMPUTED
+# from these numbers by ``_gate_failures`` — a report whose fault rows
+# claim the checks passed over evidence that says otherwise is refused.
+HOST_CRASH_KEYS = ("available", "kills", "acked_batches",
+                   "acked_vectors", "lost", "duplicates",
+                   "torn_records", "self_recall")
 TRAFFIC_KEYS = ("planned", "fed", "answered", "errors", "rejected",
                 "sha256")
 FAULT_KEYS = (
@@ -133,6 +141,28 @@ def _slo_gate(rows: Sequence[Dict[str, Any]], metric: str, bad,
 # -- fault evaluation --------------------------------------------------------
 
 
+def _host_crash_checks(block: Any) -> Dict[str, bool]:
+    """The durable-ingest judgements, derived ONLY from the host_crash
+    evidence block (docs/RESILIENCE.md §Durability): ``ingest_durable``
+    needs at least one kill actually delivered, zero acknowledged
+    vectors lost, and the replayed gallery still answering each acked
+    vector with itself (recall parity); ``ingest_no_duplicates`` is the
+    exactly-once half — a replay that applied a record twice shows up
+    as duplicate ids in the final index."""
+    ok = isinstance(block, dict) and block.get("available") is True
+    if not ok:
+        return {"ingest_durable": False, "ingest_no_duplicates": False}
+    try:
+        durable = (int(block.get("kills", 0)) >= 1
+                   and int(block.get("acked_vectors", -1)) > 0
+                   and int(block.get("lost", -1)) == 0
+                   and float(block.get("self_recall", 0.0)) >= 0.99)
+        nodup = int(block.get("duplicates", -1)) == 0
+    except (TypeError, ValueError):
+        return {"ingest_durable": False, "ingest_no_duplicates": False}
+    return {"ingest_durable": durable, "ingest_no_duplicates": nodup}
+
+
 def _alert_events(alerts: Sequence[Dict[str, Any]], slo: str
                   ) -> Tuple[bool, bool]:
     fired = resolved = False
@@ -190,7 +220,8 @@ def _observed_stage(entry: Dict[str, Any], *, windows, serve_rows,
 def _eval_fault(entry: Dict[str, Any], *, alerts, remediation,
                 observed_fires: Dict[str, int], client_errors: int,
                 trainer: Dict[str, Any], windows=(), serve_rows=(),
-                qtrace: Optional[Dict[str, Any]] = None
+                qtrace: Optional[Dict[str, Any]] = None,
+                host_crash: Optional[Dict[str, Any]] = None
                 ) -> Dict[str, Any]:
     name = entry["name"]
     kind = entry.get("kind", "failpoint")
@@ -215,6 +246,8 @@ def _eval_fault(entry: Dict[str, Any], *, alerts, remediation,
             checks[check] = 75 in (trainer.get("exit_codes") or [])
         elif check == "resume":
             checks[check] = bool(trainer.get("resumed"))
+        elif check in ("ingest_durable", "ingest_no_duplicates"):
+            checks[check] = _host_crash_checks(host_crash)[check]
         else:
             checks[check] = False  # unknown check never passes
     ok = all(checks.values())
@@ -267,6 +300,7 @@ def build_gameday_report(
     pad_after_s: float = 10.0,
     min_hot_swaps: int = 3,
     qtrace: Optional[Dict[str, Any]] = None,
+    host_crash: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Assemble (and self-judge) the report.  Inputs are plain dicts/
     lists — the runner loads the artifacts; this function only
@@ -288,7 +322,7 @@ def build_gameday_report(
                      else train_remediation),
         observed_fires=observed_fires, client_errors=client_errors,
         trainer=trainer, windows=windows, serve_rows=serve_rows,
-        qtrace=qtrace) for e in entries]
+        qtrace=qtrace, host_crash=host_crash) for e in entries]
 
     n, inside, breaches, worst = _slo_gate(
         serve_rows, "p99_ms", lambda v: v > p99_target_ms, windows)
@@ -330,6 +364,8 @@ def build_gameday_report(
         "trainer": {key: trainer.get(key) for key in TRAINER_KEYS},
         "qtrace": (dict(qtrace) if isinstance(qtrace, dict)
                    else {"available": False}),
+        "host_crash": (dict(host_crash) if isinstance(host_crash, dict)
+                       else {"available": False}),
         "verdict": "fail",
         "failures": [],
     }
@@ -370,6 +406,25 @@ def _gate_failures(report: Dict[str, Any]) -> List[str]:
             bad = [c for c, ok in (fault.get("checks") or {}).items()
                    if not ok]
             failures.append(f"fault check failed: {name} ({bad})")
+    # Durable-ingest checks are RECOMPUTED from the host_crash evidence
+    # block, never trusted from the stored fault row — a report whose
+    # SIGKILL fault claims ingest_durable over evidence showing acked
+    # loss (or no evidence at all) is refused here, which is the same
+    # gate validate_gameday_report re-derives.
+    hc: Optional[Dict[str, bool]] = None
+    for fault in report["faults"]:
+        ingest_checks = [c for c in (fault.get("expect") or ())
+                         if c in ("ingest_durable", "ingest_no_duplicates")]
+        if not ingest_checks:
+            continue
+        if hc is None:
+            hc = _host_crash_checks(report.get("host_crash"))
+        for check in ingest_checks:
+            if not hc[check]:
+                failures.append(
+                    f"host-crash evidence refutes {fault.get('name', '?')}"
+                    f": {check} recomputed false from the host_crash "
+                    f"block")
     p99 = report["slo"]["p99"]
     if p99["breaches_outside"]:
         failures.append(
@@ -453,6 +508,14 @@ def validate_gameday_report(obj: Any) -> Optional[str]:
     if not isinstance(obj["qtrace"], dict):
         return "qtrace must be an object (the summarized qtrace "\
                "evidence, or {\"available\": false})"
+    hc = obj["host_crash"]
+    if not isinstance(hc, dict):
+        return "host_crash must be an object (the durable-ingest "\
+               "evidence, or {\"available\": false})"
+    if hc.get("available"):
+        for key in HOST_CRASH_KEYS:
+            if key not in hc:
+                return f"host_crash missing key: {key}"
 
     # Recompute the gates from the evidence; the stored verdict and
     # failures must agree with them.

@@ -93,6 +93,24 @@ Failpoints wired into the framework (docs/RESILIENCE.md):
                               recall-floor watchdog and the
                               probe-escalation remediation
                               (docs/OBSERVABILITY.md §Quality)
+  ``snapshot.commit.dirsync``  die after the atomic rename but before
+                              the parent-directory fsync — the commit
+                              landed in the page cache only, the
+                              durability hole the dir-fsync exists to
+                              close (docs/RESILIENCE.md §Durability)
+  ``wal.append.torn``         truncate the WAL record mid-write (half
+                              the framed bytes land) — recovery must
+                              truncate the torn tail loudly and count
+                              it, never replay garbage
+  ``wal.rotate.crash``        die during segment rotation, after the
+                              old segment's seal is written but before
+                              the new segment file exists — recovery
+                              must start a fresh segment
+  ``wal.gc.crash``            die mid-GC, after some covered segments
+                              are unlinked but not all — recovery must
+                              tolerate the gap and replay is unaffected
+                              (GC only ever removes sealed segments at
+                              or below the checkpoint watermark)
   ==========================  =============================================
 
 ``times`` counts fires: an armed point fires its next ``times`` checks

@@ -34,6 +34,7 @@ class RetryPolicy:
     max_delay: float = 30.0
     multiplier: float = 2.0
     jitter: float = 0.25
+    jitter_cap_s: Optional[float] = None
     retry_on: Tuple[Type[BaseException], ...] = (OSError,)
 
     def __post_init__(self):
@@ -41,17 +42,54 @@ class RetryPolicy:
             raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
         if self.jitter < 0 or self.jitter > 1:
             raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+        if self.jitter_cap_s is not None and self.jitter_cap_s < 0:
+            raise ValueError(
+                f"jitter_cap_s must be >= 0, got {self.jitter_cap_s}")
 
     def delay(self, attempt: int,
               rng: Optional[random.Random] = None) -> float:
         """Backoff before the retry that follows failed attempt
-        ``attempt`` (1-based)."""
+        ``attempt`` (1-based).  ``jitter_cap_s`` bounds the ABSOLUTE
+        jitter contribution: once the exponential base delay grows
+        large, relative jitter stops scaling with it, so a fleet of
+        late-attempt retriers still decorrelates without one unlucky
+        draw doubling a 30s wait."""
         d = min(self.base_delay * self.multiplier ** (attempt - 1),
                 self.max_delay)
         if self.jitter:
             u = (rng.random() if rng is not None else random.random())
-            d *= 1.0 + self.jitter * (2.0 * u - 1.0)
+            spread = self.jitter * d
+            if self.jitter_cap_s is not None:
+                spread = min(spread, self.jitter_cap_s)
+            d += spread * (2.0 * u - 1.0)
         return max(d, 0.0)
+
+
+# Named policies: call sites that retry for a *reason* declare it here
+# once, so the schedule is reviewable in one place instead of scattered
+# inline literals.  WAL replay re-reads whole segment files (cheap,
+# must converge fast after a cold restart); segment open contends with
+# the GC unlink window (short, capped jitter keeps the tail bounded).
+_NAMED_POLICIES = {
+    "wal_replay": RetryPolicy(max_attempts=4, base_delay=0.05,
+                              max_delay=1.0, jitter=0.5,
+                              jitter_cap_s=0.2),
+    "wal_segment_open": RetryPolicy(max_attempts=3, base_delay=0.02,
+                                    max_delay=0.5, jitter=0.5,
+                                    jitter_cap_s=0.1),
+}
+
+
+def named_policy(name: str) -> RetryPolicy:
+    """The registered :class:`RetryPolicy` for ``name``; KeyError with
+    the known names when the name is not registered (a typo'd policy
+    name must fail loudly, not fall back to defaults)."""
+    try:
+        return _NAMED_POLICIES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown retry policy {name!r} — known: "
+            f"{sorted(_NAMED_POLICIES)}") from None
 
 
 def call_with_retry(

@@ -128,7 +128,7 @@ def write_manifest(snapshot_dir: str, step: int,
                    checksums: Dict[str, Dict[str, Any]],
                    extra: Optional[Dict[str, Any]] = None) -> str:
     """Write ``manifest.json`` into ``snapshot_dir`` atomically
-    (tmp file + fsync + rename)."""
+    (tmp file + fsync + rename + dir fsync)."""
     manifest = {
         "format": SNAPSHOT_FORMAT,
         "step": int(step),
@@ -144,6 +144,14 @@ def write_manifest(snapshot_dir: str, step: int,
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # The rename made the manifest's CONTENT durable but not its NAME:
+    # until the directory entry table is fsynced, a power cut can
+    # resurrect the dir without manifest.json — the classic lost-rename
+    # bug.  Syncing here also covers every other entry already in
+    # ``snapshot_dir`` (the array files a commit wrote before us), so a
+    # commit_snapshot tmp dir is fully durable before rename-publish.
+    failpoints.fire("snapshot.commit.dirsync")
+    _fsync_dir(snapshot_dir)
     return path
 
 

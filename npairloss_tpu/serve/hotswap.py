@@ -179,7 +179,24 @@ class SnapshotSwapper:
             index=index, index_path=index_path,
             snapshot_path=snapshot_path if model is not None else None,
         )
-        self.server.swap_engines(engines, freshness)
+        old_wm = int(getattr(old.index, "ingest_watermark", 0))
+        new_wm = int(getattr(index, "ingest_watermark", 0))
+
+        def _prepare() -> None:
+            # Runs under the server's ingest lock, at the flip itself:
+            # the durability watermark the tier answers FROM changes
+            # here, and the WAL records above ``new_wm`` stay pending
+            # (replayed into the next checkpoint, not into this live
+            # tier — a post-warmup in-place add would recompile on the
+            # serving path).  Logged so a watermark REGRESSION at swap
+            # time is visible evidence, never a silent rewind.
+            if old_wm or new_wm:
+                log.info(
+                    "hot-swap: ingest watermark %d -> %d (WAL records "
+                    "above %d remain pending for the next checkpoint)",
+                    old_wm, new_wm, new_wm)
+
+        self.server.swap_engines(engines, freshness, prepare=_prepare)
         detail: Dict[str, Any] = {
             "swapped": ([] + (["model"] if new_state is not None else [])
                         + (["index"] if new_index is not None else [])),

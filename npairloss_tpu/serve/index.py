@@ -99,6 +99,13 @@ class GalleryIndex:
     # stamp now.  ``index_age_s`` on /healthz and per-answer freshness
     # stamps derive from it.
     created: Optional[float] = None
+    # Durability watermark (docs/RESILIENCE.md §Durability): the last
+    # WAL sequence number whose ingest this gallery CONTAINS.  Committed
+    # into the manifest on save and restored on load, it is the one
+    # sequence-number source of truth shared by snapshot publication,
+    # cold-restart replay (records <= watermark are skipped —
+    # exactly-once) and WAL segment GC.  0 = no WAL ingest applied.
+    ingest_watermark: int = 0
     # Host master copy (unpadded, normalized): add() re-pads + re-places
     # from here instead of pulling the gallery back off the mesh.
     _host_emb: Optional[np.ndarray] = None
@@ -316,8 +323,14 @@ class GalleryIndex:
         return final
 
     def _manifest_extra(self) -> dict:
-        """Extra manifest keys a subclass commits (IVF: cluster count)."""
-        return {}
+        """Extra manifest keys this class commits; subclasses (IVF:
+        cluster count) must MERGE ``super()._manifest_extra()`` so the
+        ingest watermark survives every kind.  The key is omitted at 0
+        to keep pre-WAL manifests byte-identical."""
+        out: dict = {}
+        if self.ingest_watermark:
+            out["ingest_watermark"] = int(self.ingest_watermark)
+        return out
 
     @classmethod
     def load(
@@ -346,6 +359,10 @@ class GalleryIndex:
                 ) from e
         verify_restored(tree, manifest)
         idx = cls._from_tree(tree, manifest, mesh, axis)
+        # One restore site for every kind: subclasses override
+        # _from_tree but the watermark contract is the base class's.
+        wm = manifest.get("ingest_watermark")
+        idx.ingest_watermark = int(wm) if isinstance(wm, int) else 0
         idx._place()
         return idx
 
@@ -437,4 +454,5 @@ def index_info(path: str) -> dict:
         "size": m.get("size"),
         "dim": m.get("dim"),
         "created": m.get("created"),
+        "ingest_watermark": int(m.get("ingest_watermark", 0) or 0),
     }

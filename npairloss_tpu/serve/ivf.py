@@ -196,11 +196,16 @@ class IVFIndex(GalleryIndex):
     @classmethod
     def from_gallery(cls, gallery: GalleryIndex, **build_kw) -> "IVFIndex":
         """Cluster an already-built/loaded flat gallery (shares its host
-        arrays — rows are already unit-norm)."""
-        return cls.build_ivf(
+        arrays — rows are already unit-norm).  The ingest watermark
+        rides along: the IVF rebuild contains exactly the rows the flat
+        gallery did, so it covers the same WAL prefix — dropping it
+        would force a full replay against the converted index."""
+        out = cls.build_ivf(
             gallery._host_emb, gallery._host_labels, ids=gallery.ids,
             mesh=gallery.mesh, axis=gallery.axis, normalize=False,
             **build_kw)
+        out.ingest_watermark = gallery.ingest_watermark
+        return out
 
     # -- packing / placement ----------------------------------------------
 
@@ -338,7 +343,11 @@ class IVFIndex(GalleryIndex):
         }
 
     def _manifest_extra(self) -> dict:
+        # Merge the base extras (the ingest watermark) — an IVF commit
+        # that dropped the watermark would force a full-WAL replay on
+        # every cold restart and block segment GC forever.
         return {
+            **super()._manifest_extra(),
             "n_clusters": int(self.centroids_host.shape[0]),
             **({"parity": self.parity} if self.parity else {}),
         }

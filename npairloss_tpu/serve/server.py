@@ -16,7 +16,11 @@ Request: ``{"id": ..., "embedding": [...]}`` (a query embedding) or
 ``{"id": ..., "input": [...]}`` (raw input, needs a restored model).
 Answer: ``{"id", "neighbors": [{"rank", "row", "gallery_id", "label",
 "score"}, ...]}``; a rejected/failed query answers ``{"id", "error"}``
-instead of being silently dropped.
+instead of being silently dropped.  An ingest record ``{"id",
+"ingest": {"ids", "labels", "embeddings"}}`` takes the durable path
+instead (docs/RESILIENCE.md §Durability): write-ahead log append +
+group-commit fsync barrier BEFORE the ``{"id", "ingested", "seq"}``
+ack, so a SIGKILL after the ack can never lose the vectors.
 
 Shutdown is the training preemption contract (docs/RESILIENCE.md)
 applied to serving: SIGTERM/SIGINT set the ``resilience.preempt`` flag,
@@ -30,6 +34,7 @@ last line the JSONL front end writes.
 
 from __future__ import annotations
 
+import base64
 import collections
 import contextlib
 import dataclasses
@@ -38,7 +43,7 @@ import logging
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -48,6 +53,55 @@ from npairloss_tpu.serve.batcher import BatcherConfig, QueueFullError
 from npairloss_tpu.serve.engine import QueryEngine
 
 log = logging.getLogger("npairloss_tpu.serve")
+
+
+def encode_ingest_body(ingest: Dict[str, Any]) -> Dict[str, Any]:
+    """A client ingest block -> the ``npairloss-wal-v1`` ``kind: "add"``
+    record body (docs/RESILIENCE.md §Durability).  ``ids`` are REQUIRED:
+    the WAL is the replay source of truth, and auto-assigned ids would
+    come out different on every replay — breaking the exactly-once
+    duplicate check.  The embedding matrix rides as base64 float32 so
+    the record (and the jax-free WAL validator reading it) stays
+    numpy-free."""
+    if not isinstance(ingest, dict):
+        raise ValueError("ingest must be an object")
+    emb = np.asarray(ingest.get("embeddings"), np.float32)
+    if emb.ndim != 2 or emb.shape[0] == 0 or emb.shape[1] == 0:
+        raise ValueError(
+            f"ingest embeddings must be a non-empty 2-D matrix, got "
+            f"shape {emb.shape}")
+    labels = ingest.get("labels")
+    ids = ingest.get("ids")
+    if not isinstance(labels, list) or len(labels) != emb.shape[0]:
+        raise ValueError("ingest labels must list one label per row")
+    if not isinstance(ids, list) or len(ids) != emb.shape[0]:
+        raise ValueError(
+            "ingest ids must list one id per row (replay determinism "
+            "forbids auto-assignment)")
+    return {
+        "kind": "add",
+        "ids": [int(i) for i in ids],
+        "labels": [int(x) for x in labels],
+        "dim": int(emb.shape[1]),
+        "emb": base64.b64encode(emb.tobytes()).decode("ascii"),
+    }
+
+
+def decode_ingest_payload(payload: Dict[str, Any]
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The inverse of :func:`encode_ingest_body`: a replayed WAL record
+    body -> ``(embeddings, labels, ids)`` ready for ``index.add``."""
+    ids = np.asarray(payload["ids"], np.int64)
+    raw = base64.b64decode(payload["emb"])
+    emb = np.frombuffer(raw, np.float32)
+    dim = int(payload["dim"])
+    if dim < 1 or emb.size != ids.shape[0] * dim:
+        raise ValueError(
+            f"ingest record seq {payload.get('seq')}: embedding bytes "
+            f"({emb.size} float32) do not match {ids.shape[0]} row(s) "
+            f"of dim {dim}")
+    return (emb.reshape(ids.shape[0], dim).copy(),
+            np.asarray(payload["labels"], np.int32), ids)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,6 +254,23 @@ class RetrievalServer:
         # never-remediated runs keep the absent-when-zero contract.
         self.swaps = 0  # guarded-by: _lock
         self._explicit_compile_key = False
+        # Durable-ingest state (docs/RESILIENCE.md §Durability): all
+        # None/zero until ``attach_wal`` arms the path, so a WAL-less
+        # server keeps its pre-PR behavior and summary shape.  The
+        # ingest lock serializes record application, checkpointing, and
+        # the hot-swap flip — ``_lock`` is only ever taken INSIDE it
+        # (never the reverse), so the two can nest without deadlock.
+        self.wal = None
+        self._ingest_lock = threading.Lock()
+        self._ingest_apply: Optional[Callable[[Dict[str, Any]], None]] = None
+        self._checkpoint_fn: Optional[Callable[[int], Optional[str]]] = None
+        self._checkpoint_every = 0
+        self.ingest_batches = 0  # guarded-by: _lock
+        self.ingest_vectors = 0  # guarded-by: _lock
+        self.ingest_errors = 0  # guarded-by: _lock
+        self._ingest_watermark = 0  # guarded-by: _ingest_lock
+        self._ckpt_watermark = 0  # guarded-by: _ingest_lock
+        self._ingest_since_ckpt = 0  # guarded-by: _ingest_lock
         self.replicaset = ReplicaSet(
             engines, batcher_cfg, self._replica_dispatch,
             span_fn=self._span, on_batch=self._record_batch,
@@ -606,9 +677,136 @@ class RetrievalServer:
                           + t_merge * 1e6))
         return answers
 
+    # -- durable ingest (docs/RESILIENCE.md §Durability) --------------------
+
+    def attach_wal(self, wal, apply_fn: Callable[[Dict[str, Any]], None],
+                   *, checkpoint_fn: Optional[Callable[[int],
+                                                       Optional[str]]] = None,
+                   checkpoint_every: int = 0, watermark: int = 0,
+                   checkpoint_watermark: int = 0) -> None:
+        """Arm the durable-ingest path: ``wal`` takes every record
+        BEFORE the ack, ``apply_fn(payload)`` applies a durable record
+        to the ingest gallery, and ``checkpoint_fn(watermark)``
+        publishes a snapshot covering everything up to ``watermark``
+        (returning its path, or None when there was nothing new) —
+        after which the server GCs the WAL segments that snapshot
+        covers.  ``watermark`` seeds the applied high-water mark (the
+        cold-restart replay already happened by the time this is
+        called); ``checkpoint_watermark`` seeds the last PUBLISHED
+        watermark (the base artifact's)."""
+        self.wal = wal
+        self._ingest_apply = apply_fn
+        self._checkpoint_fn = checkpoint_fn
+        self._checkpoint_every = int(checkpoint_every)
+        self._ingest_watermark = int(watermark)  # unguarded-ok: attach_wal runs at startup, before run_jsonl/serve threads exist
+        self._ckpt_watermark = int(checkpoint_watermark)  # unguarded-ok: startup-only, no concurrent ingest yet
+
+    @property
+    def ingest_watermark(self) -> int:
+        """The last WAL sequence number applied to the ingest gallery
+        (== the last acknowledged ingest; acks happen-after apply)."""
+        with self._ingest_lock:
+            return self._ingest_watermark
+
+    def _handle_ingest(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """One ingest record, start to ack: encode -> WAL append ->
+        group-commit durability barrier -> apply -> ack.  The ack NEVER
+        precedes the fsync covering the record — that ordering is the
+        whole durability contract, and the SIGKILL drill's oracle
+        assumes it.  Ingest records never enter the query pipeline, so
+        the drain invariant's population (queries == answered + errors
+        + rejected) is untouched."""
+        rid = rec.get("id")
+        if self.wal is None or self._ingest_apply is None:
+            with self._lock:
+                self.ingest_errors += 1
+            return {"id": rid,
+                    "error": "ingest requires a WAL (serve --wal-dir)"}
+        try:
+            body = encode_ingest_body(rec.get("ingest"))
+        except (ValueError, TypeError) as e:
+            with self._lock:
+                self.ingest_errors += 1
+            return {"id": rid, "error": f"bad ingest record: {e}"}
+        try:
+            seq = self.wal.append(body)
+            self.wal.wait_durable(seq)
+        except Exception as e:  # noqa: BLE001 — the client must hear "not durable"
+            with self._lock:
+                self.ingest_errors += 1
+            log.error("ingest %r failed before durability: %s", rid, e)
+            return {"id": rid, "error": f"ingest not durable: {e}"}
+        body["seq"] = seq
+        with self._ingest_lock:
+            self._ingest_apply(body)
+            self._ingest_watermark = seq
+            self._ingest_since_ckpt += 1
+        n = len(body["ids"])
+        with self._lock:
+            self.ingest_batches += 1
+            self.ingest_vectors += n
+        return {"id": rid, "ingested": n, "seq": seq}
+
+    def _maybe_checkpoint(self) -> None:
+        if (self._checkpoint_fn is None or self._checkpoint_every <= 0):
+            return
+        with self._ingest_lock:
+            due = self._ingest_since_ckpt >= self._checkpoint_every
+        if due:
+            self.checkpoint_now()
+
+    def checkpoint_now(self) -> Optional[str]:
+        """Publish an index snapshot at the current applied watermark,
+        then GC the WAL segments it covers — the one place snapshot
+        publication and WAL GC read the same sequence number.  Returns
+        the published path (None when nothing new was applied or no
+        checkpoint sink is attached)."""
+        if self._checkpoint_fn is None or self.wal is None:
+            return None
+        with self._ingest_lock:
+            wm = self._ingest_watermark
+            if wm <= self._ckpt_watermark:
+                return None
+            try:
+                path = self._checkpoint_fn(wm)
+            except Exception as e:  # noqa: BLE001 — a failed publish is not data loss
+                log.error("ingest checkpoint at watermark %d failed: %s "
+                          "— WAL retains the records", wm, e)
+                return None
+            self._ckpt_watermark = wm
+            self._ingest_since_ckpt = 0
+        if path is not None:
+            try:
+                self.wal.gc(wm)
+            except Exception as e:  # noqa: BLE001 — GC is space, not safety
+                log.error("wal GC at watermark %d failed: %s", wm, e)
+        return path
+
+    def ingest_stats(self) -> Dict[str, Any]:
+        """The /healthz + drain ``ingest`` block (present only when a
+        WAL is attached — the freshness-JSON contract): counters, the
+        two watermarks, and the WAL's own durability stats (including
+        the torn-tail counts recovery promised to surface)."""
+        with self._ingest_lock:
+            wm, ckpt = self._ingest_watermark, self._ckpt_watermark
+        with self._lock:
+            out: Dict[str, Any] = {
+                "batches": self.ingest_batches,
+                "vectors": self.ingest_vectors,
+                "errors": self.ingest_errors,
+            }
+        out["watermark"] = wm
+        out["checkpoint_watermark"] = ckpt
+        try:
+            out["wal"] = self.wal.stats() if self.wal is not None else {}
+        except Exception as e:  # noqa: BLE001 — stats must not fail health
+            out["wal"] = {"error": str(e)}
+        return out
+
     # -- remediation actuators (docs/RESILIENCE.md §Remediation) -----------
 
-    def swap_engines(self, engines, freshness: Optional[Freshness] = None
+    def swap_engines(self, engines, freshness: Optional[Freshness] = None,
+                     prepare: Optional[Callable[[], None]] = None
                      ) -> None:
         """Atomically publish a fresh engine tier — the hot-swap commit
         point (ROADMAP item 3's actuation half).  The caller must have
@@ -624,14 +822,22 @@ class RetrievalServer:
             raise ValueError(
                 f"swap must preserve the replica count: got "
                 f"{len(engines)}, tier has {len(self.engines)}")
-        with self._lock:
-            self.engines = engines
-            self.engine = engines[0]
-            if freshness is not None:
-                self.freshness = freshness
-            self.swaps += 1
-        for rep, eng in zip(self.replicaset.replicas, engines):
-            rep.engine = eng
+        # The flip runs under the ingest lock so a durable-ingest apply
+        # or checkpoint never races the republish (``prepare`` is the
+        # hot-swap's chance to reconcile ingest state against the
+        # incoming tier's watermark at the same serialization point);
+        # WAL-less servers pay one uncontended acquire.
+        with self._ingest_lock:
+            if prepare is not None:
+                prepare()
+            with self._lock:
+                self.engines = engines
+                self.engine = engines[0]
+                if freshness is not None:
+                    self.freshness = freshness
+                self.swaps += 1
+            for rep, eng in zip(self.replicaset.replicas, engines):
+                rep.engine = eng
         if self.qtrace is not None:
             # The generation-flip instant: answers after this marker
             # come from the new snapshot — a tail spike next to it is
@@ -782,6 +988,12 @@ class RetrievalServer:
             **({"hot_swaps": self.swaps} if self.swaps else {}),
             **({"remediation": self.remediation.last_by_policy()}
                if self.remediation is not None else {}),
+            # Durable-ingest evidence (block absent = no WAL attached —
+            # the freshness-JSON contract): counters, watermarks, and
+            # the WAL's torn-tail counts, on /healthz and the drain
+            # summary alike (docs/RESILIENCE.md §Durability).
+            **({"ingest": self.ingest_stats()}
+               if self.wal is not None else {}),
             # The online recall estimate (obs.quality): block absent =
             # shadowing off — the freshness-JSON contract again, so a
             # --shadow-rate 0 run keeps its pre-PR summary shape.
@@ -830,6 +1042,14 @@ class RetrievalServer:
         """Finish in-flight batches, flush telemetry, return the
         summary record.  Idempotent enough for every exit path."""
         self.replicaset.close(drain=True)
+        if self.wal is not None:
+            # Final ingest checkpoint: everything acked this run lands
+            # in a published snapshot before the process exits, so a
+            # clean shutdown leaves nothing for cold-restart replay.
+            try:
+                self.checkpoint_now()
+            except Exception as e:  # noqa: BLE001 — drain must finish
+                log.error("drain-time ingest checkpoint failed: %s", e)
         s = self.summary()
         if self.qtrace is not None and self.qtrace.out_path:
             try:
@@ -922,6 +1142,13 @@ class RetrievalServer:
                     with self._lock:
                         self.errors += 1
                     emit({"id": None, "error": f"bad request JSON: {e}"})
+                    continue
+                if isinstance(rec, dict) and "ingest" in rec:
+                    # Durable-ingest path: WAL + fsync barrier BEFORE
+                    # the ack, never through the query pipeline (the
+                    # drain invariant's population stays query-only).
+                    emit(self._handle_ingest(rec))
+                    self._maybe_checkpoint()
                     continue
                 qt = self._qtrace_begin(rec)
                 try:

@@ -449,6 +449,61 @@ def check_qtrace_log(path: str) -> List[str]:
     return []
 
 
+def _load_wal():
+    """File-path-load ``resilience.wal`` + its jax-free seams
+    (failpoints, retrying) WITHOUT importing the package — the
+    multi-module pre-seed idiom of ``_load_staticcheck``: parent
+    package names are stubbed and each loaded leaf is set as an
+    attribute so wal.py's guarded ``from npairloss_tpu.resilience
+    import failpoints`` resolves."""
+    import importlib.util
+    import types
+
+    name = "npairloss_tpu.resilience.wal"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg = "npairloss_tpu.resilience"
+    for stub in ("npairloss_tpu", pkg):
+        if stub not in sys.modules:
+            sys.modules[stub] = types.ModuleType(stub)
+    base = os.path.join(REPO, "npairloss_tpu", "resilience")
+    for leaf in ("failpoints", "retrying", "wal"):
+        mod_name = f"{pkg}.{leaf}"
+        if mod_name in sys.modules:
+            setattr(sys.modules[pkg], leaf, sys.modules[mod_name])
+            continue
+        spec = importlib.util.spec_from_file_location(
+            mod_name, os.path.join(base, leaf + ".py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = mod
+        spec.loader.exec_module(mod)
+        setattr(sys.modules[pkg], leaf, mod)
+    return sys.modules[name]
+
+
+def check_wal_dir(path: str,
+                  min_last_seq: Optional[int] = None) -> List[str]:
+    """Gate one ``npairloss-wal-v1`` directory: manifest schema-valid
+    per the one contract (validate_wal_dir — record CRCs, sealed-
+    segment seals, contiguous sequence numbers; a torn tail on the
+    FINAL segment is a crash artifact and passes), and — with
+    ``--wal-watermark`` — refusing a log whose last replayable record
+    falls short of the externally acknowledged watermark (the
+    truncated-then-patched copy the ci.sh cold-restart smoke feeds
+    it)."""
+    wal_mod = _load_wal()
+    err = wal_mod.validate_wal_dir(path, min_last_seq=min_last_seq)
+    if err is not None:
+        return [f"wal artifact refused: {err}"]
+    info = wal_mod.wal_info(path)
+    torn = (f", torn tail: {info['torn_bytes']} byte(s) in "
+            f"{info['torn_segment']}" if info.get("torn_tail") else "")
+    _log(f"wal artifact OK ({info['segments']} segment(s), "
+         f"{info['records']} record(s), last_seq {info['last_seq']}"
+         f"{torn})")
+    return []
+
+
 def check_gameday_report(path: str) -> List[str]:
     """Gate one ``npairloss-gameday-v1`` verdict: schema-valid and
     PASSING per the one contract (validate_gameday_report recomputes
@@ -835,6 +890,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "wiring",
     )
     ap.add_argument(
+        "--wal", metavar="PATH",
+        help="gate a durable-ingest WAL directory instead of the "
+        "bench trajectory: schema-valid (npairloss-wal-v1), record "
+        "CRCs and sealed-segment seals intact, sequence numbers "
+        "contiguous — the ci.sh cold-restart-smoke wiring",
+    )
+    ap.add_argument(
+        "--wal-watermark", dest="wal_watermark", type=int,
+        metavar="SEQ",
+        help="with --wal: additionally refuse a log whose last "
+        "replayable record falls short of this acknowledged sequence "
+        "number (a truncated-then-patched copy)",
+    )
+    ap.add_argument(
         "--static", nargs="?", const=REPO, default=None, metavar="ROOT",
         help="run the invariant linter (docs/STATICCHECK.md) over ROOT "
         "(default: this repo) instead of the bench trajectory and fail "
@@ -855,6 +924,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"REGRESSION: {v}")
             return 1
         print(f"bench_check OK (staticcheck over {args.static})")
+        return 0
+
+    if args.wal:
+        violations = check_wal_dir(args.wal,
+                                   min_last_seq=args.wal_watermark)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (wal artifact {args.wal})")
         return 0
 
     if args.gameday:

@@ -224,6 +224,153 @@ print(f"serving smoke OK (100 answers, p99 {drain['p99_ms']:.1f} ms, "
       f"0 post-warmup compiles, clean drain)")
 EOF
 
+echo "== durable-ingest cold-restart smoke (docs/RESILIENCE.md §Durability) =="
+# SIGKILL the serving tier mid-ingest (no handler, no drain), then
+# cold-restart from the published artifacts + WAL alone: every ACKED
+# ingest batch must survive, the jax-free gate must accept the real
+# WAL at the acked watermark, and refuse a truncated-then-patched copy
+# (clean record-boundary truncation — structurally valid, but the
+# acked records are gone).
+wd="$smoke_dir/waldrill"
+mkdir -p "$wd/idx"
+cp -r "$serve_dir/g.gidx" "$wd/idx/g_0000.gidx"
+mkfifo "$wd/in"
+JAX_PLATFORMS=cpu python -m npairloss_tpu serve \
+    --index-prefix "$wd/idx/g_" --wal-dir "$wd/wal" \
+    --wal-checkpoint-every 2 --top-k 5 --buckets 1,8 \
+    < "$wd/in" > "$wd/answers.jsonl" 2> "$wd/serve1.log" &
+wpid=$!
+exec 4> "$wd/in"
+python - <<'EOF' >&4  # three ingest batches (ids 1000+, seeded vectors)
+import json
+import numpy as np
+rng = np.random.default_rng(7)
+for b in range(3):
+    v = rng.standard_normal((2, 64)).astype(np.float32)
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    print(json.dumps({"id": f"ing-{b}", "ingest": {
+        "ids": [1000 + 10 * b, 1001 + 10 * b],
+        "labels": [7, 7], "embeddings": v.tolist()}}), flush=True)
+EOF
+for _ in $(seq 1 240); do  # wait for the three acks (warmup included)
+    [[ "$(grep -c '"ingested"' "$wd/answers.jsonl" 2>/dev/null)" -ge 3 ]] && break
+    kill -0 "$wpid" 2>/dev/null \
+        || { echo "smoke: server died before acking ingest"; cat "$wd/serve1.log"; exit 1; }
+    sleep 0.5
+done
+[[ "$(grep -c '"ingested"' "$wd/answers.jsonl")" -ge 3 ]] \
+    || { echo "smoke: ingest never acked"; cat "$wd/serve1.log"; exit 1; }
+# a fourth batch races the kill: it may or may not be acked — the
+# durability claim is about ACKED batches only
+python - <<'EOF' >&4
+import json
+import numpy as np
+rng = np.random.default_rng(8)
+v = rng.standard_normal((2, 64)).astype(np.float32)
+v /= np.linalg.norm(v, axis=1, keepdims=True)
+print(json.dumps({"id": "ing-race", "ingest": {
+    "ids": [2000, 2001], "labels": [7, 7],
+    "embeddings": v.tolist()}}), flush=True)
+EOF
+kill -KILL "$wpid" 2>/dev/null || true
+rc=0; wait "$wpid" || rc=$?
+exec 4>&-
+[[ "$rc" -ne 75 ]] \
+    || { echo "smoke: SIGKILL ran the drain handler (exit 75)?"; exit 1; }
+wm=$(python - "$wd/answers.jsonl" <<'EOF'
+import json, sys
+seqs = []
+for line in open(sys.argv[1]):
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue  # torn tail — the writer was SIGKILLed
+    if isinstance(r, dict) and r.get("ingested"):
+        seqs.append(int(r["seq"]))
+print(max(seqs) if seqs else 0)
+EOF
+)
+[[ "$wm" -ge 3 ]] || { echo "smoke: acked watermark $wm < 3"; exit 1; }
+python scripts/bench_check.py --wal "$wd/wal" --wal-watermark "$wm" \
+    || { echo "smoke: gate refused the REAL crashed WAL at watermark $wm"; exit 1; }
+python - "$wd/wal" "$wd/walcopy" "$wm" <<'EOF'
+import os, shutil, struct, sys
+src, dst, wm = sys.argv[1], sys.argv[2], int(sys.argv[3])
+shutil.copytree(src, dst)
+segs = sorted(n for n in os.listdir(dst) if n.endswith(".seg"))
+last = os.path.join(dst, segs[-1])
+with open(last, "rb") as f:
+    data = f.read()
+H = struct.Struct("<II")
+ends, off = [0], 0
+while off + H.size <= len(data):
+    ln, _ = H.unpack_from(data, off)
+    if off + H.size + ln > len(data):
+        break  # torn tail from the kill — drop it too
+    off += H.size + ln
+    ends.append(off)
+keep = wm - 1  # one ACKED record short of the watermark
+assert len(ends) > keep, f"segment holds {len(ends) - 1} record(s)"
+with open(last, "r+b") as f:
+    f.truncate(ends[keep])
+EOF
+if python scripts/bench_check.py --wal "$wd/walcopy" --wal-watermark "$wm" \
+    > "$wd/tamper.log" 2>&1; then
+    echo "smoke: gate ACCEPTED a truncated-then-patched WAL copy"
+    cat "$wd/tamper.log"; exit 1
+fi
+grep -q "acknowledged watermark" "$wd/tamper.log" \
+    || { echo "smoke: tampered WAL refused for the wrong reason"; cat "$wd/tamper.log"; exit 1; }
+# cold restart: recovery replays the WAL tail above the newest
+# checkpoint; the first acked batch's vector must retrieve ITSELF.
+mkfifo "$wd/in2"
+JAX_PLATFORMS=cpu python -m npairloss_tpu serve \
+    --index-prefix "$wd/idx/g_" --wal-dir "$wd/wal" \
+    --wal-checkpoint-every 2 --top-k 5 --buckets 1,8 \
+    < "$wd/in2" > "$wd/answers2.jsonl" 2> "$wd/serve2.log" &
+wpid=$!
+exec 4> "$wd/in2"
+python - <<'EOF' >&4
+import json
+import numpy as np
+rng = np.random.default_rng(7)  # batch 0's vectors, regenerated
+v = rng.standard_normal((2, 64)).astype(np.float32)
+v /= np.linalg.norm(v, axis=1, keepdims=True)
+print(json.dumps({"id": "q-replay", "embedding": v[0].tolist()}),
+      flush=True)
+EOF
+for _ in $(seq 1 240); do
+    [[ -s "$wd/answers2.jsonl" ]] && break
+    kill -0 "$wpid" 2>/dev/null \
+        || { echo "smoke: restarted server died"; cat "$wd/serve2.log"; exit 1; }
+    sleep 0.5
+done
+kill -TERM "$wpid" 2>/dev/null || true
+exec 4>&-
+rc=0; wait "$wpid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "smoke: restart drain expected exit 75, got $rc"; cat "$wd/serve2.log"; exit 1; }
+grep -q "wal: recovered" "$wd/serve2.log" \
+    || { echo "smoke: restart did not run WAL recovery"; cat "$wd/serve2.log"; exit 1; }
+ls "$wd"/idx/g_w*.gidx > /dev/null 2>&1 \
+    || { echo "smoke: no ingest checkpoint published under the prefix"; ls "$wd/idx"; exit 1; }
+python - "$wd/answers2.jsonl" <<'EOF'
+import json, sys
+lines = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", f"no drain summary: {drain}"
+ans = next(a for a in lines if a.get("id") == "q-replay")
+assert "neighbors" in ans, f"replay query errored: {ans}"
+top1 = ans["neighbors"][0]
+assert top1.get("gallery_id") == 1000, \
+    f"acked ingest vector did not survive the crash: top-1 {top1}"
+ing = drain.get("ingest") or {}
+wal = ing.get("wal") or {}
+print(f"cold-restart smoke OK (watermark {ing.get('watermark')}, "
+      f"checkpoint {ing.get('checkpoint_watermark')}, "
+      f"torn_records {wal.get('torn_records')})")
+EOF
+
 echo "== perf observatory smoke (docs/OBSERVABILITY.md §Perf) =="
 # A 10-step prof run on the tiny trunk must produce a schema-valid
 # report whose step-time decomposition reconciles to wall time, and

@@ -132,7 +132,11 @@ def test_signals_sorted_and_separated():
     sigs = chaos.signals(entries, "train")
     assert [s.name for s in sigs] == ["SIGTERM"]
     assert sigs[0].expect == ("preempt_exit", "resume")
-    assert chaos.signals(entries, "serve") == []
+    serve_sigs = chaos.signals(entries, "serve")
+    assert [s.name for s in serve_sigs] == ["SIGKILL"]
+    assert serve_sigs[0].expect == ("ingest_durable",
+                                    "ingest_no_duplicates")
+    assert serve_sigs[0].at_s == pytest.approx(0.55 * 75.0)
 
 
 def test_chaos_entry_validation_is_loud():
@@ -211,7 +215,10 @@ def _passing_report(**over):
         trainer={"segments": 2, "exit_codes": [75, 75], "resumed": True},
         observed_fires={"serve.stale_model": 6, "serve.latency": 40,
                         "serve.replica_crash": 1, "train.collapse": 160,
-                        "SIGTERM": 1},
+                        "SIGTERM": 1, "SIGKILL": 1},
+        host_crash={"available": True, "kills": 1, "acked_batches": 3,
+                    "acked_vectors": 24, "lost": 0, "duplicates": 0,
+                    "torn_records": 0, "self_recall": 1.0},
         client_errors=0, window_s=75.0, seed=0,
         p99_target_ms=150.0, recall_floor=0.9, min_hot_swaps=3,
         qtrace={"available": True,
@@ -321,6 +328,62 @@ def test_missing_block_key_refused():
     assert "zero_drop missing key" in validate_gameday_report(bad)
     assert "non-empty" in validate_gameday_report(
         dict(report, faults=[]))
+
+
+def test_host_crash_lost_vector_fails():
+    report = _passing_report(host_crash={
+        "available": True, "kills": 1, "acked_batches": 3,
+        "acked_vectors": 24, "lost": 2, "duplicates": 0,
+        "torn_records": 0, "self_recall": 1.0})
+    assert report["verdict"] == "fail"
+    assert any("ingest_durable recomputed false" in f
+               for f in report["failures"])
+    # A kill that leaves duplicates fails the exactly-once half.
+    report = _passing_report(host_crash={
+        "available": True, "kills": 1, "acked_batches": 3,
+        "acked_vectors": 24, "lost": 0, "duplicates": 1,
+        "torn_records": 0, "self_recall": 1.0})
+    assert any("ingest_no_duplicates recomputed false" in f
+               for f in report["failures"])
+
+
+def test_host_crash_evidence_required():
+    # No evidence block at all: the SIGKILL fault's checks cannot pass.
+    report = _passing_report(host_crash=None)
+    assert report["verdict"] == "fail"
+    assert report["host_crash"] == {"available": False}
+    assert any("host-crash evidence refutes" in f
+               for f in report["failures"])
+    # Recall parity below the floor is a loss in disguise.
+    report = _passing_report(host_crash={
+        "available": True, "kills": 1, "acked_batches": 3,
+        "acked_vectors": 24, "lost": 0, "duplicates": 0,
+        "torn_records": 0, "self_recall": 0.5})
+    assert any("ingest_durable" in f for f in report["failures"])
+
+
+def test_host_crash_tampered_pass_refused():
+    # Flip the stored verdict AND the fault row's checks to true over
+    # refuting evidence: the validator recomputes from host_crash and
+    # refuses — the durable-ingest judgement is never trusted.
+    report = _passing_report(host_crash={
+        "available": True, "kills": 1, "acked_batches": 3,
+        "acked_vectors": 24, "lost": 5, "duplicates": 0,
+        "torn_records": 0, "self_recall": 1.0})
+    tampered = dict(report, verdict="pass", failures=[])
+    tampered["faults"] = [
+        dict(f, ok=True, checks={c: True for c in f["checks"]})
+        for f in report["faults"]]
+    err = validate_gameday_report(tampered)
+    assert err is not None and "host-crash evidence refutes" in err
+
+
+def test_host_crash_available_demands_full_evidence():
+    report = _passing_report()
+    hc = {k: v for k, v in report["host_crash"].items()
+          if k != "torn_records"}
+    err = validate_gameday_report(dict(report, host_crash=hc))
+    assert err is not None and "host_crash missing key" in err
 
 
 def test_incident_windows_pads_and_horizon():

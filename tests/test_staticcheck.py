@@ -212,16 +212,19 @@ def test_locks_real_annotations_register():
     tree = SourceTree(REPO)
     expected = {
         ("npairloss_tpu/obs/live/slo.py", "SLOEvaluator"):
-            {"_burning"},
+            ({"_burning"}, {"_lock"}),
         ("npairloss_tpu/obs/live/registry.py", "MetricRegistry"):
-            {"_metrics"},
+            ({"_metrics"}, {"_lock"}),
         ("npairloss_tpu/resilience/remediate.py", "RemediationEngine"):
-            {"_seq", "_pending", "_undos", "_last", "history"},
+            ({"_seq", "_pending", "_undos", "_last", "history"},
+             {"_lock"}),
         ("npairloss_tpu/serve/server.py", "RetrievalServer"):
-            {"engines", "engine", "freshness", "swaps", "queries",
-             "answered", "errors"},
+            ({"engines", "engine", "freshness", "swaps", "queries",
+              "answered", "errors", "_ingest_watermark",
+              "_ckpt_watermark"},
+             {"_lock", "_ingest_lock"}),
     }
-    for (rel, cls_name), attrs in expected.items():
+    for (rel, cls_name), (attrs, locks) in expected.items():
         mod = tree.parse(rel)
         cls = next(n for n in ast_mod.walk(mod)
                    if isinstance(n, ast_mod.ClassDef)
@@ -229,7 +232,7 @@ def test_locks_real_annotations_register():
         guarded = guarded_attrs(cls, tree.comments(rel))
         missing = attrs - set(guarded)
         assert not missing, f"{cls_name}: {missing} never registered"
-        assert all(v == "_lock" for v in guarded.values())
+        assert set(guarded.values()) == locks, cls_name
 
 
 def test_locks_missing_lock_attr_flagged(tmp_path):

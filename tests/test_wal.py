@@ -1,0 +1,495 @@
+"""Durable gallery ingest: WAL unit tests, the crash-point matrix, the
+artifact validator's tamper suite, the retry-policy satellites, and the
+slow-marked multi-SIGKILL disaster drill (docs/RESILIENCE.md §9).
+
+The crash-point matrix abandons live ``WriteAheadLog`` instances
+without ``close()`` (the SIGKILL analogue for in-process tests) or
+crashes them mid-operation through the §6 failpoints, then reopens the
+directory and asserts the exactly-once contract: every record whose
+``wait_durable`` returned (the ack barrier) is replayed exactly once
+above the watermark, torn tails are truncated loudly, and unacked
+records may vanish but never corrupt.
+"""
+
+import base64
+import json
+import os
+import shutil
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from npairloss_tpu.resilience import failpoints
+from npairloss_tpu.resilience.retrying import RetryPolicy, named_policy
+from npairloss_tpu.resilience.wal import (
+    MANIFEST_NAME,
+    WAL_FORMAT,
+    WalCorruptionError,
+    WalError,
+    WriteAheadLog,
+    load_wal_manifest,
+    validate_wal_dir,
+    validate_wal_manifest,
+    wal_info,
+)
+
+_HEADER = struct.Struct("<II")
+
+
+def _add(i, rows=2, dim=4):
+    """A well-formed ``kind: "add"`` record body (seq is assigned by
+    ``append``); the emb bytes are deterministic per ``i``."""
+    raw = np.full(rows * dim, float(i), np.float32).tobytes()
+    return {"kind": "add", "ids": [1000 + 10 * i + j for j in range(rows)],
+            "labels": [7] * rows, "dim": dim,
+            "emb": base64.b64encode(raw).decode("ascii")}
+
+
+def _replayed(path, after_seq=0):
+    wal = WriteAheadLog(str(path))
+    try:
+        return [rec["seq"] for rec in wal.replay(after_seq=after_seq)]
+    finally:
+        wal.close()
+
+
+# -- unit: append / replay / rotation / GC -----------------------------------
+
+
+def test_append_assigns_contiguous_seqs_and_replays(tmp_path):
+    with WriteAheadLog(str(tmp_path / "wal")) as wal:
+        seqs = [wal.append(_add(i)) for i in range(5)]
+        assert seqs == [1, 2, 3, 4, 5]
+        wal.wait_durable(5)
+        assert [r["seq"] for r in wal.replay()] == [1, 2, 3, 4, 5]
+        # The watermark contract: records at or below are skipped.
+        assert [r["seq"] for r in wal.replay(after_seq=3)] == [4, 5]
+        stats = wal.stats()
+        assert stats["last_seq"] == 5 and stats["durable_seq"] == 5
+        assert stats["torn_records"] == 0
+    assert validate_wal_dir(str(tmp_path / "wal")) is None
+
+
+def test_reopen_resumes_sequence(tmp_path):
+    path = tmp_path / "wal"
+    with WriteAheadLog(str(path)) as wal:
+        for i in range(3):
+            wal.append(_add(i))
+    with WriteAheadLog(str(path)) as wal:
+        assert wal.last_seq == 3
+        assert wal.append(_add(3)) == 4
+        assert [r["seq"] for r in wal.replay()] == [1, 2, 3, 4]
+
+
+def test_rotation_seals_segments_and_gc_respects_watermark(tmp_path):
+    path = tmp_path / "wal"
+    with WriteAheadLog(str(path), segment_max_bytes=200) as wal:
+        for i in range(8):
+            wal.append(_add(i))
+        stats = wal.stats()
+        assert stats["segments"] > 1
+        assert stats["sealed_segments"] == stats["segments"] - 1
+        sealed = load_wal_manifest(str(path))["sealed"]
+        assert validate_wal_manifest(load_wal_manifest(str(path))) is None
+        # A watermark below every sealed last_seq removes nothing ...
+        assert wal.gc(0) == 0
+        # ... and one covering some sealed segments removes exactly
+        # those, never the active segment.
+        cover = min(s["last_seq"] for s in sealed.values())
+        assert wal.gc(cover) >= 1
+        assert [r["seq"] for r in wal.replay(after_seq=cover)] == \
+            list(range(cover + 1, 9))
+    assert validate_wal_dir(str(path)) is None
+    info = wal_info(str(path))
+    assert info["last_seq"] == 8 and info["first_seq"] > 1
+
+
+def test_group_commit_flusher_makes_appends_durable(tmp_path):
+    with WriteAheadLog(str(tmp_path / "wal"),
+                       flush_interval_s=0.02) as wal:
+        seq = wal.append(_add(0))
+        wal.wait_durable(seq, timeout=10.0)
+        assert wal.durable_seq >= seq
+
+
+def test_bad_payload_and_closed_log_are_loud(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    with pytest.raises(WalError, match="ids/labels"):
+        wal.append({"kind": "add", "ids": [], "labels": [],
+                    "dim": 4, "emb": "AA=="})
+    wal.close()
+    with pytest.raises(WalError, match="closed"):
+        wal.append(_add(0))
+
+
+# -- the crash-point matrix ---------------------------------------------------
+
+
+def test_crash_before_ack_loses_only_the_unacked_record(tmp_path):
+    """Mid-record crash (``wal.append.torn``): the torn, never-acked
+    record is truncated LOUDLY; every previously acked record replays
+    exactly once and the sequence continues with no gap."""
+    path = tmp_path / "wal"
+    wal = WriteAheadLog(str(path))
+    for i in range(3):
+        wal.wait_durable(wal.append(_add(i)))
+    with failpoints.armed("wal.append.torn"):
+        with pytest.raises(failpoints.InjectedFault):
+            wal.append(_add(3))
+    # No close: the process is "gone".  Reopen recovers.
+    wal2 = WriteAheadLog(str(path))
+    try:
+        assert wal2.torn_records == 1 and wal2.torn_bytes > 0
+        assert [r["seq"] for r in wal2.replay()] == [1, 2, 3]
+        # The torn seq was never burned: the next append reuses it.
+        assert wal2.append(_add(3)) == 4
+    finally:
+        wal2.close()
+
+
+def test_crash_after_ack_pre_flush_keeps_the_acked_record(tmp_path):
+    """With a long group-commit window the fsync has NOT happened when
+    append returns — but ``wait_durable`` (the ack barrier) forces it.
+    A crash right after the ack must not lose the record."""
+    path = tmp_path / "wal"
+    wal = WriteAheadLog(str(path), flush_interval_s=60.0)
+    seq = wal.append(_add(0))
+    wal.flush()            # the covering group-commit fsync
+    wal.wait_durable(seq)  # ack barrier returned => record is durable
+    # SIGKILL analogue: abandon the instance without close/flush.
+    assert _replayed(path) == [1]
+
+
+def test_crash_during_rotation_recovers_unsealed_tail(tmp_path):
+    """``wal.rotate.crash`` dies after the finished segment's fsync but
+    before its seal reaches the manifest: recovery must treat it as the
+    clean unsealed tail and keep appending — acked records intact."""
+    path = tmp_path / "wal"
+    wal = WriteAheadLog(str(path), segment_max_bytes=200)
+    acked = []
+    with failpoints.armed("wal.rotate.crash"):
+        for i in range(12):
+            try:
+                seq = wal.append(_add(i))
+            except failpoints.InjectedFault:
+                break
+            wal.wait_durable(seq)
+            acked.append(seq)
+        else:
+            pytest.fail("segment never rotated — raise the record size")
+    wal2 = WriteAheadLog(str(path), segment_max_bytes=200)
+    try:
+        assert [r["seq"] for r in wal2.replay()] == acked
+        nxt = wal2.append(_add(99))
+        assert nxt == acked[-1] + 1
+        assert validate_wal_dir(str(path)) is None
+    finally:
+        wal2.close()
+
+
+def test_crash_during_gc_drops_stale_seal_on_recovery(tmp_path):
+    """``wal.gc.crash`` dies after a covered segment is unlinked but
+    before the manifest rewrite: the manifest carries a seal for a
+    missing segment.  Recovery drops the stale seal (it is only
+    explainable as that crash) and replay above the watermark is
+    unaffected."""
+    path = tmp_path / "wal"
+    wal = WriteAheadLog(str(path), segment_max_bytes=200)
+    for i in range(8):
+        wal.wait_durable(wal.append(_add(i)))
+    sealed = load_wal_manifest(str(path))["sealed"]
+    assert sealed, "need at least one sealed segment for GC"
+    cover = min(s["last_seq"] for s in sealed.values())
+    with failpoints.armed("wal.gc.crash"):
+        with pytest.raises(failpoints.InjectedFault):
+            wal.gc(cover)
+    # The unlinked segment is gone but its seal survived the crash.
+    manifest = load_wal_manifest(str(path))
+    present = set(os.listdir(str(path)))
+    assert any(name not in present for name in manifest["sealed"])
+    wal2 = WriteAheadLog(str(path), segment_max_bytes=200)
+    try:
+        assert [r["seq"] for r in wal2.replay(after_seq=cover)] == \
+            list(range(cover + 1, 9))
+        # Recovery rewrote the manifest without the stale seal.
+        survivors = load_wal_manifest(str(path))["sealed"]
+        assert all(name in os.listdir(str(path)) for name in survivors)
+    finally:
+        wal2.close()
+    assert validate_wal_dir(str(path)) is None
+
+
+def test_replay_is_exactly_once_across_repeated_recoveries(tmp_path):
+    """Reopen + replay is idempotent: recovering twice (crash during
+    the first recovery's replay apply) never duplicates a record."""
+    path = tmp_path / "wal"
+    with WriteAheadLog(str(path)) as wal:
+        for i in range(4):
+            wal.wait_durable(wal.append(_add(i)))
+    assert _replayed(path, after_seq=2) == [3, 4]
+    assert _replayed(path, after_seq=2) == [3, 4]  # second cold start
+    assert _replayed(path, after_seq=4) == []      # watermark caught up
+
+
+# -- validator / tamper -------------------------------------------------------
+
+
+def test_validate_refuses_truncated_then_patched_copy(tmp_path):
+    """The ci.sh tamper: truncate the final segment at a record
+    boundary (structurally valid — recovery would accept it) — the
+    acknowledged watermark is what refuses it."""
+    path = tmp_path / "wal"
+    with WriteAheadLog(str(path)) as wal:
+        for i in range(3):
+            wal.wait_durable(wal.append(_add(i)))
+    copy = tmp_path / "tampered"
+    shutil.copytree(str(path), str(copy))
+    seg = [n for n in os.listdir(str(copy)) if n.endswith(".seg")]
+    assert len(seg) == 1
+    seg_path = os.path.join(str(copy), seg[0])
+    blob = open(seg_path, "rb").read()
+    off = 0
+    for _ in range(2):  # keep 2 of 3 records
+        length, _crc = _HEADER.unpack_from(blob, off)
+        off += _HEADER.size + length
+    with open(seg_path, "r+b") as f:
+        f.truncate(off)
+    # Structurally the copy is a fine WAL ...
+    assert validate_wal_dir(str(copy)) is None
+    # ... but the operator acked seq 3: refused.
+    err = validate_wal_dir(str(copy), min_last_seq=3)
+    assert err is not None and "acknowledged watermark" in err
+    assert validate_wal_dir(str(path), min_last_seq=3) is None
+
+
+def test_validate_refuses_doctored_manifest_and_content(tmp_path):
+    path = tmp_path / "wal"
+    with WriteAheadLog(str(path), segment_max_bytes=200) as wal:
+        for i in range(8):
+            wal.append(_add(i))
+    manifest = load_wal_manifest(str(path))
+    assert manifest["format"] == WAL_FORMAT
+    sealed_name = sorted(manifest["sealed"])[0]
+
+    # Wrong format tag.
+    doctored = dict(manifest, format="npairloss-wal-v0")
+    mpath = os.path.join(str(path), MANIFEST_NAME)
+    open(mpath, "w").write(json.dumps(doctored))
+    assert "format" in validate_wal_dir(str(path))
+
+    # Sealed CRC that disagrees with the bytes.
+    doctored = json.loads(json.dumps(manifest))
+    doctored["sealed"][sealed_name]["crc32"] ^= 1
+    open(mpath, "w").write(json.dumps(doctored))
+    assert "CRC" in validate_wal_dir(str(path))
+
+    # Flipped byte inside a SEALED segment: corruption, not a torn
+    # tail — refused by the validator AND by recovery.
+    open(mpath, "w").write(json.dumps(manifest))
+    assert validate_wal_dir(str(path)) is None
+    seg_path = os.path.join(str(path), sealed_name)
+    blob = bytearray(open(seg_path, "rb").read())
+    blob[_HEADER.size + 1] ^= 0xFF
+    open(seg_path, "wb").write(bytes(blob))
+    err = validate_wal_dir(str(path))
+    assert err is not None and sealed_name in err
+    with pytest.raises(WalCorruptionError):
+        WriteAheadLog(str(path), segment_max_bytes=200)
+
+
+def test_wal_info_reports_torn_tail_without_mutating(tmp_path):
+    path = tmp_path / "wal"
+    with WriteAheadLog(str(path)) as wal:
+        for i in range(3):
+            wal.append(_add(i))
+    seg = [n for n in os.listdir(str(path)) if n.endswith(".seg")][0]
+    seg_path = os.path.join(str(path), seg)
+    size = os.path.getsize(seg_path)
+    with open(seg_path, "r+b") as f:
+        f.truncate(size - 3)  # torn mid-payload
+    info = wal_info(str(path))
+    assert info["torn_tail"] and info["torn_bytes"] > 0
+    assert info["last_seq"] == 2
+    # A torn tail is a crash artifact: the validator passes ...
+    assert validate_wal_dir(str(path)) is None
+    # ... unless the torn record was acknowledged.
+    assert "acknowledged watermark" in validate_wal_dir(
+        str(path), min_last_seq=3)
+    # wal_info did not repair anything.
+    assert os.path.getsize(seg_path) == size - 3
+
+
+# -- satellites: retry policies and the snapshot dir-fsync pin ---------------
+
+
+def test_jitter_cap_bounds_absolute_jitter():
+    policy = RetryPolicy(max_attempts=3, base_delay=10.0, max_delay=100.0,
+                         multiplier=1.0, jitter=0.5, jitter_cap_s=0.1)
+
+    class _Rng:
+        def random(self):
+            return 1.0  # worst-case draw
+
+    # Uncapped jitter would add 5.0s; the cap bounds it to 0.1s.
+    assert policy.delay(1, rng=_Rng()) == pytest.approx(10.1)
+    uncapped = RetryPolicy(max_attempts=3, base_delay=10.0,
+                           max_delay=100.0, multiplier=1.0, jitter=0.5)
+    assert uncapped.delay(1, rng=_Rng()) == pytest.approx(15.0)
+    with pytest.raises(ValueError, match="jitter_cap_s"):
+        RetryPolicy(jitter_cap_s=-1.0)
+
+
+def test_named_retry_policies_registered():
+    for name in ("wal_replay", "wal_segment_open"):
+        policy = named_policy(name)
+        assert isinstance(policy, RetryPolicy)
+        assert policy.jitter_cap_s is not None
+    with pytest.raises(KeyError, match="wal_replay"):
+        named_policy("no_such_policy")
+
+
+def test_snapshot_dirsync_failpoint_sits_after_the_rename(tmp_path):
+    """The §1 commit's durability hole: ``snapshot.commit.dirsync``
+    fires AFTER ``os.replace`` lands the manifest but BEFORE the parent
+    dir fsync — so the pin proves the rename happened (the manifest is
+    at its final name) while the directory entry was never synced."""
+    from npairloss_tpu.resilience import snapshot as snap
+    d = tmp_path / "snap"
+    d.mkdir()
+    with failpoints.armed("snapshot.commit.dirsync"):
+        with pytest.raises(failpoints.InjectedFault):
+            snap.write_manifest(str(d), step=1, checksums={})
+    final = os.path.join(str(d), snap.MANIFEST_NAME)
+    assert os.path.exists(final)          # rename already landed
+    assert not os.path.exists(final + ".part")
+    manifest = json.load(open(final))
+    assert manifest["step"] == 1
+
+
+# -- the disaster drill: >= 5 SIGKILLs against a real serving process --------
+
+
+def _read_acks(stream, acks, stop):
+    for line in iter(stream.readline, b""):
+        if stop.is_set():
+            break
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and isinstance(rec.get("ingested"), int):
+            acks.append(rec)
+
+
+@pytest.mark.slow
+def test_sigkill_drill_zero_acked_loss(tmp_path):
+    """Five scripted SIGKILLs at randomized seeded offsets against a
+    real ``serve --wal-dir`` subprocess: every acknowledged vector
+    survives into the final artifact exactly once (docs/RESILIENCE.md
+    §9; the ci.sh smoke runs the single-kill version)."""
+    from npairloss_tpu.serve import GalleryIndex
+    from npairloss_tpu.serve.index import load_newest
+
+    rng = np.random.default_rng(1234)
+    dim, kills = 16, 5
+    base = rng.normal(size=(32, dim)).astype(np.float32)
+    idx_dir = tmp_path / "idx"
+    idx_dir.mkdir()
+    GalleryIndex.build(base, np.arange(32, dtype=np.int32) % 4).save(
+        str(idx_dir / "g_0000.gidx"))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "npairloss_tpu", "serve",
+           "--index-prefix", str(idx_dir / "g_"),
+           "--wal-dir", str(tmp_path / "wal"),
+           "--wal-flush-ms", "2", "--wal-checkpoint-every", "3",
+           "--top-k", "5", "--buckets", "1,8"]
+    acked = {}     # rid -> ids sent in that batch
+    sent = {}      # rid -> (ids, emb) for every batch ever sent
+    batch_no = 0
+
+    def _batch():
+        nonlocal batch_no
+        b = batch_no
+        batch_no += 1
+        ids = [100000 + 10 * b + j for j in range(2)]
+        emb = rng.normal(size=(2, dim)).astype(np.float32)
+        emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+        rid = f"drill-{b}"
+        sent[rid] = (ids, emb)
+        return rid, json.dumps({"id": rid, "ingest": {
+            "ids": ids, "labels": [9, 9],
+            "embeddings": emb.tolist()}}) + "\n"
+
+    log_path = str(tmp_path / "serve.log")
+    for k in range(kills + 1):
+        acks, stop = [], threading.Event()
+        proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=open(log_path, "ab"), env=env)
+        reader = threading.Thread(target=_read_acks,
+                                  args=(proc.stdout, acks, stop),
+                                  daemon=True)
+        reader.start()
+        try:
+            # Randomized seeded offset: how many batches to ack before
+            # this kill lands.
+            want = int(rng.integers(1, 4))
+            deadline = time.monotonic() + 180.0
+            sent_here = 0
+            while len(acks) < want and time.monotonic() < deadline:
+                if sent_here <= len(acks):
+                    rid, line = _batch()
+                    proc.stdin.write(line.encode())
+                    proc.stdin.flush()
+                    sent_here += 1
+                time.sleep(0.05)
+            assert len(acks) >= want, \
+                f"kill {k}: only {len(acks)} acks before deadline"
+            for rec in list(acks):
+                acked[rec["id"]] = sent[rec["id"]][0]
+            if k < kills:
+                # Race one more unacked batch into the pipe, then kill.
+                rid, line = _batch()
+                try:
+                    proc.stdin.write(line.encode())
+                    proc.stdin.flush()
+                except OSError:
+                    pass
+                proc.send_signal(signal.SIGKILL)
+                assert proc.wait(timeout=60) == -signal.SIGKILL
+            else:
+                # Final segment: graceful drain publishes the last
+                # checkpoint.
+                proc.send_signal(signal.SIGTERM)
+                assert proc.wait(timeout=120) == 75
+                # Late acks may land during the drain.
+                reader.join(timeout=10)
+                for rec in list(acks):
+                    acked[rec["id"]] = sent[rec["id"]][0]
+        finally:
+            stop.set()
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=60)
+
+    found = load_newest(str(idx_dir / "g_"))
+    assert found is not None
+    final_path, final = found
+    assert "g_w" in os.path.basename(final_path)  # watermark checkpoint
+    final_ids = np.asarray(final.ids).astype(np.int64)
+    id_set = set(final_ids.tolist())
+    # Zero duplicate applies (exactly-once replay) ...
+    assert final_ids.shape[0] == len(id_set)
+    # ... and zero acked-vector loss across all five kills.
+    lost = [i for ids in acked.values() for i in ids if i not in id_set]
+    assert lost == [], f"acked ids missing after {kills} kills: {lost}"
+    assert len(acked) >= kills  # at least one acked batch per segment
+    log_text = open(log_path, "rb").read().decode("utf-8", "replace")
+    assert log_text.count("wal: recovered") >= kills
