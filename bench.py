@@ -512,7 +512,8 @@ ENGINE_ROWS = (
     "blockwise_flagship_bf16matmul", "dense_flagship_bf16matmul",
     "ring_abs", "ring_flagship", "ring_flagship_nocache",
     "ring_flagship_bf16matmul", "serve_qps",
-    "flat_qps_1m", "ivf_qps_1m",
+    "flat_qps_1m", "ivf_qps_1m", "ivf_fused_qps_1m",
+    "ivf_probe_kernel_micro",
 )
 
 
@@ -880,9 +881,10 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     # Rows are stamped with the measuring platform: gallery-scale rows
     # may be captured on CPU during tunnel outages, and that provenance
     # must ride the row, not the record headline.
-    def _serve_scale_rows(want_flat, want_ivf):
+    def _serve_scale_rows(want_flat, want_ivf, want_fused, want_micro):
         import gc
 
+        from npairloss_tpu.ops.pallas_ivf import PROBE_IMPLS
         from npairloss_tpu.serve import (
             EngineConfig,
             GalleryIndex,
@@ -900,6 +902,23 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
         # exists to disprove.  The recall-parity gates for bf16/int8
         # live in tests/test_ivf.py either way.
         scoring = "fp32" if platform == "cpu" else "bf16"
+        # The fused Pallas probe row is the same per-platform story one
+        # level up: off TPU the kernel runs in interpret mode — a
+        # parity/debug harness ~1000x slower than the thing it
+        # emulates — so a CPU outage round stamps the row skipped
+        # rather than paying (and publishing) an emulation tax.  The
+        # recall/1e-6-parity evidence for the kernel lives in
+        # tests/test_pallas_ivf.py + the ci.sh interpret smoke either
+        # way; the TPU-window recipe rides the bench record note.
+        measure_fused = want_fused and platform == "tpu"
+        if want_fused and not measure_fused:
+            extras["ivf_fused_qps_1m"] = {
+                "skipped": "fused probe kernel measures on TPU only "
+                           "(interpret mode is a parity harness, not "
+                           "a serving path)"}
+            _log("extras: skipping ivf_fused_qps_1m (platform "
+                 f"{platform}: interpret emulation is not a "
+                 "measurement)")
         # Clustered synthetic gallery — the geometry a trained
         # metric-learning gallery actually has (4096 classes, tight
         # class clusters), and the structure IVF's probe-recall story
@@ -946,48 +965,124 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
                     engine.compile_stats()["compiles_after_warmup"],
             }
 
-        _log(f"extras: building 1M x {d1} gallery (flat oracle pass)...")
-        idx_f = GalleryIndex.build(pool, plab, normalize=False)
-        eng_f = QueryEngine(idx_f, EngineConfig(
-            top_k=top_k, buckets=(bucket,), gallery_block=131072))
-        warm_f = eng_f.warmup()
-        flat_lats, flat_rows = timed(eng_f)
-        if want_flat:
-            extras["flat_qps_1m"] = base_row(flat_lats, warm_f, eng_f)
-            _log(f"extras: flat_qps_1m: {extras['flat_qps_1m']}")
-        # Free the flat device residency before the IVF build doubles it
-        # (the flat answers — the recall ground truth — are host-side).
-        del eng_f
-        idx_f.emb = idx_f.labels = idx_f.valid = None
-        gc.collect()
-        if not want_ivf:
+        # Which passes this selection actually needs: the flat oracle
+        # feeds every recall number; the scan engine feeds its own row
+        # AND the micro row's baseline clock; the dispatch-count-only
+        # micro row never forces the oracle pass.
+        need_oracle = want_flat or want_ivf or measure_fused
+        need_index = want_ivf or measure_fused or want_micro
+        flat_lats = flat_rows = None
+        if need_oracle:
+            _log(f"extras: building 1M x {d1} gallery "
+                 "(flat oracle pass)...")
+            idx_f = GalleryIndex.build(pool, plab, normalize=False)
+            eng_f = QueryEngine(idx_f, EngineConfig(
+                top_k=top_k, buckets=(bucket,), gallery_block=131072))
+            warm_f = eng_f.warmup()
+            flat_lats, flat_rows = timed(eng_f)
+            if want_flat:
+                extras["flat_qps_1m"] = base_row(flat_lats, warm_f,
+                                                 eng_f)
+                _log(f"extras: flat_qps_1m: {extras['flat_qps_1m']}")
+            # Free the flat device residency before the IVF build
+            # doubles it (the flat answers — the recall ground truth —
+            # are host-side).
+            del eng_f
+            idx_f.emb = idx_f.labels = idx_f.valid = None
+            gc.collect()
+        if not need_index:
             return
         t0 = time.perf_counter()
         idx_i = IVFIndex.build_ivf(
             pool, plab, normalize=False, clusters=kc, iters=8,
             train_size=65536)
         build_s = time.perf_counter() - t0
-        eng_i = QueryEngine(idx_i, EngineConfig(
-            top_k=top_k, buckets=(bucket,), probes=probes,
-            scoring=scoring))
-        warm_i = eng_i.warmup()
-        ivf_lats, ivf_rows = timed(eng_i)
-        row = base_row(ivf_lats, warm_i, eng_i)
-        row.update({
-            "clusters": kc, "probes": probes, "scoring": scoring,
-            "cap": idx_i.layout.cap,
-            "build_s": round(build_s, 1),
-            "recall_at_1": round(topk_recall(ivf_rows, flat_rows, k=1), 4),
-            "recall_at_10": round(
-                topk_recall(ivf_rows, flat_rows, k=10), 4),
-            "speedup_vs_flat_p50": round(
-                flat_lats[len(flat_lats) // 2]
-                / max(row["p50_ms"], 1e-9), 1),
-        })
-        extras["ivf_qps_1m"] = row
-        _log(f"extras: ivf_qps_1m: {row}")
 
-    scale_names = ("flat_qps_1m", "ivf_qps_1m")
+        def ivf_row_extras(row, eng_rows):
+            return {
+                "clusters": kc, "probes": probes, "scoring": scoring,
+                "cap": idx_i.layout.cap,
+                "build_s": round(build_s, 1),
+                "recall_at_1": round(
+                    topk_recall(eng_rows, flat_rows, k=1), 4),
+                "recall_at_10": round(
+                    topk_recall(eng_rows, flat_rows, k=10), 4),
+                "speedup_vs_flat_p50": round(
+                    flat_lats[len(flat_lats) // 2]
+                    / max(row["p50_ms"], 1e-9), 1),
+            }
+
+        eng_i = None
+        if want_ivf or want_micro:
+            eng_i = QueryEngine(idx_i, EngineConfig(
+                top_k=top_k, buckets=(bucket,), probes=probes,
+                scoring=scoring))
+            warm_i = eng_i.warmup()
+        if want_ivf:
+            ivf_lats, ivf_rows = timed(eng_i)
+            row = base_row(ivf_lats, warm_i, eng_i)
+            row.update(ivf_row_extras(row, ivf_rows))
+            extras["ivf_qps_1m"] = row
+            _log(f"extras: ivf_qps_1m: {row}")
+        eng_fu = None
+        if measure_fused or (want_micro and platform == "tpu"):
+            # SAME index object, probe_impl the only delta — the row
+            # isolates the kernel, not a rebuild.
+            eng_fu = QueryEngine(idx_i, EngineConfig(
+                top_k=top_k, buckets=(bucket,), probes=probes,
+                scoring=scoring, probe_impl="fused"))
+            warm_fu = eng_fu.warmup()
+        if measure_fused:
+            fu_lats, fu_rows = timed(eng_fu)
+            rowf = base_row(fu_lats, warm_fu, eng_fu)
+            rowf.update(ivf_row_extras(rowf, fu_rows))
+            rowf.update({
+                "probe_impl": eng_fu.probe_impl,
+                "dispatch_count":
+                    PROBE_IMPLS["fused"]["dispatch_count"],
+            })
+            extras["ivf_fused_qps_1m"] = rowf
+            _log(f"extras: ivf_fused_qps_1m: {rowf}")
+        if want_micro:
+            # Kernel-level micro: ONE steady-state probe dispatch per
+            # impl (no host gather, no batcher), plus the registry's
+            # declared pipeline dispatch counts — the 4 -> 2 claim,
+            # stamped where bench_check can gate it jax-free.
+            qm = jnp.asarray(qs[:bucket])
+
+            def one_dispatch_ms(eng):
+                args, _ = eng._topk_call(bucket)
+                reps = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(eng._topk_fn(qm, *args))
+                    reps.append(
+                        max(time.perf_counter() - t0 - floor, 1e-9))
+                reps.sort()
+                return round(reps[len(reps) // 2] * 1e3, 2)
+
+            mrow = {
+                "gallery": n1, "dim": d1, "clusters": kc,
+                "probes": probes, "bucket": bucket,
+                "scoring": scoring, "platform": platform,
+                "cap": idx_i.layout.cap,
+                "scan_dispatches":
+                    PROBE_IMPLS["scan"]["dispatch_count"],
+                "fused_dispatches":
+                    PROBE_IMPLS["fused"]["dispatch_count"],
+                "scan_ms": one_dispatch_ms(eng_i),
+            }
+            if eng_fu is not None:
+                mrow["fused_ms"] = one_dispatch_ms(eng_fu)
+            else:
+                mrow["fused_ms_note"] = (
+                    "needs a TPU window — interpret emulation "
+                    "excluded (see the record note's recipe)")
+            extras["ivf_probe_kernel_micro"] = mrow
+            _log(f"extras: ivf_probe_kernel_micro: {mrow}")
+
+    scale_names = ("flat_qps_1m", "ivf_qps_1m", "ivf_fused_qps_1m",
+                   "ivf_probe_kernel_micro")
     wants = {}
     for name in scale_names:
         if selected is not None and name not in selected:
@@ -1009,17 +1104,20 @@ def _engine_extras(jax, jnp, np, floor, deadline=None, extras=None,
     # the IVF row down — re-running the wedging code to feed the other
     # row defeats the quarantine.  A merely-deselected flat row still
     # permits the (unmeasured) oracle pass.
-    if wants["ivf_qps_1m"] and _quarantined("flat_qps_1m"):
-        reason = _quarantined("flat_qps_1m")
-        _log("extras: skipping ivf_qps_1m (flat oracle quarantined: "
-             f"{reason})")
-        extras["ivf_qps_1m"] = {
-            "skipped": f"flat oracle quarantined: {reason}"}
-        wants["ivf_qps_1m"] = False
-    if wants["flat_qps_1m"] or wants["ivf_qps_1m"]:
+    for name in ("ivf_qps_1m", "ivf_fused_qps_1m"):
+        if wants[name] and _quarantined("flat_qps_1m"):
+            reason = _quarantined("flat_qps_1m")
+            _log(f"extras: skipping {name} (flat oracle quarantined: "
+                 f"{reason})")
+            extras[name] = {
+                "skipped": f"flat oracle quarantined: {reason}"}
+            wants[name] = False
+    if any(wants[name] for name in scale_names):
         flush("serve_scale_1m")
         try:
-            _serve_scale_rows(wants["flat_qps_1m"], wants["ivf_qps_1m"])
+            _serve_scale_rows(wants["flat_qps_1m"], wants["ivf_qps_1m"],
+                              wants["ivf_fused_qps_1m"],
+                              wants["ivf_probe_kernel_micro"])
         except Exception as e:  # scale rows must not void the rest
             _log(f"extras: serve scale rows FAILED: {e}")
             for name in scale_names:

@@ -51,6 +51,8 @@ CLI_PY = "npairloss_tpu/cli.py"
 CHOICE_PINS: List[Tuple[Tuple[str, str], Tuple[str, str]]] = [
     (("npairloss_tpu/cli.py", "_PRECISION_CHOICES"),
      ("npairloss_tpu/models/precision.py", "_POLICIES")),
+    (("npairloss_tpu/cli.py", "_PROBE_IMPL_CHOICES"),
+     ("npairloss_tpu/ops/pallas_ivf.py", "PROBE_IMPLS")),
 ]
 
 # Entry-point spellings in documented command lines -> which argparse

@@ -36,6 +36,11 @@ _PRECISION_CHOICES = ("bf16", "fp32_parity", "mxu")
 _STATICCHECK_PASSES = ("purity", "scopes", "locks", "contracts",
                        "vocab", "markers")
 
+# The --probe-impl vocabulary, hardcoded for the same jax-free-parser
+# reason.  Pinned == ops.pallas_ivf.PROBE_IMPLS by the staticcheck
+# vocab pass AND tests/test_pallas_ivf.py, so drift is a lint failure.
+_PROBE_IMPL_CHOICES = ("scan", "fused", "auto")
+
 
 def _identity_batch_geometry(d):
     """(identities, images-per-identity) per batch from a MultibatchData
@@ -1391,6 +1396,7 @@ def cmd_serve(args) -> int:
                 "index_kind": args.index_kind,
                 "probes": args.probes,
                 "scoring": args.scoring,
+                "probe_impl": args.probe_impl,
                 "replicas": args.replicas,
                 "admission": args.admission,
                 "top_k": args.top_k,
@@ -1421,6 +1427,7 @@ def cmd_serve(args) -> int:
             top_k=args.top_k, buckets=buckets,
             gallery_block=args.gallery_block,
             probes=args.probes, scoring=args.scoring,
+            probe_impl=args.probe_impl,
         )
         engine = QueryEngine(
             index, engine_cfg,
@@ -2934,6 +2941,16 @@ def main(argv: Optional[list] = None) -> int:
         "(half the scan bandwidth/MXU cost), int8 (IVF only: "
         "per-cluster-scale quantized slab) — gate reduced modes with "
         "the recall-parity harness (docs/SERVING.md)",
+    )
+    sv.add_argument(
+        "--probe-impl", dest="probe_impl",
+        choices=list(_PROBE_IMPL_CHOICES), default="scan",
+        help="IVF probe-path implementation: 'scan' (the lax.scan "
+        "gather+score baseline), 'fused' (single-pass Pallas kernel: "
+        "gather + score + running top-k in one VMEM pass, in-kernel "
+        "int8 dequant), 'auto' (fused on TPU, scan elsewhere); the "
+        "resolved choice is stamped into the run manifest and /healthz "
+        "(ignored by a flat index)",
     )
     sv.add_argument(
         "--replicas", type=int, default=1,

@@ -46,6 +46,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from npairloss_tpu.obs.qtrace.report import (
     MARKER_NAMES,
+    PROBE_FUSED_SPAN,
     QTRACE_SCHEMA,
     ROOT_SPAN,
     STAGES,
@@ -220,11 +221,18 @@ class QueryTracer:
                                      else {}))
 
     def dispatch_end(self, qts: List[QueryTrace], score_us: float = 0.0,
-                     merge_us: float = 0.0) -> None:
+                     merge_us: float = 0.0,
+                     fused: bool = False) -> None:
         """The batch's answers exist.  ``score``/``topk_merge`` spans
         are placed back-to-back at the tail of the dispatch span from
         the engine's measured durations; ``dispatch`` keeps the
-        remainder (parse, encode, failpoint stalls) as self time."""
+        remainder (parse, encode, failpoint stalls) as self time.
+
+        ``fused`` marks a fused-Pallas IVF probe dispatch: the
+        score/merge clocks then came out of ONE kernel, so a wrapping
+        ``probe_fused`` span is emitted around them — the stage
+        VOCABULARY (and every per-query ``stage_us`` row) is unchanged,
+        so ``npairloss-qtrace-v1`` artifacts stay valid either way."""
         now = self._now_us()
         score_us = max(float(score_us), 0.0)
         merge_us = max(float(merge_us), 0.0)
@@ -236,6 +244,9 @@ class QueryTracer:
             s_us, m_us = score_us * scale, merge_us * scale
             self._span_event(qt, f"qtrace/{STAGES[3]}", qt.t_dispatch,
                              now)
+            if fused and s_us + m_us > 0:
+                self._span_event(qt, PROBE_FUSED_SPAN,
+                                 now - m_us - s_us, now)
             if s_us > 0:
                 self._span_event(qt, f"qtrace/{STAGES[4]}",
                                  now - m_us - s_us, now - m_us)

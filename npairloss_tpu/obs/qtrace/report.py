@@ -43,6 +43,13 @@ MARKER_NAMES: Tuple[str, ...] = ("hotswap_flip", "crash_reroute")
 ROOT_SPAN = "qtrace/query"
 STAGE_SPANS: Tuple[str, ...] = tuple(f"qtrace/{s}" for s in STAGES)
 
+# The fused IVF probe kernel collapses score + topk_merge into ONE
+# device dispatch; its trace wraps those two stage spans in this extra
+# (non-stage) span.  It is allowed vocabulary inside an exemplar tree
+# but NOT a stage: ``stages``/``stage_us`` keep the v1 six-stage
+# contract, so fused and scan artifacts validate identically.
+PROBE_FUSED_SPAN = "qtrace/probe_fused"
+
 REPORT_KEYS: Tuple[str, ...] = (
     "schema", "wall_time_origin", "slo_ms", "ring_tolerance", "stages",
     "totals", "budget", "markers", "exemplars",
@@ -114,7 +121,7 @@ def _check_exemplar(ex: Any, i: int) -> Optional[str]:
         name = ev["name"]
         if name == ROOT_SPAN:
             roots.append(ev)
-        elif name not in STAGE_SPANS:
+        elif name not in STAGE_SPANS and name != PROBE_FUSED_SPAN:
             return (f"{where}.events[{j}]: span name {name!r} outside "
                     f"the qtrace vocabulary")
         args = ev.get("args")
@@ -145,7 +152,8 @@ def _check_exemplar(ex: Any, i: int) -> Optional[str]:
         d0 = dispatch["ts"] - NEST_SLACK_US
         d1 = dispatch["ts"] + dispatch["dur"] + NEST_SLACK_US
         for ev in events:
-            if ev.get("name") in ("qtrace/score", "qtrace/topk_merge"):
+            if ev.get("name") in ("qtrace/score", "qtrace/topk_merge",
+                                  PROBE_FUSED_SPAN):
                 if ev["ts"] < d0 or ev["ts"] + ev["dur"] > d1:
                     return (f"{where}: {ev['name']!r} escapes its parent "
                             "dispatch span — broken nesting")

@@ -76,8 +76,30 @@ def lowered_text(lowered) -> str:
         )
 
 
+def _resolve_rep_check_off():
+    # shard_map's replication checker has no rule for pallas_call, so a
+    # shard-local Pallas kernel (ops/pallas_ivf.py) must switch it off.
+    # The kwarg moved with the type system: ``check_rep`` up to the
+    # 0.4.x/0.5.x era, ``check_vma`` after the varying-manual-axes
+    # rework.  Resolve the spelling once from the signature.
+    import inspect
+
+    try:
+        params = inspect.signature(_resolve_shard_map()).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        return {"check_rep": False}
+    for name in ("check_rep", "check_vma"):
+        if name in params:
+            return {name: False}
+    return {}  # pragma: no cover - checker removed entirely
+
+
 shard_map = _resolve_shard_map()
 axis_size = _resolve_axis_size()
 pvary = _resolve_pvary()
+# Splat into a shard_map call to disable its replication check (needed
+# around pallas_call bodies): ``shard_map(f, ..., **REP_CHECK_OFF)``.
+REP_CHECK_OFF = _resolve_rep_check_off()
 
-__all__ = ["axis_size", "lowered_text", "pvary", "shard_map"]
+__all__ = ["REP_CHECK_OFF", "axis_size", "lowered_text", "pvary",
+           "shard_map"]

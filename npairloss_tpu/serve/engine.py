@@ -50,7 +50,12 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from npairloss_tpu.ops.normalize import l2_normalize
-from npairloss_tpu.parallel._compat import shard_map
+from npairloss_tpu.ops.pallas_ivf import (
+    PROBE_IMPLS,
+    fused_probe_topk,
+    resolve_probe_impl,
+)
+from npairloss_tpu.parallel._compat import REP_CHECK_OFF, shard_map
 from npairloss_tpu.resilience import failpoints
 from npairloss_tpu.serve.index import GalleryIndex, l2_normalize_rows
 from npairloss_tpu.serve.ivf import SCORINGS, IVFIndex
@@ -82,13 +87,22 @@ class EngineConfig:
     ``int8`` additionally quantizes the stored slab with a per-cluster
     scale (IVF only — flat storage has no cluster to scale by).  Both
     reduced modes are gated by the recall-parity harness
-    (docs/SERVING.md §Approximate index)."""
+    (docs/SERVING.md §Approximate index).
+
+    ``probe_impl`` picks the IVF probe-path implementation from the
+    :data:`npairloss_tpu.ops.pallas_ivf.PROBE_IMPLS` registry:
+    ``scan`` is the lax.scan gather+score baseline, ``fused`` the
+    single-pass Pallas kernel, ``auto`` the per-platform pick (fused
+    on TPU, scan elsewhere) — resolved once at engine build and
+    stamped into /healthz and bench records.  Ignored by a flat
+    index."""
 
     top_k: int = 10
     buckets: Tuple[int, ...] = (1, 8, 32)
     gallery_block: int = 4096
     probes: int = 8
     scoring: str = "fp32"
+    probe_impl: str = "scan"
 
     def __post_init__(self):
         if not self.buckets or list(self.buckets) != sorted(
@@ -103,6 +117,11 @@ class EngineConfig:
         if self.scoring not in SCORINGS:
             raise ValueError(
                 f"scoring must be one of {SCORINGS}, got {self.scoring!r}"
+            )
+        if self.probe_impl not in PROBE_IMPLS:
+            raise ValueError(
+                f"probe_impl must be one of {sorted(PROBE_IMPLS)}, "
+                f"got {self.probe_impl!r}"
             )
 
 
@@ -313,6 +332,13 @@ class QueryEngine:
         self.compiles_after_warmup = 0
         self._guard = os.environ.get(COMPILE_GUARD_ENV, "").strip().lower()
         self._ivf = isinstance(index, IVFIndex)
+        # Resolved once here ("auto" -> the platform pick) so every
+        # consumer — the jitted program choice, /healthz, bench rows,
+        # the qtrace fused flag — reports the impl that actually runs.
+        # None for flat engines: the probe path does not exist there,
+        # and /healthz keeps its pre-IVF shape (absent-when-off).
+        self.probe_impl = (
+            resolve_probe_impl(cfg.probe_impl) if self._ivf else None)
         if cfg.scoring == "int8" and not self._ivf:
             raise ValueError(
                 "scoring='int8' needs an IVF index (the per-cluster "
@@ -405,9 +431,15 @@ class QueryEngine:
         scoring = self.cfg.scoring
         index = self.index
         with_scale = scoring == "int8"
+        # Both impls share the exact operand/return protocol, so the
+        # registry choice is one function pointer — everything
+        # downstream (finalize, shard merge, compile accounting) is
+        # impl-agnostic.
+        probe_fn = (fused_probe_topk if self.probe_impl == "fused"
+                    else _ivf_probe_topk)
 
         def single(q, packed, rows, cents, cvalid, scale=None):
-            s, r = _ivf_probe_topk(
+            s, r = probe_fn(
                 q, packed, rows, cents, cvalid, scale,
                 k=k, probes=probes, scoring=scoring, g0=0)
             return _finalize_topk(s, r, k)
@@ -419,7 +451,7 @@ class QueryEngine:
             def per_shard(q, packed, rows, cents, cvalid, scale=None):
                 kc_local = packed.shape[0]
                 g0 = jax.lax.axis_index(axis) * kc_local
-                s, r = _ivf_probe_topk(
+                s, r = probe_fn(
                     q, packed, rows, cents, cvalid, scale,
                     k=k, probes=probes, scoring=scoring, g0=g0)
                 return s[None], r[None]
@@ -431,6 +463,9 @@ class QueryEngine:
                 per_shard, mesh=mesh,
                 in_specs=tuple(specs),
                 out_specs=(P(axis), P(axis)),
+                # The replication checker has no pallas_call rule; the
+                # fused kernel's outputs are all P(axis)-varying anyway.
+                **(REP_CHECK_OFF if self.probe_impl == "fused" else {}),
             )
 
             def topk(q, packed, rows, cents, cvalid, scale=None):
@@ -604,7 +639,7 @@ class QueryEngine:
             if scale is not None:
                 args += (scale,)
             sig = ("ivf", bucket, tuple(layout.packed.shape),
-                   self.cfg.scoring)
+                   self.cfg.scoring, self.probe_impl)
             return args, sig
         return ((idx.emb, idx.labels, idx.valid),
                 ("topk", bucket, idx.padded_size, idx.dim))

@@ -225,7 +225,16 @@ class IVFIndex(GalleryIndex):
         g = self.mesh.size if self.mesh is not None else 1
         kc_pad = kc + (-kc) % g
         sizes = np.bincount(assign, minlength=kc)
+        # Cap rounds up to the fused probe kernel's sublane alignment
+        # (lcm of the fp32/bf16/int8 min tiles), so the Pallas path's
+        # per-dispatch tile re-pad is a width-zero no-op — the 1M-row
+        # slab is never copied on the hot path.  The extra rows carry
+        # the same -1 sentinel as ragged tails and mask identically in
+        # both probe impls.
+        from npairloss_tpu.ops.pallas_ivf import CAP_ALIGN
+
         cap = max(int(sizes.max()), 1)
+        cap += (-cap) % CAP_ALIGN
         order = np.argsort(assign, kind="stable")
         offsets = np.zeros(kc + 1, np.int64)
         offsets[1:] = np.cumsum(sizes)

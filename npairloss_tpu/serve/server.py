@@ -674,7 +674,11 @@ class RetrievalServer:
                 qts,
                 score_us=(stages or {}).get("score_us", 0.0),
                 merge_us=((stages or {}).get("merge_us", 0.0)
-                          + t_merge * 1e6))
+                          + t_merge * 1e6),
+                # Fused probe path: the score/merge clocks came out of
+                # ONE Pallas dispatch, so the trace wraps them in a
+                # probe_fused span (the stage vocabulary is unchanged).
+                fused=getattr(engine, "probe_impl", None) == "fused")
         return answers
 
     # -- durable ingest (docs/RESILIENCE.md §Durability) --------------------
@@ -1031,6 +1035,14 @@ class RetrievalServer:
             "ok": True,
             "draining": self._preempted(),
             **self.summary(),
+            # The RESOLVED IVF probe impl (scan/fused — never "auto")
+            # behind this tier's answers; absent on a flat tier, where
+            # the probe path does not exist (absent-when-off, the
+            # freshness-JSON contract).  Survives hot-swap because
+            # swap_engines rebuilds from the old EngineConfig.
+            **({"probe_impl": pi}
+               if (pi := getattr(self.engine, "probe_impl", None))
+               is not None else {}),
         }
         if self.admission is not None:
             out["admission"] = self.admission.stats()

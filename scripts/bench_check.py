@@ -705,6 +705,66 @@ def _ivf_hard_gates(new_rows: Dict[str, Dict]) -> List[str]:
     return out
 
 
+def _fused_probe_gates(new: Dict[str, Any]) -> List[str]:
+    """Shape + hard gates on the fused-Pallas probe rows (ISSUE 19),
+    jax-free off the record dict alone: a measured ``ivf_fused_qps_1m``
+    must carry its RESOLVED impl, a declared pipeline dispatch count
+    <= 2 (the 4 -> 2 claim the row exists to stamp), and the same
+    recall@1 floor as the scan row; ``ivf_probe_kernel_micro`` must
+    declare both impls' dispatch counts and a measured scan clock.
+    Skipped/error/absent rows = coverage unchanged, nothing to gate."""
+    out: List[str] = []
+    extras = new.get("extras")
+    extras = extras if isinstance(extras, dict) else {}
+
+    def measured(name):
+        row = extras.get(name)
+        if isinstance(row, dict) and "error" not in row \
+                and not row.get("skipped"):
+            return row
+        return None
+
+    fused = measured("ivf_fused_qps_1m")
+    if fused is not None:
+        if fused.get("probe_impl") != "fused":
+            out.append(
+                f"ivf_fused_qps_1m: probe_impl {fused.get('probe_impl')!r}"
+                " != 'fused' (the row must stamp the RESOLVED impl it "
+                "measured)")
+        dc = fused.get("dispatch_count")
+        if not isinstance(dc, int) or isinstance(dc, bool) or dc > 2:
+            out.append(
+                f"ivf_fused_qps_1m: dispatch_count {dc!r} is not an "
+                "int <= 2 (the fused probe path's whole claim)")
+        r1 = fused.get("recall_at_1")
+        if isinstance(r1, (int, float)) and r1 < IVF_RECALL_FLOOR:
+            out.append(
+                f"ivf_fused_qps_1m: recall@1 {r1:.4f} < hard floor "
+                f"{IVF_RECALL_FLOOR} (the kernel drifted from the "
+                "brute-force oracle)")
+        elif isinstance(r1, (int, float)):
+            _log(f"fused recall@1 {r1:.4f} >= floor {IVF_RECALL_FLOOR}")
+    micro = measured("ivf_probe_kernel_micro")
+    if micro is not None:
+        fd = micro.get("fused_dispatches")
+        if not isinstance(fd, int) or isinstance(fd, bool) or fd > 2:
+            out.append(
+                f"ivf_probe_kernel_micro: fused_dispatches {fd!r} is "
+                "not an int <= 2")
+        sd = micro.get("scan_dispatches")
+        if not isinstance(sd, int) or isinstance(sd, bool) \
+                or (isinstance(fd, int) and fd >= sd):
+            out.append(
+                f"ivf_probe_kernel_micro: scan_dispatches {sd!r} must "
+                "be an int above fused_dispatches — the row records the "
+                "dispatch-count DROP")
+        if not isinstance(micro.get("scan_ms"), (int, float)):
+            out.append(
+                "ivf_probe_kernel_micro: scan_ms missing/non-numeric "
+                "(the baseline clock the fused claim compares against)")
+    return out
+
+
 def _spread(rec: Dict[str, Any]) -> float:
     """Relative window spread = the record's own measured noise floor
     (two-window-min semantics: the min is published, the spread is the
@@ -811,6 +871,7 @@ def check(
                     f"(ref {ref_row['p99_ms']:.2f} from {ref_src}, "
                     f"tol {eff:.1%})")
     violations.extend(_ivf_hard_gates(new_rows))
+    violations.extend(_fused_probe_gates(new))
     return violations
 
 

@@ -424,6 +424,44 @@ np.testing.assert_allclose(
 print("pallas stem interpret smoke OK (lrn fwd+bwd, bias_relu, pool)")
 EOF
 
+echo "== pallas probe kernel interpret smoke (ops/pallas_ivf.py) =="
+# The fused IVF probe kernel (gather + score + running top-k in one
+# VMEM pass) must hold interpret-mode parity against the lax.scan
+# baseline AND the brute-force recall gate on every box that runs CI
+# (the full scoring x geometry matrix lives in tests/test_pallas_ivf.py).
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+from npairloss_tpu.serve import EngineConfig, GalleryIndex, QueryEngine
+from npairloss_tpu.serve.ivf import IVFIndex, topk_recall
+rng = np.random.default_rng(0)
+cents = rng.standard_normal((8, 24)).astype(np.float32)
+cents /= np.linalg.norm(cents, axis=1, keepdims=True)
+emb = np.repeat(cents, 25, axis=0) + 0.1 * rng.standard_normal(
+    (200, 24)).astype(np.float32)
+emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+lab = np.repeat(np.arange(8), 25).astype(np.int32)
+q = emb[rng.choice(200, 8, replace=False)]
+ivf = IVFIndex.build_ivf(emb, lab, normalize=False, clusters=6,
+                         train_size=None)
+out = {}
+for impl in ("scan", "fused"):
+    eng = QueryEngine(ivf, EngineConfig(top_k=10, buckets=(8,), probes=3,
+                                        probe_impl=impl))
+    out[impl] = eng.query(q, normalize=False)
+np.testing.assert_allclose(out["fused"]["scores"], out["scan"]["scores"],
+                           rtol=1e-6, atol=1e-6)
+oracle = QueryEngine(GalleryIndex.build(emb, lab, normalize=False),
+                     EngineConfig(top_k=10, buckets=(8,)))
+exact = oracle.query(q, normalize=False)["rows"]
+for k in (1, 10):
+    rf = topk_recall(out["fused"]["rows"], exact, k=k)
+    rs = topk_recall(out["scan"]["rows"], exact, k=k)
+    assert rf == rs, (k, rf, rs)
+assert topk_recall(out["fused"]["rows"], exact, k=1) >= 0.95
+print("pallas probe kernel interpret smoke OK (fused==scan to 1e-6, "
+      "recall@{1,10} identical, recall@1 >= 0.95)")
+EOF
+
 echo "== precision-policy prof guard (models/precision.py) =="
 # The default (mxu) flagship's compute must live in the conv/inception
 # gemms, not the LRN tail: prof the default-policy flagship and assert
