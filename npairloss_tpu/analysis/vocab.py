@@ -53,6 +53,14 @@ CHOICE_PINS: List[Tuple[Tuple[str, str], Tuple[str, str]]] = [
      ("npairloss_tpu/models/precision.py", "_POLICIES")),
     (("npairloss_tpu/cli.py", "_PROBE_IMPL_CHOICES"),
      ("npairloss_tpu/ops/pallas_ivf.py", "PROBE_IMPLS")),
+    # The tenant manifest validator is jax-free (the bench_check
+    # file-path-load contract), so its choice tuples restate the
+    # registries they admit specs into — pinned here so a new probe
+    # impl or index kind cannot land without the manifest accepting it.
+    (("npairloss_tpu/serve/tenants.py", "_PROBE_IMPL_CHOICES"),
+     ("npairloss_tpu/ops/pallas_ivf.py", "PROBE_IMPLS")),
+    (("npairloss_tpu/serve/tenants.py", "_INDEX_KIND_CHOICES"),
+     ("npairloss_tpu/serve/tenants.py", "INDEX_KINDS")),
 ]
 
 # Entry-point spellings in documented command lines -> which argparse

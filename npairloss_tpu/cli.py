@@ -1202,10 +1202,35 @@ def cmd_serve(args) -> int:
             log.error("--remediation-config %s: %s",
                       args.remediation_config, e)
             return 2
-    if getattr(args, "wal_dir", None) and not args.index_prefix:
+    tenant_registry = None
+    if getattr(args, "tenant_config", None):
+        # Parse + validate the tenants manifest NOW (jax-free): a typo'd
+        # tenant table must fail before any index loads or bucket warms.
+        from npairloss_tpu.serve.tenants import TenantRegistry
+
+        try:
+            tenant_registry = TenantRegistry.load(args.tenant_config)
+        except (OSError, ValueError) as e:
+            log.error("--tenant-config %s: %s", args.tenant_config, e)
+            return 2
+        if args.snapshot or getattr(args, "watch_snapshots", None):
+            log.error("--tenant-config serves embedding queries only "
+                      "(per-tenant model snapshots are not a thing yet) "
+                      "— drop --snapshot/--watch-snapshots")
+            return 2
+        if getattr(args, "remediate", False):
+            log.error("--tenant-config does not compose with "
+                      "--remediate: per-tenant hot-swap is armed "
+                      "automatically and per-tenant admission replaces "
+                      "load_shed (docs/SERVING.md §Multi-tenant)")
+            return 2
+    if getattr(args, "wal_dir", None) and not args.index_prefix \
+            and tenant_registry is None:
         log.error("--wal-dir needs --index-prefix (ingest checkpoints "
                   "publish under the prefix, and cold restart reloads "
-                  "the newest one — docs/RESILIENCE.md §Durability)")
+                  "the newest one — docs/RESILIENCE.md §Durability); "
+                  "in tenant mode each tenant's index_prefix plays "
+                  "that role")
         return 2
     shadow_rate = float(getattr(args, "shadow_rate", 0.0) or 0.0)
     if not (0.0 <= shadow_rate <= 1.0):
@@ -1246,6 +1271,7 @@ def cmd_serve(args) -> int:
 
         mesh = data_parallel_mesh(jax.devices()[:want])
 
+    index = index_path = None
     if args.index_prefix:
         found = load_newest(args.index_prefix, mesh=mesh)
         if found is None:
@@ -1253,7 +1279,7 @@ def cmd_serve(args) -> int:
             return 2
         index_path, index = found
         log.info("serving index %s", index_path)
-    else:
+    elif args.index:
         index_path = os.path.abspath(args.index)
         index = load_index(args.index, mesh=mesh)
     # Reconcile the committed structure with the requested serving
@@ -1276,7 +1302,28 @@ def cmd_serve(args) -> int:
                 mesh=mesh, normalize=False)
         return idx
 
-    index = _reconcile_index(index)
+    if index is not None:
+        index = _reconcile_index(index)
+
+    # Tenant mode loads one index PER TENANT, each reconciled to its
+    # own declared kind (a mixed flat/IVF tier behind one front end).
+    tenant_indexes = {}
+    if tenant_registry is not None:
+        from npairloss_tpu.serve.tenants import reconcile_index_kind
+
+        for spec_t in tenant_registry:
+            found = load_newest(spec_t.index_prefix, mesh=mesh)
+            if found is None:
+                log.error("tenant %r: no valid index under prefix %r",
+                          spec_t.tenant_id, spec_t.index_prefix)
+                return 2
+            tpath, tidx = found
+            tidx = reconcile_index_kind(
+                tidx, spec_t.index_kind,
+                clusters=args.ivf_clusters, mesh=mesh)
+            tenant_indexes[spec_t.tenant_id] = (tpath, tidx)
+            log.info("tenant %r: serving index %s (%s)",
+                     spec_t.tenant_id, tpath, spec_t.index_kind)
 
     # Durable-ingest arm (docs/RESILIENCE.md §Durability): open the WAL
     # (recovery truncates any torn tail loudly), then replay every
@@ -1286,7 +1333,7 @@ def cmd_serve(args) -> int:
     # checkpoint publication + hot-swap; an in-place add to the live
     # gallery would recompile on the serving path.
     wal = None
-    if getattr(args, "wal_dir", None):
+    if getattr(args, "wal_dir", None) and tenant_registry is None:
         import numpy as np
 
         from npairloss_tpu.resilience.wal import (
@@ -1342,6 +1389,78 @@ def cmd_serve(args) -> int:
                  args.wal_dir, _wal_st["last_seq"], replayed,
                  base_watermark, _wal_st["torn_records"])
 
+    # Per-tenant durable ingest: the same WAL discipline, one log per
+    # tenant under --wal-dir/<tenant_id>, each checkpointing under its
+    # own index_prefix — one tenant's ingest volume never advances (or
+    # corrupts) a neighbor's watermark.
+    tenant_wals = []
+    tenant_ingests = {}
+    if getattr(args, "wal_dir", None) and tenant_registry is not None:
+        import numpy as np
+
+        from npairloss_tpu.resilience.wal import (
+            WalCorruptionError,
+            WriteAheadLog,
+        )
+        from npairloss_tpu.serve.index import INDEX_SUFFIX
+        from npairloss_tpu.serve.server import decode_ingest_payload
+        from npairloss_tpu.serve.tenants import TenantIngest
+
+        for spec_t in tenant_registry:
+            tid = spec_t.tenant_id
+            tpath, tidx = tenant_indexes[tid]
+            t_watermark = int(getattr(tidx, "ingest_watermark", 0))
+            t_state = {"base": tpath, "pending": []}
+
+            def _t_apply(payload, _st=t_state):
+                _st["pending"].append(
+                    (int(payload["seq"]), decode_ingest_payload(payload)))
+
+            def _t_publish(wm, _st=t_state, _spec=spec_t):
+                pending = [p for p in _st["pending"] if p[0] <= wm]
+                if not pending:
+                    return None
+                base = load_index(_st["base"], mesh=mesh)
+                emb = np.concatenate([d[0] for _, d in pending])
+                labels = np.concatenate([d[1] for _, d in pending])
+                ids = np.concatenate([d[2] for _, d in pending])
+                base.add(emb, labels, ids=ids)
+                base.ingest_watermark = wm
+                path = base.save(
+                    f"{_spec.index_prefix}w{wm:012d}{INDEX_SUFFIX}")
+                _st["base"] = path
+                _st["pending"] = [p for p in _st["pending"]
+                                  if p[0] > wm]
+                log.info("tenant %r ingest checkpoint: %s (watermark "
+                         "%d, +%d row(s))", _spec.tenant_id, path, wm,
+                         int(emb.shape[0]))
+                return path
+
+            t_wal_dir = os.path.join(args.wal_dir, tid)
+            try:
+                t_wal = WriteAheadLog(
+                    t_wal_dir,
+                    flush_interval_s=max(args.wal_flush_ms, 0.0) / 1e3)
+                replayed = 0
+                for payload in t_wal.replay(after_seq=t_watermark):
+                    _t_apply(payload)
+                    replayed += 1
+            except WalCorruptionError as e:
+                log.error("--wal-dir %s (tenant %r) refused: %s",
+                          t_wal_dir, tid, e)
+                for w in tenant_wals:
+                    w.close()
+                return 2
+            tenant_wals.append(t_wal)
+            tenant_ingests[tid] = TenantIngest(
+                t_wal, _t_apply, checkpoint_fn=_t_publish,
+                checkpoint_every=args.wal_checkpoint_every,
+                watermark=max(t_watermark, t_wal.last_seq),
+                checkpoint_watermark=t_watermark)
+            log.info("tenant %r durable ingest armed: wal %s, replayed "
+                     "%d record(s) above watermark %d", tid, t_wal_dir,
+                     replayed, t_watermark)
+
     model = state = None
     input_shape = None
     if args.snapshot:
@@ -1381,6 +1500,15 @@ def cmd_serve(args) -> int:
             # of its real capacity.
             specs = default_watchdogs(
                 "serve", max_queue=args.max_queue * args.replicas)
+        if tenant_registry is not None:
+            # Per-tenant SLOs over the labeled metric streams
+            # (serve_p99_ms{tenant=...}) — one evaluator, one alert
+            # engine, tenant-scoped tenant_*@<id> alert names.
+            from npairloss_tpu.serve.tenants import tenant_slo_specs
+
+            specs = list(specs)
+            for spec_t in tenant_registry:
+                specs.extend(tenant_slo_specs(spec_t))
         live = LiveObservatory(specs, out_dir=tel_dir)
     if tel_dir or trace_dir:
         from npairloss_tpu.obs import RunTelemetry
@@ -1410,6 +1538,8 @@ def cmd_serve(args) -> int:
                                              False)),
                 "shadow_rate": shadow_rate,
                 "qtrace": bool(getattr(args, "qtrace", False)),
+                **({"tenants": tenant_registry.ids()}
+                   if tenant_registry is not None else {}),
             })
 
     if args.admission != "off" and live is None:
@@ -1422,35 +1552,110 @@ def cmd_serve(args) -> int:
 
     preempt = PreemptionSignal().install()
     shadow = None
+    tenant_shadows = []
+    tenant_swapper = None
     try:
-        engine_cfg = EngineConfig(
-            top_k=args.top_k, buckets=buckets,
-            gallery_block=args.gallery_block,
-            probes=args.probes, scoring=args.scoring,
-            probe_impl=args.probe_impl,
-        )
-        engine = QueryEngine(
-            index, engine_cfg,
-            model=model, state=state, telemetry=telemetry,
-        )
-        # Replicas share the primary's compiled programs: one warmup
-        # warms the whole tier, and with --compile-cache a restarted
-        # replica deserializes instead of recompiling.
-        engines = [engine] + [
-            QueryEngine(index, engine_cfg, model=model, state=state,
-                        telemetry=telemetry, share_compiled_with=engine)
-            for _ in range(args.replicas - 1)
-        ]
-        if not args.no_warmup:
-            engine.warmup(input_shape)
-            for e in engines[1:]:
-                e.warmed = True
         from npairloss_tpu.serve import Freshness
 
-        freshness = Freshness.collect(
-            index=index, index_path=index_path,
-            snapshot_path=args.snapshot or None,
-        )
+        tenant_entries = {}
+        programs = None
+        if tenant_registry is None:
+            engine_cfg = EngineConfig(
+                top_k=args.top_k, buckets=buckets,
+                gallery_block=args.gallery_block,
+                probes=args.probes, scoring=args.scoring,
+                probe_impl=args.probe_impl,
+            )
+            engine = QueryEngine(
+                index, engine_cfg,
+                model=model, state=state, telemetry=telemetry,
+            )
+            # Replicas share the primary's compiled programs: one
+            # warmup warms the whole tier, and with --compile-cache a
+            # restarted replica deserializes instead of recompiling.
+            engines = [engine] + [
+                QueryEngine(index, engine_cfg, model=model, state=state,
+                            telemetry=telemetry,
+                            share_compiled_with=engine)
+                for _ in range(args.replicas - 1)
+            ]
+            if not args.no_warmup:
+                engine.warmup(input_shape)
+                for e in engines[1:]:
+                    e.warmed = True
+            freshness = Freshness.collect(
+                index=index, index_path=index_path,
+                snapshot_path=args.snapshot or None,
+            )
+        else:
+            # Tenant mode: one engine set PER TENANT through the shared
+            # ProgramCache — bucketed shapes make the jitted programs
+            # tenant-agnostic, so tenants at the same geometry share
+            # one program family and tenant count never multiplies
+            # compiles (the test_tenants.py assertion).
+            from npairloss_tpu.serve.tenants import (
+                ProgramCache,
+                QuotaGate,
+                TenantEntry,
+                TenantTelemetry,
+                tenant_slo_specs,
+            )
+
+            programs = ProgramCache()
+            for spec_t in tenant_registry:
+                tid = spec_t.tenant_id
+                tpath, tidx = tenant_indexes[tid]
+                t_cfg = EngineConfig(
+                    top_k=args.top_k, buckets=buckets,
+                    gallery_block=args.gallery_block,
+                    probes=args.probes, scoring=args.scoring,
+                    probe_impl=spec_t.probe_impl or args.probe_impl,
+                )
+                t_tel = (TenantTelemetry(telemetry, tid)
+                         if telemetry is not None else None)
+                primary = programs.engine_for(tidx, t_cfg,
+                                              telemetry=t_tel)
+                if not args.no_warmup:
+                    primary.warmup(None)
+                t_engines = [primary] + [
+                    QueryEngine(tidx, t_cfg, telemetry=t_tel,
+                                share_compiled_with=primary)
+                    for _ in range(args.replicas - 1)
+                ]
+                for e in t_engines[1:]:
+                    e.warmed = primary.warmed
+                quota = None
+                if spec_t.quota_qps > 0:
+                    quota = QuotaGate(
+                        spec_t.quota_qps,
+                        burst_s=spec_t.quota_burst_s,
+                        registry=(live.registry.view(tenant=tid)
+                                  if live is not None else None))
+                t_adm = None
+                t_slos = tenant_slo_specs(spec_t)
+                if spec_t.admission and live is not None and t_slos:
+                    from npairloss_tpu.serve.admission import (
+                        AdmissionConfig,
+                        AdmissionController,
+                    )
+
+                    t_adm = AdmissionController(
+                        AdmissionConfig(
+                            slo_names=tuple(s.name for s in t_slos),
+                            probe_every=spec_t.probe_every),
+                        registry=live.registry.view(tenant=tid))
+                    live.add_listener(t_adm.on_statuses)
+                tenant_entries[tid] = TenantEntry(
+                    spec_t, t_engines,
+                    freshness=Freshness.collect(index=tidx,
+                                                index_path=tpath),
+                    quota=quota, admission=t_adm,
+                    ingest=tenant_ingests.get(tid))
+            first_entry = next(iter(tenant_entries.values()))
+            engines = first_entry.engines
+            # The server-level freshness stays None: in tenant mode
+            # every freshness fact is per-entry (the healthz contract).
+            freshness = None
         admission = None
         if args.admission == "slo":
             from npairloss_tpu.serve.admission import controller_from_args
@@ -1488,11 +1693,28 @@ def cmd_serve(args) -> int:
                           max_queue=args.max_queue),
             ServerConfig(metrics_window=args.metrics_window,
                          explicit_drops=getattr(args, "explicit_drops",
-                                                False)),
+                                                False),
+                         poll_s=args.poll_s),
             telemetry=telemetry, preempt=preempt,
             freshness=freshness, live=live, admission=admission,
             input_shape=input_shape, qtrace=qtracer,
         )
+        if tenant_registry is not None:
+            from npairloss_tpu.serve.tenants import TenantSwapper
+
+            server.enable_tenants(tenant_entries)
+            # Per-tenant hot-swap watch, always on in tenant mode: the
+            # "nothing newer" sweep costs a listdir per tenant, and a
+            # published checkpoint/commit under any tenant's prefix
+            # swaps THAT tenant in place while its neighbors keep
+            # answering.
+            tenant_swapper = TenantSwapper(
+                server, programs=programs, mesh=mesh,
+                telemetry=telemetry, ivf_clusters=args.ivf_clusters)
+            tenant_swapper.start(period_s=2.0)
+            log.info("multi-tenant serving: %d tenant(s) %s; hot-swap "
+                     "sweep every 2.0s", len(tenant_entries),
+                     sorted(tenant_entries))
         if wal is not None:
             server.attach_wal(
                 wal, _apply_ingest,
@@ -1503,7 +1725,65 @@ def cmd_serve(args) -> int:
             log.info("durable ingest armed: wal %s, flush %.1f ms, "
                      "checkpoint every %d batch(es)", args.wal_dir,
                      args.wal_flush_ms, args.wal_checkpoint_every)
-        if shadow_rate > 0:
+        if shadow_rate > 0 and tenant_registry is not None:
+            # Per-tenant quality observatories: each tenant gets its
+            # own deterministic sampler, oracle, floor and
+            # quality.<tenant>.jsonl — a recall regression in one
+            # gallery can never hide inside a healthy aggregate.  The
+            # TenantTelemetry facade stamps the tenant into every
+            # quality row, so the recall gauges land labeled
+            # (serve_recall_at_K{tenant=...}) where the tenant's
+            # recall SLO reads them.
+            from npairloss_tpu.obs.quality.shadow import (
+                ShadowConfig,
+                ShadowScorer,
+            )
+            from npairloss_tpu.serve.tenants import TenantTelemetry
+
+            shadow_ks = tuple(k for k in (1, 5, 10) if k <= args.top_k)
+            for t_i, tid in enumerate(tenant_entries):
+                entry = tenant_entries[tid]
+                spec_t = entry.spec
+                baseline = None
+                try:
+                    from npairloss_tpu.resilience.snapshot import (
+                        read_manifest,
+                    )
+
+                    raw = read_manifest(
+                        tenant_indexes[tid][0]).get("parity")
+                    baseline = raw if isinstance(raw, dict) else None
+                except Exception:  # noqa: BLE001 — baseline is optional evidence
+                    baseline = None
+                floor = floor_metric = None
+                if spec_t.recall_floor is not None:
+                    if spec_t.recall_k in shadow_ks:
+                        floor = spec_t.recall_floor
+                        floor_metric = (
+                            f"serve_recall_at_{spec_t.recall_k}")
+                    else:
+                        log.warning(
+                            "tenant %r recall floor targets recall@%d "
+                            "but --top-k %d samples only recall@{%s} — "
+                            "that floor can never see a sample", tid,
+                            spec_t.recall_k, args.top_k,
+                            ",".join(str(k) for k in shadow_ks))
+                entry.shadow = ShadowScorer(
+                    (lambda e=entry: e.engines[0].index),
+                    ShadowConfig(rate=shadow_rate, ks=shadow_ks,
+                                 window=args.shadow_window,
+                                 seed=args.shadow_seed + t_i),
+                    telemetry=TenantTelemetry(telemetry, tid),
+                    out_path=os.path.join(tel_dir,
+                                          f"quality.{tid}.jsonl"),
+                    baseline=baseline,
+                    recall_floor=floor, floor_metric=floor_metric,
+                ).start()
+                tenant_shadows.append(entry.shadow)
+            log.info("per-tenant shadow scoring armed: rate %g, "
+                     "window %d, %d scorer(s)", shadow_rate,
+                     args.shadow_window, len(tenant_shadows))
+        elif shadow_rate > 0:
             # Quality observatory (docs/OBSERVABILITY.md §Quality):
             # shadow-score a deterministic sample of live queries
             # against the flat oracle, off the hot path.  The floor the
@@ -1685,6 +1965,26 @@ def cmd_serve(args) -> int:
                                       float(st["durable_seq"]))
                     live.registry.set("serve_wal_torn_records",
                                       float(st["torn_records"]))
+                if server.tenants:
+                    # Per-tenant freshness/ingest gauges, labeled —
+                    # each tenant's staleness and durability watermark
+                    # is its own metric stream.
+                    for tid in sorted(server.tenants):
+                        entry = server.tenants[tid]
+                        view = live.registry.view(tenant=tid)
+                        if entry.ingest is not None:
+                            ist = entry.ingest.stats()
+                            view.set("serve_ingest_watermark",
+                                     float(ist["watermark"]))
+                            wst = ist.get("wal") or {}
+                            if "durable_seq" in wst:
+                                view.set("serve_wal_durable_seq",
+                                         float(wst["durable_seq"]))
+                        f_t = entry.freshness
+                        if f_t is None:
+                            continue
+                        for key, v in f_t.ages().items():
+                            view.set(f"serve_{key}", v)
                 f = server.freshness
                 if f is None:
                     return
@@ -1705,6 +2005,11 @@ def cmd_serve(args) -> int:
         return server.run_jsonl(_sys.stdin, _sys.stdout)
     finally:
         preempt.uninstall()
+        if tenant_swapper is not None:
+            try:
+                tenant_swapper.stop()
+            except Exception as e:  # noqa: BLE001
+                log.error("tenant swapper stop failed: %s", e)
         if wal is not None:
             try:
                 # Drain-time checkpoint already ran inside the server's
@@ -1712,6 +2017,16 @@ def cmd_serve(args) -> int:
                 wal.close()
             except Exception as e:  # noqa: BLE001
                 log.error("wal close failed: %s", e)
+        for t_wal in tenant_wals:
+            try:
+                t_wal.close()
+            except Exception as e:  # noqa: BLE001
+                log.error("tenant wal close failed: %s", e)
+        for t_sh in tenant_shadows:
+            try:
+                t_sh.close()
+            except Exception as e:  # noqa: BLE001
+                log.error("tenant shadow scorer close failed: %s", e)
         if shadow is not None:
             try:
                 # Drain the shadow queue (every accepted sample
@@ -1869,21 +2184,32 @@ def cmd_gameday(args) -> int:
     if args.duration <= 0:
         log.error("--duration must be > 0, got %s", args.duration)
         return 1
-    if args.replicas < 2:
+    scenario = getattr(args, "scenario", "day")
+    if scenario == "day" and args.replicas < 2:
         log.error("--replicas must be >= 2 (the replica-crash entry "
                   "needs a survivor to reroute to), got %s",
                   args.replicas)
+        return 1
+    if args.schedule and scenario != "day":
+        log.error("--schedule is the day scenario's knob; tenant_skew "
+                  "ships its own schedule (the hot-tenant burst)")
         return 1
     if args.schedule and not os.path.exists(args.schedule):
         log.error("--schedule not found: %s", args.schedule)
         return 1
 
-    from npairloss_tpu.gameday.runner import GamedayError, run_gameday
+    from npairloss_tpu.gameday.runner import (GamedayError, run_gameday,
+                                              run_tenant_skew)
 
     try:
-        report = run_gameday(
-            args.out, seed=args.seed, duration_s=args.duration,
-            schedule_path=args.schedule, replicas=args.replicas)
+        if scenario == "tenant_skew":
+            report = run_tenant_skew(
+                args.out, seed=args.seed, duration_s=args.duration,
+                replicas=args.replicas)
+        else:
+            report = run_gameday(
+                args.out, seed=args.seed, duration_s=args.duration,
+                schedule_path=args.schedule, replicas=args.replicas)
     except GamedayError as e:
         log.error("gameday run broke: %s", e)
         return 1
@@ -2907,6 +3233,16 @@ def main(argv: Optional[list] = None) -> int:
         help="scan PREFIX*.gidx newest-first and serve the first valid "
         "one (torn/corrupt indexes skipped with a logged reason)",
     )
+    sv_idx.add_argument(
+        "--tenant-config", dest="tenant_config", metavar="PATH",
+        help="multi-tenant serving (docs/SERVING.md §Multi-tenant): a "
+        "npairloss-tenants-v1 JSON manifest mapping tenant ids to "
+        "index prefixes, per-tenant index kind/probe impl, qps quota, "
+        "recall floor and admission params; every query/ingest record "
+        "must carry a registered 'tenant' id, and freshness, quotas, "
+        "SLOs and shadow scoring split per tenant behind one front "
+        "end and one replica tier (replaces --index/--index-prefix)",
+    )
     sv.add_argument(
         "--snapshot",
         help="training snapshot to restore for raw-'input' queries "
@@ -2989,6 +3325,13 @@ def main(argv: Optional[list] = None) -> int:
         "--metrics-window", dest="metrics_window", type=int, default=100,
         help="queries per emitted latency/QPS/queue-depth metrics row "
         "(0 = none)",
+    )
+    sv.add_argument(
+        "--poll-s", dest="poll_s", type=float, default=0.1,
+        help="front-end wakeup period: how long an answer may sit "
+        "ready before the idle flush emits it, and the drain-signal "
+        "reaction bound while idle — lower it when measured latency "
+        "at low qps matters more than wakeup overhead (default 0.1)",
     )
     sv.add_argument(
         "--gallery-block", dest="gallery_block", type=int, default=4096,
@@ -3379,10 +3722,19 @@ def main(argv: Optional[list] = None) -> int:
                     help="traffic window in seconds (default 75)")
     gd.add_argument("--schedule", metavar="PATH",
                     help="chaos schedule JSON (default: the shipped "
-                    "compressed-day schedule)")
+                    "compressed-day schedule; day scenario only)")
     gd.add_argument("--replicas", type=int, default=2,
                     help="serving replicas (default 2; >= 2 so the "
-                    "replica-crash entry has a survivor)")
+                    "day scenario's replica-crash entry has a "
+                    "survivor)")
+    gd.add_argument("--scenario", choices=("day", "tenant_skew"),
+                    default="day",
+                    help="'day' = the full compressed-day chaos drill; "
+                    "'tenant_skew' = the multi-tenant noisy-neighbor "
+                    "drill (docs/SERVING.md §Multi-tenant): one tier, "
+                    "three tenant galleries, a hot-tenant burst that "
+                    "must quota-shed and page WITHOUT degrading the "
+                    "other tenants (default %(default)s)")
     gd.set_defaults(fn=cmd_gameday)
 
     sc = sub.add_parser(

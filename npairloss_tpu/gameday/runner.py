@@ -291,18 +291,26 @@ def _send(io: Dict[str, Any], line: bytes,
 
 
 def _feed(plan: tg.TrafficPlan, emb: np.ndarray, io: Dict[str, Any],
-          t0: float, state: Dict[str, Any]) -> None:
+          t0: float, state: Dict[str, Any],
+          tenant_embs: Optional[Dict[str, np.ndarray]] = None) -> None:
     """Pace the plan's query events against the monotonic clock and
     write them to the tier's stdin.  Writes may block on pipe
     backpressure while the tier warms or degrades — that only delays
-    later events, it never reorders or drops them."""
-    n = emb.shape[0]
+    later events, it never reorders or drops them.  Multi-tenant plans
+    stamp each record with its tenant and draw the query vector from
+    THAT tenant's gallery (``tenant_embs``); tenantless plans keep the
+    pre-tenant line shape byte for byte."""
     for ev in plan.queries:
         wait = (t0 + ev.t) - time.monotonic()
         if wait > 0:
             time.sleep(wait)
-        line = json.dumps({"id": ev.qid,
-                           "embedding": emb[ev.key % n].tolist()})
+        src = (emb if ev.tenant is None
+               else (tenant_embs or {})[ev.tenant])
+        req = {"id": ev.qid,
+               "embedding": src[ev.key % src.shape[0]].tolist()}
+        if ev.tenant is not None:
+            req["tenant"] = ev.tenant
+        line = json.dumps(req)
         if not _send(io, line.encode("utf-8") + b"\n"):
             state["feed_error"] = f"serve stdin broke at qid {ev.qid}"
             return
@@ -746,4 +754,235 @@ def _reconcile(out: str, entries, plan: tg.TrafficPlan,
              report["verdict"], len(report["faults"]),
              report["zero_drop"]["hot_swaps"],
              drain.get("answered", 0), state.get("fed", 0))
+    return report
+
+
+# -- tenant_skew scenario ----------------------------------------------------
+
+TENANT_IDS = ("acme", "bcorp", "ccorp")
+# The hot tenant's quota: above its steady share of the diurnal peak
+# (no shedding on a quiet day) and far below its burst arrival rate
+# (the burst MUST shed).  burst_s=1 keeps the token bucket shallow so
+# the quota alert's evidence is unambiguous.
+HOT_QUOTA_QPS = 6.0
+
+
+def _tenant_workspace(out: str, cfg: tg.TrafficConfig,
+                      hot_tenant: str) -> Dict[str, np.ndarray]:
+    """Per-tenant galleries — SAME geometry on purpose, so the shared
+    ProgramCache proves tenant count never multiplies compiles — plus
+    the ``npairloss-tenants-v1`` manifest: the hot tenant gets the
+    quota the burst must exhaust, every neighbor gets the p99/recall
+    SLOs whose survival the verdict gates."""
+    for sub in ("idx", "serve_tel"):
+        os.makedirs(os.path.join(out, sub), exist_ok=True)
+    from npairloss_tpu.serve.index import GalleryIndex
+    from npairloss_tpu.serve.tenants import TENANTS_SCHEMA
+
+    embs: Dict[str, np.ndarray] = {}
+    tenants: List[Dict[str, Any]] = []
+    for i, tid in enumerate(TENANT_IDS):
+        rng = np.random.default_rng(cfg.seed + 101 + i)
+        emb = rng.standard_normal((cfg.catalog, 64)).astype(np.float32)
+        emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+        labels = (np.arange(cfg.catalog) % 16).astype(np.int32)
+        index = GalleryIndex.build(emb, labels, normalize=False)
+        index.save(os.path.join(out, "idx", f"{tid}-0000.gidx"))
+        embs[tid] = emb
+        spec: Dict[str, Any] = {
+            "tenant_id": tid,
+            "index_prefix": os.path.join(out, "idx", f"{tid}-"),
+        }
+        if tid == hot_tenant:
+            spec.update(quota_qps=HOT_QUOTA_QPS, quota_burst_s=1.0)
+        else:
+            spec.update(p99_ms=P99_TARGET_MS, recall_floor=RECALL_FLOOR,
+                        recall_k=10)
+        tenants.append(spec)
+    _write_json(os.path.join(out, "tenants.json"),
+                {"schema": TENANTS_SCHEMA, "tenants": tenants})
+    return embs
+
+
+def _tenant_serve_cmd(out: str, replicas: int) -> List[str]:
+    return _python() + [
+        "serve", "--tenant-config", os.path.join(out, "tenants.json"),
+        "--compile-cache", os.path.join(out, "xla_cache"),
+        "--top-k", "10", "--buckets", "1", "--deadline-ms", "2",
+        "--poll-s", "0.02",
+        "--max-queue", "64", "--replicas", str(replicas),
+        "--explicit-drops", "--metrics-window", "4",
+        "--shadow-rate", "1", "--shadow-window", "4",
+        "--telemetry-dir", os.path.join(out, "serve_tel"),
+        "--live-obs", "--slo-tick", "0.2",
+    ]
+
+
+_SERVE_READY_MARKER = "shadow scoring armed"
+
+
+def _wait_serve_ready(log_path: str, proc,
+                      timeout_s: float = 180.0) -> None:
+    """Block until the serve log shows the post-warmup marker (the
+    shadow-scorer arming line is the last thing cmd_serve logs before
+    entering the stdin loop).  Feeding a still-importing server piles
+    the whole early schedule into the pipe; the catch-up replay then
+    pollutes the first latency windows with a flood the plan never
+    scripted."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if proc.poll() is not None:
+            raise GamedayError(
+                f"serve died during startup (rc={proc.returncode}); "
+                f"see {log_path}")
+        try:
+            with open(log_path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                if _SERVE_READY_MARKER in f.read():
+                    return
+        except OSError:
+            pass
+        time.sleep(0.2)
+    raise GamedayError(
+        f"serve not ready after {timeout_s:.0f}s (no "
+        f"{_SERVE_READY_MARKER!r} in {log_path})")
+
+
+def run_tenant_skew(out: str, *, seed: int = 0,
+                    duration_s: float = 75.0,
+                    replicas: int = 2,
+                    hot_tenant: str = "acme") -> Dict[str, Any]:
+    """The noisy-neighbor gameday (docs/SERVING.md §Multi-tenant): ONE
+    serving tier, three tenant galleries, and a traffic plan whose
+    single mid-window burst lands ~8x of its load on ``hot_tenant``.
+    The scripted chaos is the plan itself (schedule kind "traffic") —
+    the verdict must see the hot tenant quota-shed AND paged by its
+    tenant-scoped alert, which must also RESOLVE before drain, while
+    every other tenant kept zero errors/rejects, its whole-run p99
+    under the target, and its shadow recall over the floor.
+
+    Timing is load-bearing: the quota SLO's 30s rolling window means
+    the burst's bad samples age out ~30s after the burst ends, so the
+    window needs the burst mid-run with a >=30s quiet tail for the
+    alert pair to complete (one burst at duration/2 with
+    duration_s >= ~65)."""
+    out = os.path.abspath(out)
+    os.makedirs(out, exist_ok=True)
+    if hot_tenant not in TENANT_IDS:
+        raise GamedayError(
+            f"hot_tenant must be one of {TENANT_IDS}, got {hot_tenant!r}")
+    entries = chaos.tenant_skew_schedule(hot_tenant, duration_s)
+    cfg = tg.TrafficConfig(
+        seed=seed, duration_s=duration_s, base_qps=4.0, peak_qps=8.0,
+        burst_qps=40.0, bursts=1, burst_s=6.0, catalog=256, zipf_s=1.1,
+        tenants=tuple((tid, 1.0) for tid in TENANT_IDS),
+        hot_tenant=hot_tenant, hot_burst_factor=8.0)
+    plan = tg.generate(cfg)
+    with open(os.path.join(out, "traffic.jsonl"), "w",
+              encoding="utf-8") as f:
+        f.write("\n".join(tg.plan_lines(plan)) + "\n")
+    embs = _tenant_workspace(out, cfg, hot_tenant)
+
+    sup = _Supervisor()
+    state: Dict[str, Any] = {"fed": 0}
+    try:
+        serve = sup.launch(
+            "serve", _tenant_serve_cmd(out, replicas),
+            env=_child_env(), stdin=subprocess.PIPE,
+            stdout=sup.open(os.path.join(out, "answers.jsonl")),
+            stderr=sup.open(os.path.join(out, "serve.log")))
+        _wait_serve_ready(os.path.join(out, "serve.log"), serve)
+        t0 = time.monotonic()
+        io: Dict[str, Any] = {"stdin": serve.stdin,
+                              "lock": threading.Lock()}
+        feeder = threading.Thread(
+            target=_feed, args=(plan, embs[hot_tenant], io, t0, state),
+            kwargs={"tenant_embs": embs},
+            name="gameday-feed", daemon=True)
+        feeder.start()
+        while time.monotonic() - t0 < duration_s:
+            if serve.poll() is not None:
+                raise GamedayError(
+                    f"serve died mid-window (rc={serve.returncode}); "
+                    f"see {out}/serve.log")
+            time.sleep(0.25)
+        feeder.join(timeout=30.0)
+        # The quota alert resolves ~30s after the burst's bad samples
+        # start aging out — the drain must not beat the resolution.
+        time.sleep(3.0)
+        serve.send_signal(signal.SIGTERM)
+        time.sleep(0.2)
+        serve.stdin.close()
+        serve_rc = serve.wait(timeout=120)
+        if serve_rc != 75:
+            raise GamedayError(
+                f"serve drain expected exit 75, got {serve_rc}; "
+                f"see {out}/serve.log")
+    finally:
+        sup.cleanup()
+    if state.get("feed_error"):
+        raise GamedayError(state["feed_error"])
+
+    answers = _jsonl(os.path.join(out, "answers.jsonl"))
+    drains = [a for a in answers if a.get("event") == "serve_drain"]
+    if not drains:
+        raise GamedayError("no serve_drain summary in answers.jsonl")
+    drain = drains[-1]
+    serve_tel = os.path.join(out, "serve_tel")
+    serve_alerts = _jsonl(os.path.join(serve_tel, "alerts.jsonl"))
+    # The tier-wide p99 gate judges the AGGREGATE window rows only; a
+    # tenant-stamped row is that tenant's own evidence and already
+    # gated per-tenant (counting it twice would let one tenant's
+    # in-quota latency fail the tier).
+    serve_rows = [r for r in _jsonl(os.path.join(serve_tel,
+                                                 "metrics.jsonl"))
+                  if "p99_ms" in r and "wall_time" in r
+                  and "tenant" not in r]
+    tenant_quality = {
+        tid: [r for r in _jsonl(os.path.join(serve_tel,
+                                             f"quality.{tid}.jsonl"))
+              if r.get("kind") == "window"]
+        for tid in TENANT_IDS}
+
+    report = gv.build_gameday_report(
+        chaos.entry_dicts(entries),
+        traffic={
+            "planned": len(plan.queries),
+            "fed": state.get("fed", 0),
+            "answered": drain.get("answered"),
+            "errors": drain.get("errors"),
+            "rejected": drain.get("rejected"),
+            "sha256": tg.plan_digest(plan),
+        },
+        serve_alerts=serve_alerts, train_alerts=[],
+        serve_remediation=_jsonl(
+            os.path.join(serve_tel, "remediation.jsonl")),
+        train_remediation=[],
+        serve_rows=serve_rows,
+        quality_windows=[],  # recall is judged per tenant below
+        drain=drain,
+        comms={"available": False,
+               "reason": "no trainer in the tenant_skew scenario"},
+        trainer={"segments": 0, "exit_codes": [], "resumed": False},
+        observed_fires={},
+        client_errors=int(drain.get("errors", 0)),
+        window_s=duration_s, seed=seed,
+        p99_target_ms=P99_TARGET_MS, recall_floor=RECALL_FLOOR,
+        # The burst is traffic, not a failpoint — there is no stall to
+        # pad around, so tight pads keep real pre-burst evidence
+        # outside the incident window (recall_worst must be a number,
+        # not None-because-everything-was-excused).
+        pad_before_s=5.0, pad_after_s=5.0,
+        min_hot_swaps=0,
+        tenant_hot=hot_tenant, tenant_quality=tenant_quality,
+    )
+    _write_json(os.path.join(out, "gameday.json"), report)
+    tb = report.get("tenants") or {}
+    log.info("gameday[tenant_skew]: verdict=%s (hot=%s shed+rejected=%s"
+             " alerted=%s)",
+             report["verdict"], hot_tenant,
+             (tb.get("tenants", {}).get(hot_tenant) or {}).get(
+                 "rejected"),
+             (tb.get("tenants", {}).get(hot_tenant) or {}).get(
+                 "alerted"))
     return report

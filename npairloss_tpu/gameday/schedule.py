@@ -21,7 +21,10 @@ import json
 from typing import List, Optional, Sequence, Tuple
 
 TARGETS = ("serve", "train")
-KINDS = ("failpoint", "signal")
+# "traffic" entries script no fault site at all: the chaos IS the
+# traffic plan's shape (e.g. the tenant-skew hot-tenant burst), and the
+# declared alert pair is the only evidence they leave.
+KINDS = ("failpoint", "signal", "traffic")
 # Extra per-entry checks the verdict knows how to verify.
 EXPECT_CHECKS = ("zero_client_errors", "preempt_exit", "resume",
                  "ingest_durable", "ingest_no_duplicates")
@@ -83,6 +86,10 @@ class ChaosEntry:
             raise ValueError(
                 f"{self.name}: signal entries declare evidence via "
                 "expect checks (preempt_exit/resume), not alerts")
+        if self.kind == "traffic" and not self.alert:
+            raise ValueError(
+                f"{self.name}: a traffic entry's only evidence is its "
+                "alert pair — declare the alert it must fire+resolve")
         if self.stage is not None:
             if self.stage not in STAGE_CHECKS:
                 raise ValueError(
@@ -170,6 +177,24 @@ def default_schedule(duration_s: float = 75.0) -> List[ChaosEntry]:
                    at_s=0.55 * duration_s,
                    expect=("ingest_durable", "ingest_no_duplicates")),
     ]
+
+
+def tenant_skew_schedule(hot_tenant: str,
+                         duration_s: float = 45.0) -> List[ChaosEntry]:
+    """The multi-tenant noisy-neighbor scenario (docs/SERVING.md
+    §Multi-tenant): the scripted chaos is the traffic plan itself — the
+    hot tenant's arrival weight is multiplied inside the burst windows
+    (traffic.TrafficConfig hot_burst_factor) until its quota sheds —
+    and the declared evidence is the tenant-scoped quota alert pair.
+    The ``tenant_quota@<id>`` spelling is serve/tenants.py's
+    ``tenant_of_slo`` naming contract, restated here because schedules
+    load on the jax-free gate path without the package."""
+    if not hot_tenant:
+        raise ValueError("tenant_skew_schedule needs the hot tenant id")
+    return [ChaosEntry(
+        name="hot_tenant_burst", target="serve", kind="traffic",
+        at_s=0.4 * duration_s,
+        alert=f"tenant_quota@{hot_tenant}")]
 
 
 def entry_dicts(entries: Sequence[ChaosEntry]) -> List[dict]:

@@ -55,6 +55,16 @@ class TrafficConfig:
     zipf_s: float = 1.1
     ingest_every_s: float = 0.0  # 0 = no ingest stream
     ingest_rows: int = 16
+    # Multi-tenant skew (docs/SERVING.md §Multi-tenant): () = the
+    # single-tenant day, unchanged byte for byte.  With a weight table,
+    # every query also draws a tenant id — from a SEPARATE rng stream,
+    # so the arrival times and keys of a tenantless plan at the same
+    # seed are untouched.  Inside burst windows the hot tenant's weight
+    # is multiplied by ``hot_burst_factor``: the noisy-neighbor shape
+    # (one tenant surges, the others keep their baseline rates).
+    tenants: Tuple[Tuple[str, float], ...] = ()
+    hot_tenant: str = ""
+    hot_burst_factor: float = 1.0
 
     def __post_init__(self):
         if self.duration_s <= 0:
@@ -86,16 +96,42 @@ class TrafficConfig:
             raise ValueError(
                 f"bad ingest spec: every={self.ingest_every_s} "
                 f"rows={self.ingest_rows}")
+        if self.hot_tenant and not self.tenants:
+            raise ValueError(
+                f"hot_tenant {self.hot_tenant!r} needs a tenants "
+                "weight table")
+        if self.tenants:
+            names = [t for t, _ in self.tenants]
+            if len(set(names)) != len(names) or not all(names):
+                raise ValueError(
+                    f"tenant ids must be distinct and non-empty, "
+                    f"got {names}")
+            if any(w <= 0 for _, w in self.tenants):
+                raise ValueError(
+                    f"tenant weights must be > 0, got {self.tenants}")
+            if self.hot_tenant and self.hot_tenant not in names:
+                raise ValueError(
+                    f"hot_tenant {self.hot_tenant!r} not in the "
+                    f"weight table {names}")
+        if self.hot_burst_factor < 1.0:
+            raise ValueError(
+                f"hot_burst_factor must be >= 1 (a burst that SHRINKS "
+                f"the hot tenant is not a burst), got "
+                f"{self.hot_burst_factor}")
+        if self.hot_burst_factor > 1.0 and not self.hot_tenant:
+            raise ValueError("hot_burst_factor needs hot_tenant")
 
 
 @dataclasses.dataclass(frozen=True)
 class QueryEvent:
     """One query arrival: ``t`` seconds into the window, a stable qid,
-    and the Zipf-drawn catalog key it asks about."""
+    the Zipf-drawn catalog key it asks about, and (multi-tenant plans
+    only) the tenant the query belongs to."""
 
     t: float
     qid: int
     key: int
+    tenant: Any = None  # Optional[str]; None on single-tenant plans
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,6 +208,25 @@ def generate(cfg: TrafficConfig) -> TrafficPlan:
             break
         queries.append(QueryEvent(t=t, qid=qid, key=zipf.draw(rng)))
         qid += 1
+    if cfg.tenants:
+        # Tenant draws ride their OWN rng stream (seed + 2): adding or
+        # removing the weight table never perturbs the arrival times
+        # and keys above, and a tenantless plan at the same seed stays
+        # byte-identical.
+        trng = random.Random(cfg.seed + 2)
+        names = [t for t, _ in cfg.tenants]
+        base_w = [w for _, w in cfg.tenants]
+        burst_w = [w * (cfg.hot_burst_factor if name == cfg.hot_tenant
+                        else 1.0)
+                   for name, w in cfg.tenants]
+        queries = [
+            dataclasses.replace(
+                q, tenant=trng.choices(
+                    names,
+                    weights=(burst_w
+                             if any(a <= q.t < b for a, b in windows)
+                             else base_w))[0])
+            for q in queries]
     ingest: List[IngestEvent] = []
     if cfg.ingest_every_s > 0:
         commit_id, t = 0, cfg.ingest_every_s
@@ -191,12 +246,23 @@ def plan_lines(plan: TrafficPlan) -> List[str]:
     """Canonical JSON lines for the plan — sorted keys, fixed float
     formatting via json's repr, one event per line.  Two runs of the
     same seed produce the same list, byte for byte."""
+    cfg_d = dataclasses.asdict(plan.cfg)
+    if not cfg_d.get("tenants"):
+        # A tenantless plan serializes (and so digests) exactly as it
+        # did before the tenant fields existed — replayability of the
+        # recorded single-tenant days is part of the contract.
+        for key in ("tenants", "hot_tenant", "hot_burst_factor"):
+            cfg_d.pop(key, None)
+    else:
+        cfg_d["tenants"] = [list(t) for t in cfg_d["tenants"]]
     lines = [json.dumps(
-        {"cfg": dataclasses.asdict(plan.cfg),
+        {"cfg": cfg_d,
          "bursts": [list(w) for w in plan.burst_windows]},
         sort_keys=True)]
-    lines += [json.dumps(dataclasses.asdict(q), sort_keys=True)
-              for q in plan.queries]
+    lines += [json.dumps(
+        {k: v for k, v in dataclasses.asdict(q).items()
+         if not (k == "tenant" and v is None)}, sort_keys=True)
+        for q in plan.queries]
     lines += [json.dumps(dataclasses.asdict(i), sort_keys=True)
               for i in plan.ingest]
     return lines
@@ -223,7 +289,16 @@ def plan_stats(plan: TrafficPlan) -> Dict[str, Any]:
         counts[q.key] = counts.get(q.key, 0) + 1
     top_key, top_n = (max(counts.items(), key=lambda kv: kv[1])
                       if counts else (0, 0))
+    by_tenant: Dict[str, Dict[str, int]] = {}
+    for q in plan.queries:
+        if q.tenant is None:
+            continue
+        row = by_tenant.setdefault(q.tenant, {"queries": 0, "burst": 0})
+        row["queries"] += 1
+        if plan.in_burst(q.t):
+            row["burst"] += 1
     return {
+        **({"tenants": by_tenant} if by_tenant else {}),
         "queries": len(plan.queries),
         "ingest_commits": len(plan.ingest),
         "burst_queries": n_burst,

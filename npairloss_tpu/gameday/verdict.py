@@ -43,12 +43,25 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 GAMEDAY_SCHEMA = "npairloss-gameday-v1"
 
-# Top-level keys every report carries, in order.
+# Top-level keys every report carries, in order.  "tenants" is NOT in
+# this tuple on purpose: reports written before multi-tenant serving
+# existed must keep validating, so the tenant-isolation block is
+# optional-but-judged (gated whenever present and available).
 REPORT_KEYS = (
     "schema", "window_s", "seed", "traffic", "faults", "incidents",
     "slo", "drain", "zero_drop", "comms", "trainer", "qtrace",
     "host_crash", "verdict", "failures",
 )
+# The tenant-isolation evidence block (the tenant_skew scenario):
+# per-tenant counters lifted from the drain summary, per-tenant worst
+# recall outside incident windows, and whether a tenant-scoped alert
+# (slo name ending "@<tenant_id>" — serve/tenants.py's tenant_of_slo
+# naming contract, restated here because this module is loaded by file
+# path without the package) ever fired for each tenant.
+TENANT_GATE_KEYS = ("available", "hot", "p99_target_ms",
+                    "recall_floor", "tenants")
+TENANT_ROW_KEYS = ("queries", "answered", "errors", "rejected", "shed",
+                   "p99_ms", "alerted", "recall_worst")
 # Durable-ingest evidence the SIGKILL drill stores (host_crash block;
 # ``{"available": false}`` on runs that scripted no serve kill).  The
 # ingest_durable / ingest_no_duplicates fault checks are RECOMPUTED
@@ -253,6 +266,10 @@ def _eval_fault(entry: Dict[str, Any], *, alerts, remediation,
     ok = all(checks.values())
     if kind == "failpoint":
         ok = ok and fired
+    if kind in ("failpoint", "traffic"):
+        # A "traffic" entry scripts no fault site (the chaos is the
+        # traffic plan's own shape, e.g. a hot-tenant burst), so its
+        # whole evidence is the declared alert pair + remediation.
         if alert:
             ok = ok and alert_fired and alert_resolved
         if remedy:
@@ -301,6 +318,8 @@ def build_gameday_report(
     min_hot_swaps: int = 3,
     qtrace: Optional[Dict[str, Any]] = None,
     host_crash: Optional[Dict[str, Any]] = None,
+    tenant_hot: Optional[str] = None,
+    tenant_quality: Optional[Dict[str, Sequence[Dict[str, Any]]]] = None,
 ) -> Dict[str, Any]:
     """Assemble (and self-judge) the report.  Inputs are plain dicts/
     lists — the runner loads the artifacts; this function only
@@ -350,6 +369,49 @@ def build_gameday_report(
         "invariant_holds": bool(invariant),
     }
 
+    # Tenant-isolation evidence (the tenant_skew scenario): every
+    # number RE-derived from the drain's per-tenant blocks, the alert
+    # log and the per-tenant quality windows — never trusted from a
+    # caller's claim.
+    tenants_block: Dict[str, Any] = {"available": False}
+    tdrain = drain.get("tenants")
+    if tenant_hot is not None and isinstance(tdrain, dict):
+        per: Dict[str, Any] = {}
+        for tid in sorted(tdrain):
+            row = tdrain[tid] if isinstance(tdrain[tid], dict) else {}
+            quota_sheds = 0
+            quota = row.get("quota")
+            if isinstance(quota, dict):
+                quota_sheds = int(quota.get("sheds", 0))
+            alerted = any(
+                isinstance(rec, dict) and rec.get("state") == "firing"
+                and isinstance(rec.get("slo"), str)
+                and rec["slo"].endswith(f"@{tid}")
+                for rec in serve_alerts)
+            qrows = list((tenant_quality or {}).get(tid) or ())
+            outside = [
+                float(r["recall_at_10"]) for r in qrows
+                if isinstance(r, dict) and "recall_at_10" in r
+                and "wall_time" in r
+                and not _in_windows(float(r["wall_time"]), windows)]
+            per[tid] = {
+                "queries": int(row.get("queries", 0)),
+                "answered": int(row.get("answered", 0)),
+                "errors": int(row.get("errors", 0)),
+                "rejected": int(row.get("rejected", 0)),
+                "shed": quota_sheds + int(row.get("shed", 0)),
+                "p99_ms": float(row.get("p99_ms", 0.0)),
+                "alerted": alerted,
+                "recall_worst": (min(outside) if outside else None),
+            }
+        tenants_block = {
+            "available": True,
+            "hot": tenant_hot,
+            "p99_target_ms": float(p99_target_ms),
+            "recall_floor": float(recall_floor),
+            "tenants": per,
+        }
+
     report = {
         "schema": GAMEDAY_SCHEMA,
         "window_s": float(window_s),
@@ -366,6 +428,7 @@ def build_gameday_report(
                    else {"available": False}),
         "host_crash": (dict(host_crash) if isinstance(host_crash, dict)
                        else {"available": False}),
+        "tenants": tenants_block,
         "verdict": "fail",
         "failures": [],
     }
@@ -456,6 +519,58 @@ def _gate_failures(report: Dict[str, Any]) -> List[str]:
     if comms.get("available") and comms.get("unattributed_bytes", 0) != 0:
         failures.append(
             f"unattributed comms bytes: {comms.get('unattributed_bytes')}")
+    # Tenant isolation (the tenant_skew scenario): the NOISY tenant
+    # must have been shed AND paged with a tenant-scoped alert, while
+    # every OTHER tenant kept zero errors, zero rejects, its p99 under
+    # the target, and (when shadow-scored) its recall over the floor —
+    # a hot neighbor that degrades the quiet tenants fails the gameday
+    # even if every tier-wide gate above held.
+    tb = report.get("tenants") or {}
+    if isinstance(tb, dict) and tb.get("available"):
+        hot = tb.get("hot")
+        target = float(tb.get("p99_target_ms", 0.0) or 0.0)
+        floor = tb.get("recall_floor")
+        per = tb.get("tenants") if isinstance(tb.get("tenants"), dict) \
+            else {}
+        hot_row = per.get(hot)
+        if not isinstance(hot_row, dict):
+            failures.append(
+                f"tenant skew: hot tenant {hot!r} missing from the "
+                "drain's per-tenant evidence")
+        else:
+            if (int(hot_row.get("rejected", 0)) <= 0
+                    and int(hot_row.get("shed", 0)) <= 0):
+                failures.append(
+                    f"tenant skew: noisy tenant {hot!r} was never "
+                    "shed — isolation unproven")
+            if not hot_row.get("alerted"):
+                failures.append(
+                    f"tenant skew: no tenant-scoped alert "
+                    f"(...@{hot}) ever fired for the noisy tenant")
+        for tid in sorted(per):
+            row = per[tid]
+            if tid == hot or not isinstance(row, dict):
+                continue
+            if int(row.get("errors", 0)) != 0:
+                failures.append(
+                    f"tenant isolation: {tid!r} saw "
+                    f"{row.get('errors')} error(s) during the "
+                    "hot-tenant burst")
+            if int(row.get("rejected", 0)) != 0:
+                failures.append(
+                    f"tenant isolation: {tid!r} had "
+                    f"{row.get('rejected')} rejected quer(ies) — the "
+                    "noisy neighbor's shed leaked")
+            if target and float(row.get("p99_ms", 0.0)) > target:
+                failures.append(
+                    f"tenant isolation: {tid!r} p99 "
+                    f"{row.get('p99_ms')}ms > {target}ms")
+            worst = row.get("recall_worst")
+            if (floor is not None and worst is not None
+                    and float(worst) < float(floor)):
+                failures.append(
+                    f"tenant isolation: {tid!r} recall {worst} < "
+                    f"floor {floor}")
     return failures
 
 
@@ -516,6 +631,32 @@ def validate_gameday_report(obj: Any) -> Optional[str]:
         for key in HOST_CRASH_KEYS:
             if key not in hc:
                 return f"host_crash missing key: {key}"
+    # "tenants" is optional (pre-multi-tenant reports lack it) but when
+    # present and available its shape must be complete — the per-tenant
+    # isolation gates below read it blind.
+    tb = obj.get("tenants")
+    if tb is not None:
+        if not isinstance(tb, dict):
+            return "tenants must be an object (the per-tenant "\
+                   "isolation evidence, or {\"available\": false})"
+        if tb.get("available"):
+            for key in TENANT_GATE_KEYS:
+                if key not in tb:
+                    return f"tenants missing key: {key}"
+            per = tb["tenants"]
+            if not isinstance(per, dict) or not per:
+                return "tenants.tenants must be a non-empty object "\
+                       "keyed by tenant id"
+            if tb["hot"] not in per:
+                return (f"tenants.hot {tb['hot']!r} is not one of the "
+                        "evidenced tenants")
+            for tid, row in per.items():
+                if not isinstance(row, dict):
+                    return f"tenants.tenants[{tid!r}] must be an object"
+                for key in TENANT_ROW_KEYS:
+                    if key not in row:
+                        return (f"tenants.tenants[{tid!r}] missing "
+                                f"key: {key}")
 
     # Recompute the gates from the evidence; the stored verdict and
     # failures must agree with them.

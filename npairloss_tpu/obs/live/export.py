@@ -49,30 +49,50 @@ def _fmt(v: float) -> str:
 
 def prometheus_text(registry) -> str:
     """Render every metric in the exposition format, sorted by name so
-    scrapes (and tests) are deterministic."""
+    scrapes (and tests) are deterministic.
+
+    Labeled registry keys (``serve_rows{tenant="a"}`` — see
+    ``registry.labeled_name``) render as real Prometheus labels, with
+    the family's ``# TYPE`` header emitted once across all label sets.
+    A registry with no labeled series renders byte-identically to the
+    pre-label format."""
+    from npairloss_tpu.obs.live.registry import split_labels
+
     lines = []
     snap = registry.snapshot()
-    for name in sorted(snap):
-        m = snap[name]
-        pname = _prom_name(name)
+    entries = sorted(
+        (split_labels(key) + (key,)) for key in snap)
+    typed = set()
+    for base, labels, key in entries:
+        m = snap[key]
+        pname = _prom_name(base)
         kind = m["kind"]
+        lab = "{" + labels + "}" if labels else ""
         if kind == "counter":
-            lines.append(f"# TYPE {pname}_total counter")
-            lines.append(f"{pname}_total {_fmt(m['value'])}")
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname}_total counter")
+            lines.append(f"{pname}_total{lab} {_fmt(m['value'])}")
         elif kind == "gauge":
             if m["value"] is None:
                 continue  # a gauge never set exposes nothing
-            lines.append(f"# TYPE {pname} gauge")
-            lines.append(f"{pname} {_fmt(m['value'])}")
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname}{lab} {_fmt(m['value'])}")
         else:
-            lines.append(f"# TYPE {pname} histogram")
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} histogram")
+            # ``le`` composes with (goes after) the series labels.
+            pre = labels + "," if labels else ""
             cum = m["cumulative_counts"]
             for bound, count in zip(m["bounds"], cum):
                 lines.append(
-                    f'{pname}_bucket{{le="{_fmt(bound)}"}} {count}')
-            lines.append(f'{pname}_bucket{{le="+Inf"}} {cum[-1]}')
-            lines.append(f"{pname}_sum {_fmt(m['sum'])}")
-            lines.append(f"{pname}_count {m['count']}")
+                    f'{pname}_bucket{{{pre}le="{_fmt(bound)}"}} {count}')
+            lines.append(f'{pname}_bucket{{{pre}le="+Inf"}} {cum[-1]}')
+            lines.append(f"{pname}_sum{lab} {_fmt(m['sum'])}")
+            lines.append(f"{pname}_count{lab} {m['count']}")
     return "\n".join(lines) + "\n"
 
 

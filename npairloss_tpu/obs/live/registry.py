@@ -217,10 +217,109 @@ class MetricRegistry:
                 return []
             return [(t, v) for t, v in m.samples if t >= since]
 
+    def view(self, **labels: str) -> "LabeledRegistry":
+        """A label-scoped view of this registry (``view(tenant="a")``)
+        — see :class:`LabeledRegistry`."""
+        return LabeledRegistry(self, labels)
+
 
 def _sanitize(key: str) -> str:
     """Telemetry keys to metric-name atoms ([a-zA-Z0-9_])."""
     return "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in key)
+
+
+# -- label dimension ----------------------------------------------------------
+#
+# A labeled metric lives in the registry under the canonical key
+# ``name{k="v",...}`` (labels sorted by key).  The registry itself stays
+# label-oblivious — every read/write API keys on the full string — which
+# is exactly what lets label-scoped series flow through ``samples_since``
+# and hence SLO specs unchanged: an SLO targeting
+# ``serve_p99_ms{tenant="acme"}`` needs zero evaluator changes.  The
+# exporter (obs/live/export.py) splits the key back apart to render
+# Prometheus label syntax.
+
+_LABEL_KEY_RE_CHARS = "label keys must match [a-zA-Z_][a-zA-Z0-9_]*"
+
+
+def labeled_name(name: str, labels: Dict[str, str]) -> str:
+    """Canonical registry key for ``name`` under a fixed label set.
+    Loud on malformed labels — a typo'd label must fail at wiring, not
+    render broken exposition."""
+    if not labels:
+        return name
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k])
+        if not k or not (k[0].isalpha() or k[0] == "_") or \
+                not all(ch.isalnum() or ch == "_" for ch in k):
+            raise ValueError(f"bad metric label key {k!r}: "
+                             + _LABEL_KEY_RE_CHARS)
+        if any(ch in v for ch in ('"', "\\", "\n")):
+            raise ValueError(
+                f"bad metric label value {v!r} for {k!r}: quotes, "
+                "backslashes and newlines are not representable")
+        parts.append(f'{k}="{v}"')
+    return f"{name}{{{','.join(parts)}}}"
+
+
+def split_labels(key: str) -> Tuple[str, str]:
+    """Inverse of :func:`labeled_name` for the exporter: registry key ->
+    ``(base name, rendered label body)`` — ``("serve_rows", 'tenant="a"')``
+    for a labeled key, ``(key, "")`` for a flat one."""
+    if key.endswith("}") and "{" in key:
+        base, _, rest = key.partition("{")
+        return base, rest[:-1]
+    return key, ""
+
+
+class LabeledRegistry:
+    """A label-scoped view over a :class:`MetricRegistry`: every metric
+    name is rewritten through :func:`labeled_name` with a fixed label
+    set.  This is how per-tenant serving reuses tenant-agnostic
+    components (AdmissionController, ShadowScorer, freshness probes)
+    unchanged — hand them the view and their ``serve_shedding`` becomes
+    ``serve_shedding{tenant="acme"}``."""
+
+    def __init__(self, registry: "MetricRegistry", labels: Dict[str, str]):
+        if not labels:
+            raise ValueError("LabeledRegistry needs >= 1 label")
+        self.base = registry
+        self.labels = dict(labels)
+        labeled_name("_probe", self.labels)  # validate loudly at wiring
+
+    def _n(self, name: str) -> str:
+        return labeled_name(name, self.labels)
+
+    def counter(self, name: str, help: str = ""):
+        return self.base.counter(self._n(name), help=help)
+
+    def gauge(self, name: str, help: str = ""):
+        return self.base.gauge(self._n(name), help=help)
+
+    def histogram(self, name: str,
+                  bounds: Sequence[float] = DEFAULT_BOUNDS,
+                  help: str = ""):
+        return self.base.histogram(self._n(name), bounds=bounds, help=help)
+
+    def inc(self, name: str, amount: float = 1.0) -> None:
+        self.base.inc(self._n(name), amount)
+
+    def set(self, name: str, value: float,
+            t: Optional[float] = None) -> None:
+        self.base.set(self._n(name), value, t)
+
+    def observe(self, name: str, value: float,
+                t: Optional[float] = None,
+                bounds: Sequence[float] = DEFAULT_BOUNDS) -> None:
+        self.base.observe(self._n(name), value, t, bounds=bounds)
+
+    def get(self, name: str):
+        return self.base.get(self._n(name))
+
+    def samples_since(self, name: str, since: float
+                      ) -> List[Tuple[float, float]]:
+        return self.base.samples_since(self._n(name), since)
 
 
 class RegistrySink:
@@ -272,7 +371,14 @@ class RegistrySink:
         t = record.get("wall_time")
         t = float(t) if isinstance(t, _NUMERIC) else None
         p = _sanitize(phase)
-        reg.inc(f"{p}_rows")
+        # Tenant-stamped rows (multi-tenant serving) land on labeled
+        # series — ``serve_p99_ms{tenant="a"}`` — so one tenant's signal
+        # cannot hide in the aggregate.  Rows without the stamp map to
+        # the same flat names as always.
+        tenant = record.get("tenant")
+        lab = {"tenant": tenant} if isinstance(tenant, str) and tenant \
+            else {}
+        reg.inc(labeled_name(f"{p}_rows", lab))
         event = record.get("event")
         if isinstance(event, str):
             # Lifecycle/event rows (resilience retry/rollback/preempt,
@@ -281,23 +387,24 @@ class RegistrySink:
             # collide with the window rows' — ingesting them as gauge
             # samples would re-fire a long-resolved p99 alert at the
             # final tick.  Count them; never gauge them.
-            reg.inc(f"{p}_event_{_sanitize(event)}")
+            reg.inc(labeled_name(f"{p}_event_{_sanitize(event)}", lab))
             return
         step = record.get("step")
         if isinstance(step, _NUMERIC):
-            reg.set(f"{p}_step", float(step), t)
+            reg.set(labeled_name(f"{p}_step", lab), float(step), t)
         for key, value in record.items():
-            if key in self._SKIP or key == "phase" or \
+            if key in self._SKIP or key in ("phase", "tenant") or \
                     not isinstance(value, _NUMERIC) or \
                     isinstance(value, bool):
                 continue
             if not math.isfinite(value):
                 continue
-            reg.set(f"{p}_{_sanitize(key)}", float(value), t)
+            reg.set(labeled_name(f"{p}_{_sanitize(key)}", lab),
+                    float(value), t)
         if phase == "train":
             self._train_extras(record, t)
         elif phase == "serve":
-            self._serve_extras(record, t)
+            self._serve_extras(record, t, lab)
 
     def _train_extras(self, record: Dict[str, Any], t) -> None:
         reg = self.registry
@@ -328,11 +435,13 @@ class RegistrySink:
                 vals = self._rank_steps.values()
                 reg.set("fleet_step_lag", float(max(vals) - min(vals)), t)
 
-    def _serve_extras(self, record: Dict[str, Any], t) -> None:
+    def _serve_extras(self, record: Dict[str, Any], t,
+                      lab: Optional[Dict[str, str]] = None) -> None:
+        name = labeled_name("serve_latency_ms", lab or {})
         for key in ("p50_ms", "p99_ms"):
             v = record.get(key)
             if isinstance(v, _NUMERIC) and math.isfinite(v):
-                self.registry.observe("serve_latency_ms", float(v), t)
+                self.registry.observe(name, float(v), t)
 
     def flush(self) -> None:
         pass

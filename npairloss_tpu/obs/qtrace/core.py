@@ -104,7 +104,7 @@ class QueryTrace:
 
     __slots__ = ("trace_id", "qid", "wall_time", "t_ingest",
                  "t_admitted", "t_picked", "t_dispatch", "stage_us",
-                 "events", "replica", "probe", "done")
+                 "events", "replica", "probe", "tenant", "done")
 
     def __init__(self, trace_id: str, qid: Any, wall_time: float,
                  t_ingest: float):
@@ -119,6 +119,10 @@ class QueryTrace:
         self.events: List[Dict[str, Any]] = []
         self.replica: Optional[str] = None
         self.probe = False
+        # Multi-tenant serving stamps the owning tenant id at ingestion;
+        # it rides into the root span's args so an exemplar tree is
+        # attributable to the tenant whose traffic produced it.
+        self.tenant: Optional[str] = None
         self.done = False
 
 
@@ -319,7 +323,9 @@ class QueryTracer:
         self._span_event(qt, ROOT_SPAN, qt.t_ingest, now,
                          **({"qid": qt.qid} if qt.qid is not None
                             else {}),
-                         **({"probe": True} if qt.probe else {}))
+                         **({"probe": True} if qt.probe else {}),
+                         **({"tenant": qt.tenant} if qt.tenant
+                            else {}))
         if self.registry is not None:
             for stage, ms in stage_ms.items():
                 self.registry.observe(f"qtrace_{stage}_ms", ms)

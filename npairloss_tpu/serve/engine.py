@@ -317,6 +317,7 @@ class QueryEngine:
         state: Optional[Dict[str, Any]] = None,
         telemetry=None,
         share_compiled_with: Optional["QueryEngine"] = None,
+        share_programs_with: Optional["QueryEngine"] = None,
     ):
         if cfg.top_k > index.size:
             raise ValueError(
@@ -345,6 +346,51 @@ class QueryEngine:
                 "scale has no flat-gallery equivalent); use bf16 or "
                 "--index-kind ivf"
             )
+        if share_compiled_with is not None and \
+                share_programs_with is not None:
+            raise ValueError(
+                "share_compiled_with and share_programs_with are "
+                "mutually exclusive"
+            )
+        if share_programs_with is not None:
+            # Cross-index program sharing (multi-tenant serving,
+            # docs/SERVING.md §Multi-tenant): the jitted topk/encode
+            # closures capture ONLY the config (k, block, probes,
+            # scoring, probe impl) and the mesh/axis — index arrays and
+            # model state are traced ARGUMENTS — so engines over
+            # DIFFERENT galleries can reuse one set of callables.  Two
+            # tenants at one (bucket, padded_size, D) geometry then hit
+            # the same executable: tenant count never multiplies
+            # compiles (the shared ``_seen_sigs`` set plus the cache-
+            # size accounting prove it per dispatch).  Everything the
+            # closures DO capture must match, loudly:
+            other = share_programs_with
+            if other.cfg != cfg:
+                raise ValueError(
+                    "share_programs_with requires an identical "
+                    f"EngineConfig (got {cfg} vs {other.cfg})"
+                )
+            if other._ivf != self._ivf:
+                raise ValueError(
+                    "share_programs_with requires the same index kind "
+                    "(flat vs IVF programs differ)"
+                )
+            if other.index.mesh is not index.mesh or \
+                    other.index.axis != index.axis:
+                raise ValueError(
+                    "share_programs_with requires the same mesh object "
+                    "and axis (the sharded program captures them)"
+                )
+            if other.model is not model:
+                raise ValueError(
+                    "share_programs_with requires the same model object "
+                    "(the encode program captures it; state is an "
+                    "argument)"
+                )
+            self._seen_sigs = other._seen_sigs
+            self._topk_fn = other._topk_fn
+            self._encode_fn = other._encode_fn
+            return
         if share_compiled_with is not None:
             # Replica-tier compile sharing (docs/SERVING.md): replicas
             # of ONE index+config reuse the primary's jitted callables

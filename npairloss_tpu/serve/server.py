@@ -55,6 +55,13 @@ from npairloss_tpu.serve.engine import QueryEngine
 log = logging.getLogger("npairloss_tpu.serve")
 
 
+class UnknownTenantError(ValueError):
+    """A record named a tenant the registry does not know.  Raised
+    from ``submit`` BEFORE the query is counted: an unregistered id is
+    a malformed request (the bad-JSON accounting — errors, never
+    queries/rejected), not admitted-then-shed traffic."""
+
+
 def encode_ingest_body(ingest: Dict[str, Any]) -> Dict[str, Any]:
     """A client ingest block -> the ``npairloss-wal-v1`` ``kind: "add"``
     record body (docs/RESILIENCE.md §Durability).  ``ids`` are REQUIRED:
@@ -271,6 +278,15 @@ class RetrievalServer:
         self._ingest_watermark = 0  # guarded-by: _ingest_lock
         self._ckpt_watermark = 0  # guarded-by: _ingest_lock
         self._ingest_since_ckpt = 0  # guarded-by: _ingest_lock
+        # Multi-tenant map (serve/tenants.py): empty until
+        # ``enable_tenants`` installs it, so a single-tenant server
+        # keeps every pre-PR behavior and stream byte-identical.  When
+        # armed, each query/ingest record must carry a registered
+        # "tenant" id; counters, freshness, quota, admission, shadow,
+        # and ingest split per entry while the replica tier, front
+        # ends, and compiled programs stay shared.
+        self.tenants: Dict[str, Any] = {}
+        self._replica_idx: Dict[str, int] = {}
         self.replicaset = ReplicaSet(
             engines, batcher_cfg, self._replica_dispatch,
             span_fn=self._span, on_batch=self._record_batch,
@@ -292,6 +308,11 @@ class RetrievalServer:
         self.queries = 0  # guarded-by: _lock
         self.answered = 0  # guarded-by: _lock
         self.errors = 0  # guarded-by: _lock
+        # Errors refused BEFORE admission (bad JSON, unknown tenant):
+        # counted in ``errors`` but never in ``queries``, so the drop
+        # residual must exclude them or a refusal reads as a negative
+        # drop count.
+        self.errors_refused = 0  # guarded-by: _lock
         self._window_t0 = time.perf_counter()
         self._window_n = 0
         self._last_batch: Dict[str, Any] = {}
@@ -412,7 +433,8 @@ class RetrievalServer:
         if qt is not None and self.qtrace is not None:
             self.qtrace.drop(qt, error=error)
 
-    def _record_latency(self, seconds: float, qt=None) -> None:
+    def _record_latency(self, seconds: float, qt=None,
+                        entry=None) -> None:
         if qt is not None and self.qtrace is not None:
             # Finish the trace BEFORE the window-threshold check so the
             # query that closes a window lands in that window's stage
@@ -429,6 +451,13 @@ class RetrievalServer:
                 # accumulate a divergent unbounded copy of the ring
                 # (pinned by tests/test_qtrace.py).
                 self._window_lat.append(seconds * 1e3)
+            if entry is not None:
+                # The tenant's own rings: same sample, same population
+                # rule — its p99 SLO burns on ITS tail, not the tier's.
+                entry.answered += 1
+                entry.lat.append(seconds * 1e3)
+                if self.cfg.metrics_window:
+                    entry.window_lat.append(seconds * 1e3)
             self.answered += 1
             self._window_n += 1
             if (self.cfg.metrics_window
@@ -446,13 +475,20 @@ class RetrievalServer:
                  qt=None) -> Dict[str, Any]:
         """Per-answer bookkeeping: an ``{"id", "error"}`` answer (a
         malformed record the dispatch answered individually) counts as
-        an error, everything else as an answered query with latency."""
+        an error, everything else as an answered query with latency —
+        attributed to the answer's tenant in tenant mode (the dispatch
+        stamped the id, so no side channel is needed)."""
+        entry = (self.tenants.get(answer.get("tenant"))
+                 if self.tenants and isinstance(answer, dict) else None)
         if "error" in answer:
             with self._lock:
                 self.errors += 1
+                if entry is not None:
+                    entry.errors += 1
             self._qtrace_drop(qt, error=True)
         else:
-            self._record_latency(time.perf_counter() - t0, qt)
+            self._record_latency(time.perf_counter() - t0, qt,
+                                 entry=entry)
         return answer
 
     def _percentiles(
@@ -551,6 +587,44 @@ class RetrievalServer:
             except Exception as e:  # noqa: BLE001 — telemetry is not the run
                 log.error("serve metrics emission failed: %s", e)
         log.info("serve window: %s", row)
+        if self.tenants:
+            self._emit_tenant_windows()
+
+    def _emit_tenant_windows(self) -> None:
+        """One tenant-stamped row per tenant that answered this window.
+        The ``tenant`` key makes the RegistrySink land every metric on
+        labeled series (``serve_p99_ms{tenant="a"}``) — the sample
+        streams the per-tenant SLOs burn on — so a noisy tenant's tail
+        cannot hide inside the aggregate window row, and a quiet
+        tenant emits nothing (no stale gauges)."""
+        snaps: List[tuple] = []
+        with self._lock:
+            for tid in sorted(self.tenants):
+                entry = self.tenants[tid]
+                lat = entry.take_window()
+                if lat:
+                    snaps.append((tid, entry, lat))
+        for tid, entry, lat in snaps:
+            trow = {
+                "tenant": tid,
+                "queries": len(lat),
+                **{k: round(v, 3)
+                   for k, v in self._percentiles(lat).items()},
+            }
+            if entry.quota is not None and entry.quota.sheds:
+                trow["quota_sheds"] = entry.quota.sheds
+            if entry.admission is not None and entry.admission.sheds:
+                trow["shed"] = entry.admission.sheds
+            if entry.rejected:
+                trow["rejected"] = entry.rejected
+            if self.telemetry is not None \
+                    and self.telemetry.metrics_enabled:
+                try:
+                    self.telemetry.log("serve", self.answered, trow)
+                except Exception as e:  # noqa: BLE001 — telemetry is not the run
+                    log.error("tenant %r metrics emission failed: %s",
+                              tid, e)
+            log.info("serve tenant window: %s", trow)
 
     # -- serving core ------------------------------------------------------
 
@@ -558,13 +632,54 @@ class RetrievalServer:
                   engine: Optional[QueryEngine] = None,
                   replica: Optional[str] = None
                   ) -> List[Dict[str, Any]]:
-        """Batcher dispatch: coalesced query records -> per-query
-        answers.  A malformed record (missing field, wrong embedding
-        shape, ragged input) answers ``{"id", "error"}`` WITHOUT failing
-        its co-riders — one hostile client must not degrade unrelated
-        traffic sharing the micro-batch.  Raw-'input' records encode as
-        ONE stacked dispatch (that is the batcher's whole point), then
-        merge with the embedding records for one top-k dispatch."""
+        """Batcher dispatch.  Single-tenant: straight through to the
+        core.  Tenant mode: a micro-batch may coalesce queries for
+        SEVERAL galleries (the batchers are shared — that is the
+        one-tier contract), so the batch splits by tenant id and each
+        group dispatches on its tenant's engine for THIS replica; the
+        answers reassemble in item order."""
+        if not self.tenants:
+            return self._dispatch_core(items, engine=engine,
+                                       replica=replica)
+        ridx = self._replica_idx.get(replica, 0)
+        groups: Dict[Any, List[int]] = {}
+        for i, rec in enumerate(items):
+            tid = rec.get("tenant") if isinstance(rec, dict) else None
+            groups.setdefault(tid, []).append(i)
+        answers: List[Optional[Dict[str, Any]]] = [None] * len(items)
+        for tid, idxs in groups.items():
+            entry = self.tenants.get(tid)
+            if entry is None:
+                # Defensive: submit() already refuses unknown tenants;
+                # a record that lost its id between admit and dispatch
+                # still answers instead of crashing its co-riders.
+                for i in idxs:
+                    answers[i] = {"id": items[i].get("id"),
+                                  "tenant": tid,
+                                  "error": f"unknown tenant {tid!r}"}
+                continue
+            eng = entry.engines[ridx if ridx < len(entry.engines)
+                                else 0]
+            group = self._dispatch_core([items[i] for i in idxs],
+                                        engine=eng, replica=replica,
+                                        entry=entry)
+            for i, ans in zip(idxs, group):
+                answers[i] = ans
+        return answers
+
+    def _dispatch_core(self, items: List[Dict[str, Any]],
+                       engine: Optional[QueryEngine] = None,
+                       replica: Optional[str] = None,
+                       entry=None) -> List[Dict[str, Any]]:
+        """Coalesced query records -> per-query answers.  A malformed
+        record (missing field, wrong embedding shape, ragged input)
+        answers ``{"id", "error"}`` WITHOUT failing its co-riders — one
+        hostile client must not degrade unrelated traffic sharing the
+        micro-batch.  Raw-'input' records encode as ONE stacked
+        dispatch (that is the batcher's whole point), then merge with
+        the embedding records for one top-k dispatch.  ``entry`` scopes
+        freshness stamps, the shadow offer, and the answers' ``tenant``
+        key to one tenant (None = the single-tenant tier)."""
         from npairloss_tpu.serve.engine import ServeCompileError
 
         if engine is None:
@@ -573,6 +688,11 @@ class RetrievalServer:
                 if isinstance(it, dict)
                 and (qt := it.get("_qt")) is not None]
                if self.qtrace is not None else [])
+        # Answers carry their tenant id in tenant mode — the routing
+        # evidence bench_check's tenant gate audits (and the key
+        # _account uses to attribute errors without a side channel).
+        tstamp = ({"tenant": entry.tenant_id}
+                  if entry is not None else {})
         if qts:
             # ``batch_assemble`` ends here; everything from this point
             # to the answers — parse, encode, failpoint stalls, the
@@ -609,7 +729,8 @@ class RetrievalServer:
                         "query record needs an 'embedding' or 'input' field"
                     )
             except Exception as e:  # noqa: BLE001 — answer THIS record
-                answers[i] = {"id": rec.get("id"), "error": str(e)}
+                answers[i] = {"id": rec.get("id"), **tstamp,
+                              "error": str(e)}
         if enc_rows:
             try:
                 enc = engine.encode(
@@ -622,7 +743,7 @@ class RetrievalServer:
                 raise  # strict-guard trip is a server fault, fail loudly
             except Exception as e:  # noqa: BLE001 — ragged stack, no model
                 for i, _ in enc_rows:
-                    answers[i] = {"id": items[i].get("id"),
+                    answers[i] = {"id": items[i].get("id"), **tstamp,
                                   "error": str(e)}
         t_merge = 0.0
         if emb_rows:
@@ -633,13 +754,16 @@ class RetrievalServer:
             out = (engine.query(batch) if stages is None
                    else engine.query(batch, stages=stages))
             t_asm0 = time.perf_counter()
-            ages = (self.freshness.ages()
-                    if self.freshness is not None else {})
+            fresh = (entry.freshness if entry is not None
+                     else self.freshness)
+            ages = fresh.ages() if fresh is not None else {}
             for j, (i, _) in enumerate(emb_rows):
                 answers[i] = {
                     "id": items[i].get("id"),
+                    **tstamp,
                     # Per-answer freshness stamp (ROADMAP item 4): how
-                    # old the model/index behind THIS answer is.
+                    # old the model/index behind THIS answer is — the
+                    # TENANT'S freshness in tenant mode.
                     **ages,
                     "neighbors": [
                         {
@@ -656,17 +780,21 @@ class RetrievalServer:
             # device top-K with labels/ids/freshness into the wire
             # shape, so it lands in ``topk_merge``, not dispatch self.
             t_merge = time.perf_counter() - t_asm0
-            if self.shadow is not None:
+            shadow = (entry.shadow if entry is not None
+                      else self.shadow)
+            if shadow is not None:
                 # Shadow offer AFTER the answers are built: a hash +
                 # bounded put per sampled query, never a wait — the
                 # scorer re-scores on its own thread (obs.quality).
+                # Tenant mode offers to the TENANT'S scorer, whose
+                # oracle is that tenant's gallery.
                 try:
                     for j, (i, row) in enumerate(emb_rows):
                         # The raw query row — the oracle re-normalizes
                         # exactly like the serving engine did.
-                        self.shadow.offer(items[i].get("id"), row,
-                                          out["rows"][j],
-                                          out["scores"][j])
+                        shadow.offer(items[i].get("id"), row,
+                                     out["rows"][j],
+                                     out["scores"][j])
                 except Exception as e:  # noqa: BLE001 — shadow must not fail answers
                     log.error("shadow offer failed: %s", e)
         if qts:
@@ -721,6 +849,18 @@ class RetrievalServer:
         the drain invariant's population (queries == answered + errors
         + rejected) is untouched."""
         rid = rec.get("id")
+        if self.tenants:
+            # Tenant mode: the record routes to its tenant's own WAL +
+            # watermark (one durability domain per tenant — a noisy
+            # neighbor's ingest burst cannot delay another tenant's
+            # checkpoint).
+            try:
+                entry = self._tenant_entry(rec)
+            except UnknownTenantError as e:
+                with self._lock:
+                    self.ingest_errors += 1
+                return {"id": rid, "error": str(e)}
+            return self._tenant_ingest(entry, rec)
         if self.wal is None or self._ingest_apply is None:
             with self._lock:
                 self.ingest_errors += 1
@@ -750,6 +890,47 @@ class RetrievalServer:
             self.ingest_batches += 1
             self.ingest_vectors += n
         return {"id": rid, "ingested": n, "seq": seq}
+
+    def _tenant_ingest(self, entry, rec: Dict[str, Any]
+                       ) -> Dict[str, Any]:
+        """One tenant's ingest record through ITS durability domain
+        (serve/tenants.py TenantIngest): same encode -> WAL -> fsync
+        barrier -> apply -> ack ordering as the single-tenant path,
+        against the tenant's own WAL and watermark.  Aggregate ingest
+        counters still tick, so Σ per-tenant == tier totals."""
+        rid = rec.get("id")
+        tid = entry.tenant_id
+        ing = entry.ingest
+        if ing is None:
+            with self._lock:
+                self.ingest_errors += 1
+            return {"id": rid, "tenant": tid,
+                    "error": f"tenant {tid!r} ingest requires a WAL "
+                             "(serve --wal-dir)"}
+        try:
+            body = encode_ingest_body(rec.get("ingest"))
+        except (ValueError, TypeError) as e:
+            ing.note_error()
+            with self._lock:
+                self.ingest_errors += 1
+            return {"id": rid, "tenant": tid,
+                    "error": f"bad ingest record: {e}"}
+        try:
+            seq = ing.commit(body)
+        except Exception as e:  # noqa: BLE001 — the client must hear "not durable"
+            ing.note_error()
+            with self._lock:
+                self.ingest_errors += 1
+            log.error("tenant %r ingest %r failed before durability: "
+                      "%s", tid, rid, e)
+            return {"id": rid, "tenant": tid,
+                    "error": f"ingest not durable: {e}"}
+        n = len(body["ids"])
+        with self._lock:
+            self.ingest_batches += 1
+            self.ingest_vectors += n
+        ing.maybe_checkpoint()
+        return {"id": rid, "tenant": tid, "ingested": n, "seq": seq}
 
     def _maybe_checkpoint(self) -> None:
         if (self._checkpoint_fn is None or self._checkpoint_every <= 0):
@@ -807,6 +988,93 @@ class RetrievalServer:
             out["wal"] = {"error": str(e)}
         return out
 
+    # -- multi-tenant map (serve/tenants.py) --------------------------------
+
+    def enable_tenants(self, entries: Dict[str, Any]) -> None:
+        """Install the tenant-keyed serving map — startup-only, like
+        ``attach_wal``: one ``TenantEntry`` per tenant id, each holding
+        exactly one engine per replica (replica r serves tenant t from
+        ``entry.engines[r]``, so the tier's batchers/queues stay
+        shared while every tenant answers from its own gallery)."""
+        if self.tenants:
+            raise ValueError("tenant map already installed")
+        entries = dict(entries)
+        if not entries:
+            raise ValueError("enable_tenants needs >= 1 tenant entry")
+        for tid, entry in entries.items():
+            if len(entry.engines) != len(self.engines):
+                raise ValueError(
+                    f"tenant {tid!r} has {len(entry.engines)} "
+                    f"engine(s); the replica tier has "
+                    f"{len(self.engines)}")
+        self.tenants = entries  # unguarded-ok: enable_tenants runs at startup, before serving threads exist
+        self._replica_idx = {
+            rep.name: i
+            for i, rep in enumerate(self.replicaset.replicas)}
+
+    def _tenant_entry(self, record) -> Any:
+        """The entry a record routes to (tenant mode only); raises
+        :class:`UnknownTenantError` for a missing/unregistered id so
+        the caller accounts it as a malformed request."""
+        tid = record.get("tenant") if isinstance(record, dict) else None
+        entry = self.tenants.get(tid)
+        if entry is None:
+            raise UnknownTenantError(
+                f"unknown tenant {tid!r} (registered: "
+                f"{sorted(self.tenants)})")
+        return entry
+
+    def swap_tenant_engines(self, tenant_id: str, engines,
+                            freshness: Optional[Freshness] = None
+                            ) -> None:
+        """Atomically republish ONE tenant's engine set — the
+        ``swap_engines`` commit point scoped to an entry.  Every other
+        tenant's pointers are untouched; in-flight batches finish on
+        the engines they started with (the dispatcher resolves
+        ``entry.engines`` per batch), so no tenant drops a query.
+        The flip holds the tenant's ingest lock (when it has one) so a
+        durable-ingest apply never races the republish — the
+        single-tenant lock order, per entry."""
+        entry = self.tenants.get(tenant_id)
+        if entry is None:
+            raise UnknownTenantError(
+                f"unknown tenant {tenant_id!r} (registered: "
+                f"{sorted(self.tenants)})")
+        engines = list(engines)
+        if len(engines) != len(entry.engines):
+            raise ValueError(
+                f"tenant {tenant_id!r} swap must preserve the replica "
+                f"count: got {len(engines)}, entry has "
+                f"{len(entry.engines)}")
+        ingest_lock = (entry.ingest.lock if entry.ingest is not None
+                       else contextlib.nullcontext())
+        with ingest_lock:
+            with self._lock:
+                entry.engines = engines
+                if freshness is not None:
+                    entry.freshness = freshness
+                entry.swaps += 1
+                self.swaps += 1
+                generation = self.swaps
+        if self.qtrace is not None:
+            self.qtrace.marker("hotswap_flip", generation=generation,
+                               tenant=tenant_id)
+        log.warning(
+            "hot-swap %d: tenant %r republished (%s)", generation,
+            tenant_id,
+            freshness.identity() if freshness else "same identity")
+
+    def _all_engines(self) -> List[QueryEngine]:
+        """Every distinct engine behind the tier: the replica anchors
+        plus each tenant's sets, deduped by identity (tenant 0's
+        engines ARE ``self.engines``) — the population compile
+        counters sum over."""
+        seen: Dict[int, QueryEngine] = {id(e): e for e in self.engines}
+        for entry in self.tenants.values():
+            for e in entry.engines:
+                seen.setdefault(id(e), e)
+        return list(seen.values())
+
     # -- remediation actuators (docs/RESILIENCE.md §Remediation) -----------
 
     def swap_engines(self, engines, freshness: Optional[Freshness] = None,
@@ -862,6 +1130,16 @@ class RetrievalServer:
             # Replicas share the primary's programs + signature set;
             # only their counters need the reset.
             e.compiles_after_warmup = 0
+        for entry in self.tenants.values():
+            # Each tenant's primary re-dispatches its own buckets (a
+            # shared signature set makes repeats free); replicas again
+            # only reset counters.  rewarm never clears shared
+            # signatures, so the loop cannot thrash the cache.
+            if entry.engines[0] is not self.engine:
+                dt += entry.engines[0].rewarm(self.input_shape)
+            for e in entry.engines:
+                if e is not entry.engines[0] and e is not self.engine:
+                    e.compiles_after_warmup = 0
         self._explicit_compile_key = True
         return {"warmup_s": round(dt, 3)}
 
@@ -872,12 +1150,22 @@ class RetrievalServer:
         total = self.replicaset.rejected
         if self.admission is not None:
             total += self.admission.sheds
+        for entry in self.tenants.values():
+            # Per-tenant fast-rejects (quota + tenant admission) never
+            # reach the replicaset or the global controller, so adding
+            # them double-counts nothing; backpressure and global sheds
+            # were counted above and only ATTRIBUTED to entry.rejected.
+            if entry.quota is not None:
+                total += entry.quota.sheds
+            if entry.admission is not None:
+                total += entry.admission.sheds
         return total
 
     def _compiles_after_warmup(self) -> int:
-        # Replicas share one signature set, so summing never double-
-        # counts a compile; single-engine this is the old value.
-        return sum(e.compiles_after_warmup for e in self.engines)
+        # Replicas (and same-geometry tenants) share one signature set,
+        # so summing never double-counts a compile; single-engine this
+        # is the old value.
+        return sum(e.compiles_after_warmup for e in self._all_engines())
 
     def submit(self, record: Dict[str, Any]):
         """Admit one query record; returns (future, t_submit).  Raises
@@ -887,11 +1175,42 @@ class RetrievalServer:
         qt = (record.get("_qt")
               if self.qtrace is not None and isinstance(record, dict)
               else None)
+        # Tenant resolution happens BEFORE any counting: an unknown
+        # tenant is a malformed request (UnknownTenantError -> errors,
+        # like bad JSON), never an admitted-then-shed query.
+        entry = self._tenant_entry(record) if self.tenants else None
+        if entry is not None and qt is not None:
+            qt.tenant = entry.tenant_id
         with self._span("serve/admit"):
             with self._lock:  # HTTP front end submits from many threads
                 self.queries += 1
+                if entry is not None:
+                    entry.queries += 1
+            if entry is not None and entry.quota is not None and \
+                    not entry.quota.admit():
+                # Quota shed: THIS tenant's token bucket ran dry — a
+                # per-tenant fast-reject (its neighbors' queues and
+                # counters never see the query).
+                with self._lock:
+                    entry.rejected += 1
+                raise QueueFullError(
+                    f"quota exceeded for tenant "
+                    f"{entry.tenant_id!r}; retry after backoff")
+            if entry is not None and entry.admission is not None and \
+                    not entry.admission.admit(trace=qt):
+                with self._lock:
+                    entry.rejected += 1
+                raise QueueFullError(
+                    f"load shed: tenant {entry.tenant_id!r} SLO "
+                    "burning (admission control); retry after backoff")
             if self.admission is not None and \
                     not self.admission.admit(trace=qt):
+                if entry is not None:
+                    # Tier-wide shed, attributed to the tenant whose
+                    # query it refused (sum of per-tenant rejected must
+                    # reproduce the aggregate).
+                    with self._lock:
+                        entry.rejected += 1
                 raise QueueFullError(
                     "load shed: SLO burning (admission control); retry "
                     "after backoff")
@@ -901,7 +1220,16 @@ class RetrievalServer:
                 # lands in the queue, and the queue put is the only
                 # ordering edge between this thread and ``picked``.
                 self.qtrace.admitted(qt)
-            return self.replicaset.submit(record), time.perf_counter()
+            try:
+                fut = self.replicaset.submit(record)
+            except QueueFullError:
+                if entry is not None:
+                    # Backpressure lands on the submitting tenant too:
+                    # counted where replicaset.rejected counts it.
+                    with self._lock:
+                        entry.rejected += 1
+                raise
+            return fut, time.perf_counter()
 
     def handle_many(
         self,
@@ -916,6 +1244,14 @@ class RetrievalServer:
             qt = self._qtrace_begin(rec)
             try:
                 staged.append((rec, *self.submit(rec), qt))
+            except UnknownTenantError as e:
+                # Malformed request (never admitted): errors, not
+                # queries/rejected — the bad-JSON accounting.
+                with self._lock:
+                    self.errors += 1
+                    self.errors_refused += 1
+                self._qtrace_drop(qt, error=True)
+                staged.append((rec, None, str(e), None))
             except QueueFullError as e:
                 # counted in batcher.rejected — NOT also in errors, or
                 # the drain invariant queries == answered + errors +
@@ -952,8 +1288,12 @@ class RetrievalServer:
         real drop — a query the tier swallowed; read mid-flight it also
         counts queries still in their batch, which is why the key is
         absent-when-zero unless ``explicit_drops`` asks for the
-        measured 0."""
-        return (self.queries - self.answered - self.errors
+        measured 0.  Refused-before-admission errors (bad JSON,
+        unknown tenant) sit in ``errors`` but never entered
+        ``queries``, so they are excluded — a refusal is not a
+        negative drop."""
+        return (self.queries - self.answered
+                - (self.errors - self.errors_refused)
                 - self._rejected_total())
 
     def summary(self) -> Dict[str, Any]:
@@ -1008,6 +1348,23 @@ class RetrievalServer:
             # once more, so an untraced run keeps its pre-PR shape.
             **({"qtrace": self.qtrace.summary_block()}
                if self.qtrace is not None else {}),
+            # Per-tenant evidence (serve/tenants.py): one block per
+            # tenant — counters, freshness, quota, shed, ingest,
+            # quality — absent entirely in single-tenant mode (the
+            # freshness-JSON contract), so Σ per-tenant counters can be
+            # audited against the aggregates above (bench_check
+            # --tenants does).
+            **({"tenants": {tid: self.tenants[tid].stats_block()
+                            for tid in sorted(self.tenants)}}
+               if self.tenants else {}),
+            # Errors no tenant row can own (unknown-tenant refusals,
+            # bad JSON — never admitted, so never attributed): the
+            # explicit remainder that makes the tenant error audit
+            # exact — Σ per-tenant errors + this == aggregate errors.
+            **({"errors_unattributed":
+                self.errors - sum(e.errors
+                                  for e in self.tenants.values())}
+               if self.tenants else {}),
             **{k: round(v, 3) for k, v in self._percentiles().items()},
             # Whole-run latency split: where an answer's time went,
             # stage by stage (one read at drain, not per window; from
@@ -1016,13 +1373,14 @@ class RetrievalServer:
             **(self._latency_split(
                 self._tracer().events_since(self._events_start_idx)[0])
                if self._tracer() is not None else {}),
-            # Compile counters are tier-wide sums (replicas share one
-            # signature set, so sums never double-count and both keys
-            # stay mutually consistent — whichever replica took a count
-            # must not make after_warmup exceed total).
+            # Compile counters are tier-wide sums (replicas — and
+            # same-geometry tenants — share one signature set, so sums
+            # never double-count and both keys stay mutually consistent
+            # — whichever engine took a count must not make
+            # after_warmup exceed total).
             **{**self.engine.compile_stats(),
                "compiles_total": sum(e.compiles_total
-                                     for e in self.engines),
+                                     for e in self._all_engines()),
                "compiles_after_warmup": self._compiles_after_warmup()},
         }
 
@@ -1062,6 +1420,18 @@ class RetrievalServer:
                 self.checkpoint_now()
             except Exception as e:  # noqa: BLE001 — drain must finish
                 log.error("drain-time ingest checkpoint failed: %s", e)
+        for tid in sorted(self.tenants):
+            # Same clean-shutdown promise per tenant's durability
+            # domain; one tenant's failed publish must not stop the
+            # others' (its WAL keeps the records either way).
+            ing = self.tenants[tid].ingest
+            if ing is None:
+                continue
+            try:
+                ing.checkpoint_now()
+            except Exception as e:  # noqa: BLE001 — drain must finish
+                log.error("drain-time tenant %r checkpoint failed: %s",
+                          tid, e)
         s = self.summary()
         if self.qtrace is not None and self.qtrace.out_path:
             try:
@@ -1153,6 +1523,7 @@ class RetrievalServer:
                 except ValueError as e:
                     with self._lock:
                         self.errors += 1
+                        self.errors_refused += 1
                     emit({"id": None, "error": f"bad request JSON: {e}"})
                     continue
                 if isinstance(rec, dict) and "ingest" in rec:
@@ -1166,6 +1537,14 @@ class RetrievalServer:
                 try:
                     fut, t0 = self.submit(rec)
                     pending.append((rec.get("id"), fut, t0, qt))
+                except UnknownTenantError as e:
+                    # Malformed request (never admitted): errors, not
+                    # queries/rejected — the bad-JSON accounting.
+                    with self._lock:
+                        self.errors += 1
+                        self.errors_refused += 1
+                    self._qtrace_drop(qt, error=True)
+                    emit({"id": rec.get("id"), "error": str(e)})
                 except QueueFullError as e:
                     # counted in batcher.rejected, not errors (drain
                     # invariant: queries == answered + errors + rejected)

@@ -402,6 +402,155 @@ def _load_gameday():
     return sys.modules[name]
 
 
+def _load_tenants():
+    """File-path-load ``serve.tenants`` (module level stdlib-only —
+    the same contract as the alerts/remediate/quality/gameday modules)
+    WITHOUT importing the package.  The manifest schema id lives in
+    that module alone; this gate never restates the literal."""
+    import importlib.util
+
+    name = "npairloss_tpu.serve.tenants"
+    if name not in sys.modules:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO, "npairloss_tpu", "serve",
+                               "tenants.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[name]
+
+
+def check_tenants(manifest_path: str,
+                  answers_path: Optional[str] = None) -> List[str]:
+    """Gate one multi-tenant serving run: the tenants manifest must be
+    schema-valid per the one contract (validate_tenants_manifest — a
+    tampered manifest with unknown keys, a duplicate tenant id, or an
+    out-of-range quota is refused with every problem listed), and —
+    when an answers log sits next to it (or is named via
+    ``--answers-log``) — the run's evidence must be tenant-consistent:
+    no answer claiming an unregistered tenant id, per-tenant drain
+    counters that cross-sum EXACTLY into the aggregates (quota
+    accounting that leaks across tenants shows up as a sum mismatch),
+    and recall evidence per tenant (an aggregate quality block with no
+    per-tenant breakdown hides exactly the noisy-neighbor regression
+    this tier exists to catch)."""
+    tmod = _load_tenants()
+    try:
+        with open(manifest_path, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except OSError as e:
+        return [f"tenants manifest {manifest_path} unreadable: {e}"]
+    except ValueError as e:
+        return [f"tenants manifest {manifest_path} not JSON: {e}"]
+    problems = tmod.validate_tenants_manifest(manifest)
+    if problems:
+        return [f"tenants manifest refused: {p}" for p in problems]
+    specs = {t["tenant_id"]: t for t in manifest["tenants"]}
+
+    if answers_path is None:
+        cand = os.path.join(
+            os.path.dirname(os.path.abspath(manifest_path)),
+            "answers.jsonl")
+        answers_path = cand if os.path.exists(cand) else None
+    if answers_path is None:
+        _log(f"tenants manifest OK ({len(specs)} tenant(s); no "
+             "answers log to cross-check)")
+        return []
+    answers: List[Dict[str, Any]] = []
+    try:
+        with open(answers_path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    answers.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail
+    except OSError as e:
+        return [f"answers log {answers_path} unreadable: {e}"]
+
+    violations: List[str] = []
+    unknown = sorted({a["tenant"] for a in answers
+                      if isinstance(a, dict)
+                      and isinstance(a.get("tenant"), str)
+                      and a["tenant"] not in specs})
+    if unknown:
+        violations.append(
+            f"answers claim unregistered tenant id(s) {unknown} — an "
+            "unknown tenant must be refused as an error, never served")
+    drain = None
+    for a in answers:
+        if isinstance(a, dict) and a.get("event") == "serve_drain":
+            drain = a
+    if drain is None:
+        violations.append(
+            f"{answers_path}: no serve_drain summary — the per-tenant "
+            "accounting cannot be audited")
+        return violations
+    per = drain.get("tenants")
+    if not isinstance(per, dict) or not per:
+        violations.append(
+            "drain summary has no per-tenant block — a multi-tenant "
+            "run must leave per-tenant evidence")
+        return violations
+    extra = sorted(set(per) - set(specs))
+    if extra:
+        violations.append(
+            f"drain reports unregistered tenant(s) {extra}")
+    # The aggregates must be EXACTLY the per-tenant sums: a quota or
+    # shed accounted against the wrong tenant cancels nowhere and
+    # shows up as a sum mismatch.
+    # "errors" alone admits an explicit remainder: unknown-tenant
+    # refusals and bad JSON are never admitted, so no tenant row can
+    # own them — the drain's errors_unattributed names that count and
+    # the identity stays EXACT (a negative or unexplained remainder is
+    # still refused).
+    unattributed = drain.get("errors_unattributed", 0)
+    if not isinstance(unattributed, int) or unattributed < 0:
+        violations.append(
+            f"errors_unattributed {unattributed!r} is not a "
+            "non-negative count")
+        unattributed = 0
+    for key in ("queries", "answered", "errors", "rejected"):
+        agg = drain.get(key)
+        total = sum(int(row.get(key, 0)) for row in per.values()
+                    if isinstance(row, dict))
+        if key == "errors":
+            total += unattributed
+        if isinstance(agg, int) and total != agg:
+            violations.append(
+                f"per-tenant {key} sum {total} != aggregate {agg} — "
+                "the tenant accounting does not cross-sum"
+                + (" (errors_unattributed included)"
+                   if key == "errors" else ""))
+    if "quality" in drain:
+        violations.append(
+            "aggregate quality block in a multi-tenant drain — recall "
+            "evidence must live inside each tenant's block (one "
+            "cross-tenant average hides a single tenant's collapse)")
+    for tid, spec in specs.items():
+        row = per.get(tid)
+        if not isinstance(row, dict):
+            violations.append(
+                f"tenant {tid!r} missing from the drain's per-tenant "
+                "block")
+            continue
+        if spec.get("recall_floor") is not None \
+                and "quality" not in row:
+            violations.append(
+                f"tenant {tid!r} declares recall_floor "
+                f"{spec['recall_floor']} but its drain block carries "
+                "no quality evidence (shadow scorer never armed?)")
+    if not violations:
+        served = sum(int(row.get("answered", 0))
+                     for row in per.values() if isinstance(row, dict))
+        _log(f"tenants evidence OK ({len(specs)} tenant(s), "
+             f"{served} answered, per-tenant sums match the "
+             "aggregates)")
+    return violations
+
+
 def _load_qtrace():
     """File-path-load ``obs.qtrace.report`` (self-contained, stdlib
     only — the same contract as the alerts/remediate/quality/gameday
@@ -976,6 +1125,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="with --static: restrict findings to files changed since "
         "the git ref (the fast incremental hook)",
     )
+    ap.add_argument(
+        "--tenants", metavar="MANIFEST",
+        help="gate a multi-tenant serving run: refuse a tampered "
+        "tenants manifest (schema, duplicate ids, out-of-range "
+        "quotas) and, against the answers log next to it (or "
+        "--answers-log), refuse answers claiming unregistered "
+        "tenants, drain counters that do not cross-sum, and recall "
+        "evidence hidden in an aggregate block",
+    )
+    ap.add_argument(
+        "--answers-log", dest="answers_log", metavar="PATH",
+        help="with --tenants: the serve answers JSONL to cross-check "
+        "(default: answers.jsonl beside the manifest, when present)",
+    )
     args = ap.parse_args(argv)
 
     if args.static:
@@ -985,6 +1148,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"REGRESSION: {v}")
             return 1
         print(f"bench_check OK (staticcheck over {args.static})")
+        return 0
+
+    if args.tenants:
+        violations = check_tenants(args.tenants,
+                                   answers_path=args.answers_log)
+        if violations:
+            for v in violations:
+                print(f"REGRESSION: {v}")
+            return 1
+        print(f"bench_check OK (tenants manifest {args.tenants})")
         return 0
 
     if args.wal:

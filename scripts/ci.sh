@@ -1566,6 +1566,204 @@ python scripts/bench_check.py --quality "$q_dir/ghost/quality.jsonl" > /dev/null
     && { echo "quality smoke: gate ACCEPTED a breach with no alert log"; exit 1; }
 echo "quality observatory smoke OK (clean zero-alert + gate, fault->alert->escalation->resolve, watch agreement, gate teeth)"
 
+echo "== multi-tenant serving smoke (docs/SERVING.md §Multi-tenant) =="
+# Three tenants (mixed flat/IVF, distinct galleries) behind ONE front
+# end / ONE replica tier / ONE compile cache: routed self-match answers
+# per tenant, an unknown tenant refused as an error, a MID-TRAFFIC
+# hot-swap of one tenant with zero drops and bit-level proof the
+# others kept serving, a noisy tenant quota-shed in isolation (its
+# tenant-scoped alert fires; neighbors keep zero errors/rejects), zero
+# post-warmup compiles across the shared geometry, and the jax-free
+# bench_check --tenants gate accepting the evidence and refusing
+# tampered copies of it.
+mt_dir="$smoke_dir/mt"
+mkdir -p "$mt_dir/idx" "$mt_dir/tel"
+python - "$mt_dir" <<'EOF'
+import json, sys
+import numpy as np
+from npairloss_tpu.serve import GalleryIndex
+d = sys.argv[1]
+for t_i, tid in enumerate(("acme", "bcorp", "ccorp")):
+    rng = np.random.default_rng(11 + t_i)
+    emb = rng.standard_normal((192, 32)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    labels = (np.arange(192) % 16).astype(np.int32)
+    GalleryIndex.build(emb, labels, normalize=False).save(
+        f"{d}/idx/{tid}-0000.gidx")
+    np.save(f"{d}/{tid}.emb.npy", emb)
+tenants = [
+    # capacity = qps*burst_s = 10 tokens: phase A's 10 paced probes
+    # fit the bucket, the 30-query flood cannot.
+    {"tenant_id": "acme", "index_prefix": d + "/idx/acme-",
+     "index_kind": "ivf", "quota_qps": 2.0, "quota_burst_s": 5.0},
+    {"tenant_id": "bcorp", "index_prefix": d + "/idx/bcorp-"},
+    {"tenant_id": "ccorp", "index_prefix": d + "/idx/ccorp-"},
+]
+json.dump({"schema": "npairloss-tenants-v1", "tenants": tenants},
+          open(d + "/tenants.json", "w"))
+with open(d + "/phase_a.jsonl", "w") as f:
+    for tid in ("acme", "bcorp", "ccorp"):
+        emb = np.load(f"{d}/{tid}.emb.npy")
+        for i in range(10):
+            f.write(json.dumps({"id": f"{tid[0]}-{i}", "tenant": tid,
+                                "embedding": emb[i].tolist()}) + "\n")
+    f.write(json.dumps({"id": "x-1", "tenant": "ghost",
+                        "embedding": emb[0].tolist()}) + "\n")
+    f.write(json.dumps({"id": "x-2",
+                        "embedding": emb[0].tolist()}) + "\n")
+EOF
+mkfifo "$mt_dir/in"
+# Strict guard: ANY post-warmup compile aborts the server — the
+# cross-tenant program-sharing claim fails loudly, not just by counter.
+JAX_PLATFORMS=cpu NPAIRLOSS_SERVE_COMPILE_GUARD=strict \
+    python -m npairloss_tpu serve \
+    --tenant-config "$mt_dir/tenants.json" \
+    --top-k 5 --buckets 1,8 --deadline-ms 2 --poll-s 0.02 \
+    --max-queue 64 --metrics-window 4 \
+    --explicit-drops --live-obs --slo-tick 0.2 \
+    --telemetry-dir "$mt_dir/tel" \
+    < "$mt_dir/in" > "$mt_dir/answers.jsonl" \
+    2> "$mt_dir/serve.log" &
+mt_pid=$!
+exec 4> "$mt_dir/in"
+cat "$mt_dir/phase_a.jsonl" >&4
+for _ in $(seq 1 240); do  # 32 answers: 30 routed + 2 refused
+    [[ "$(wc -l < "$mt_dir/answers.jsonl")" -ge 32 ]] && break
+    kill -0 "$mt_pid" 2>/dev/null \
+        || { echo "mt smoke: server died in phase A"; cat "$mt_dir/serve.log"; exit 1; }
+    sleep 0.5
+done
+# Mid-traffic hot-swap: commit a STRICTLY newer bcorp gallery; the
+# per-tenant watch must republish bcorp alone within a sweep or two.
+python - "$mt_dir" <<'EOF'
+import sys
+import numpy as np
+from npairloss_tpu.serve import GalleryIndex
+d = sys.argv[1]
+rng = np.random.default_rng(99)
+emb = rng.standard_normal((192, 32)).astype(np.float32)
+emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+labels = (np.arange(192) % 16).astype(np.int32)
+GalleryIndex.build(emb, labels, normalize=False).save(
+    d + "/idx/bcorp-0001.gidx")
+np.save(d + "/bcorp2.emb.npy", emb)
+EOF
+for _ in $(seq 1 60); do
+    grep -q "tenant 'bcorp' republished" "$mt_dir/serve.log" && break
+    kill -0 "$mt_pid" 2>/dev/null \
+        || { echo "mt smoke: server died awaiting hot-swap"; cat "$mt_dir/serve.log"; exit 1; }
+    sleep 0.5
+done
+grep -q "tenant 'bcorp' republished" "$mt_dir/serve.log" \
+    || { echo "mt smoke: bcorp hot-swap never landed"; cat "$mt_dir/serve.log"; exit 1; }
+# Phase B: bcorp answers from the NEW gallery; then the noisy-neighbor
+# flood — acme's 1-token bucket sheds the burst while bcorp/ccorp ride
+# along untouched.
+python - "$mt_dir" <<'EOF'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+with open(d + "/phase_b.jsonl", "w") as f:
+    emb2 = np.load(d + "/bcorp2.emb.npy")
+    for i in range(10):
+        f.write(json.dumps({"id": f"b2-{i}", "tenant": "bcorp",
+                            "embedding": emb2[i].tolist()}) + "\n")
+    embs = {t: np.load(f"{d}/{t}.emb.npy")
+            for t in ("acme", "bcorp", "ccorp")}
+    for i in range(30):
+        f.write(json.dumps({"id": f"hot-{i}", "tenant": "acme",
+                            "embedding": embs["acme"][i % 192].tolist()})
+                + "\n")
+        if i % 3 == 0:
+            for t in ("bcorp", "ccorp"):
+                emb = embs[t] if t != "bcorp" else emb2
+                f.write(json.dumps({"id": f"q-{t}-{i}", "tenant": t,
+                                    "embedding": emb[i].tolist()}) + "\n")
+EOF
+cat "$mt_dir/phase_b.jsonl" >&4
+for _ in $(seq 1 120); do  # 32 + 10 + 30 + 20 = 92 answers
+    [[ "$(wc -l < "$mt_dir/answers.jsonl")" -ge 92 ]] && break
+    kill -0 "$mt_pid" 2>/dev/null \
+        || { echo "mt smoke: server died in phase B"; cat "$mt_dir/serve.log"; exit 1; }
+    sleep 0.5
+done
+for _ in $(seq 1 60); do  # the tenant-scoped quota alert must page
+    grep -q '"slo": "tenant_quota@acme"' "$mt_dir/tel/alerts.jsonl" 2>/dev/null && break
+    sleep 0.5
+done
+kill -TERM "$mt_pid" 2>/dev/null || true
+exec 4>&-
+rc=0; wait "$mt_pid" || rc=$?
+[[ "$rc" -eq 75 ]] \
+    || { echo "mt smoke: expected exit 75 after SIGTERM, got $rc"; cat "$mt_dir/serve.log"; exit 1; }
+python - "$mt_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+lines = [json.loads(ln) for ln in open(d + "/answers.jsonl") if ln.strip()]
+drain = lines[-1]
+assert drain.get("event") == "serve_drain", drain
+answers = {a["id"]: a for a in lines[:-1]}
+for tid in ("acme", "bcorp", "ccorp"):
+    for i in range(10):  # phase A: routed self-match per tenant
+        a = answers[f"{tid[0]}-{i}"]
+        assert a.get("tenant") == tid and a["neighbors"][0]["row"] == i, a
+for i in range(10):  # post-swap bcorp: NEW gallery's rows self-match
+    a = answers[f"b2-{i}"]
+    top1 = a["neighbors"][0]
+    assert top1["row"] == i and top1["score"] > 0.99, a
+for rid in ("x-1", "x-2"):  # unknown tenant: refused, never admitted
+    assert "unknown tenant" in answers[rid]["error"], answers[rid]
+shed = [a for a in answers.values()
+        if "quota exceeded" in a.get("error", "")]
+assert shed and all("'acme'" in a["error"] for a in shed), len(shed)
+per = drain["tenants"]
+assert per["acme"]["quota"]["sheds"] >= 15, per["acme"]
+assert per["bcorp"]["errors"] == 0 and per["bcorp"]["rejected"] == 0, per["bcorp"]
+assert per["ccorp"]["errors"] == 0 and per["ccorp"]["rejected"] == 0, per["ccorp"]
+assert per["bcorp"]["hot_swaps"] == 1 and "hot_swaps" not in per["ccorp"], per
+assert per["acme"]["index_kind"] == "ivf" and per["bcorp"]["index_kind"] == "flat"
+assert drain["errors_unattributed"] == 2, drain  # the 2 unknown-tenant refusals
+for key in ("queries", "answered", "errors", "rejected"):
+    total = sum(row[key] for row in per.values())
+    if key == "errors":
+        total += drain["errors_unattributed"]
+    assert total == drain[key], (key, total, drain[key])
+assert drain["queries_dropped"] == 0, drain
+assert drain["compiles_after_warmup"] == 0, drain
+alerts = [json.loads(ln) for ln in open(d + "/tel/alerts.jsonl")]
+fired = [a for a in alerts if a.get("state") == "firing"]
+assert any(a["slo"] == "tenant_quota@acme" for a in fired), fired
+# Noisy-neighbor isolation at the paging layer: acme's incident never
+# becomes a bcorp/ccorp-scoped page.
+assert not [a for a in fired
+            if a["slo"].endswith(("@bcorp", "@ccorp"))], fired
+print(f"mt smoke: {drain['answered']} answered across 3 tenants, "
+      f"{per['acme']['quota']['sheds']} acme sheds contained, "
+      f"1 bcorp hot-swap, 0 dropped, 0 post-warmup compiles")
+EOF
+python scripts/bench_check.py --tenants "$mt_dir/tenants.json" > /dev/null \
+    || { echo "mt smoke: gate REFUSED honest tenant evidence"; exit 1; }
+python - "$mt_dir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+man = json.load(open(d + "/tenants.json"))
+man["tenants"][0]["quota_qps"] = -1
+json.dump(man, open(d + "/tampered_manifest.json", "w"))
+out = []
+for ln in open(d + "/answers.jsonl"):
+    rec = json.loads(ln)
+    if rec.get("event") == "serve_drain":
+        rec["tenants"]["acme"]["rejected"] = 0  # hide the sheds
+    out.append(json.dumps(rec))
+open(d + "/tampered_answers.jsonl", "w").write("\n".join(out) + "\n")
+EOF
+python scripts/bench_check.py --tenants "$mt_dir/tampered_manifest.json" > /dev/null \
+    && { echo "mt smoke: gate ACCEPTED a tampered manifest"; exit 1; }
+python scripts/bench_check.py --tenants "$mt_dir/tenants.json" \
+    --answers-log "$mt_dir/tampered_answers.jsonl" > /dev/null \
+    && { echo "mt smoke: gate ACCEPTED broken tenant cross-sums"; exit 1; }
+echo "multi-tenant smoke OK (3 tenants one tier, routed answers, mid-traffic hot-swap, quota isolation + tenant-scoped alert, gate + teeth)"
+
 echo "== gameday: composed-system soak (docs/RESILIENCE.md §8) =="
 # The whole stack as one production-shaped group — snapshotting trainer
 # (preempted mid-stream, relaunched, resumed), replicated serving tier
